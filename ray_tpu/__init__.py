@@ -1,0 +1,45 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+A ground-up rebuild of the capabilities of Ray (tasks / actors / objects,
+distributed scheduling with gang placement, distributed training, HPO,
+streaming data, serving, RL) designed for JAX/XLA on TPU pods: intra-slice
+parallelism (DP/FSDP/TP/SP/EP, ring attention) is expressed as GSPMD sharding
+and Pallas kernels compiled to ICI collectives, while this package provides
+what XLA does not — the multi-process runtime around the compiled step.
+
+Public core API mirrors the reference's ``ray`` module surface
+(``python/ray/__init__.py``): ``init``, ``remote``, ``get``, ``put``,
+``wait``, ``kill``, ``get_actor``, plus ``util``-style placement groups.
+"""
+
+from ray_tpu._version import version as __version__  # noqa: F401
+from ray_tpu.core.api import (  # noqa: F401
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.errors import (  # noqa: F401
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.placement import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)

@@ -1,0 +1,37 @@
+"""Build the native shared library (g++ -shared), cached by source mtime.
+
+The reference builds its native layer with bazel (``BUILD.bazel``); here the
+native surface is small enough that a direct g++ invocation at first import
+keeps the dev loop to sub-second rebuilds. The built ``.so`` lands next to the
+sources in ``build/``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "build")
+_LOCK = threading.Lock()
+
+
+def build_library(name: str, sources: list, extra_flags: list = ()) -> str:
+    """Compile ``sources`` (relative to _native/) into build/lib<name>.so,
+    rebuilding only when a source is newer than the output. Returns the path.
+    """
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_DIR, s) for s in sources]
+    with _LOCK:
+        if os.path.exists(out):
+            out_mtime = os.path.getmtime(out)
+            if all(os.path.getmtime(s) <= out_mtime for s in srcs):
+                return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + f".tmp.{os.getpid()}"
+        cmd = ["g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
+               "-pthread", *extra_flags, "-o", tmp, *srcs]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)  # atomic: concurrent builders race safely
+    return out

@@ -1,0 +1,64 @@
+"""Multi-node-in-one-machine cluster fixture.
+
+Analogue of the reference's ``python/ray/cluster_utils.py:135`` ``Cluster`` —
+the backbone of all distributed testing (SURVEY §4: "multiple raylets on one
+machine emulate multi-node"). Each ``add_node`` starts a real node supervisor
+(its own RPC server, worker pool and resource accounting) in this process;
+workers are real subprocesses, so scheduling, spillback, object pulls and
+node-death paths exercise the same code as a physical cluster.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ray_tpu.core.controller import Controller
+from ray_tpu.core.node import Node
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[Dict] = None):
+        self.controller = Controller()
+        self.nodes = []
+        if initialize_head:
+            self.add_node(**(head_node_args or {}))
+
+    @property
+    def address(self):
+        return self.controller.address
+
+    def add_node(self, num_cpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> Node:
+        node_resources = dict(resources or {})
+        if num_cpus is not None:
+            node_resources["CPU"] = float(num_cpus)
+        node = Node(self.controller.address, node_resources, labels)
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: Node) -> None:
+        node.stop()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def wait_for_nodes(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        want = len(self.nodes)
+        while time.monotonic() < deadline:
+            alive = [n for n in self.controller.list_nodes() if n["alive"]]
+            if len(alive) >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"only {len(alive)}/{want} nodes alive")
+
+    def shutdown(self) -> None:
+        for node in self.nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        self.nodes.clear()
+        self.controller.stop()

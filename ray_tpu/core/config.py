@@ -1,0 +1,92 @@
+"""Process-wide typed configuration flags.
+
+TPU-native analogue of the reference's ``RAY_CONFIG`` system
+(reference: ``src/ray/common/ray_config_def.h:18-22`` — 216 typed flags, each
+overridable via a ``RAY_<name>`` env var or ``ray.init(_system_config=...)``).
+Here every flag is declared once in ``_FLAG_DEFS`` with a type and default;
+``RAY_TPU_<NAME>`` env vars override at import time and
+``init(_system_config={...})`` overrides at runtime.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAG_DEFS: Dict[str, tuple] = {
+    # (type, default, doc)
+    "inline_object_max_bytes": (int, 100 * 1024,
+        "Task returns at or below this size are returned in-band to the owner's "
+        "in-process store instead of the shared-memory store (reference: "
+        "max_direct_call_object_size, ray_config_def.h)."),
+    "object_store_memory_bytes": (int, 2 * 1024**3,
+        "Default size of the per-node shared-memory object store segment."),
+    "object_store_fallback_dir": (str, "/dev/shm",
+        "Directory backing the shared-memory store files."),
+    "worker_lease_timeout_s": (float, 30.0,
+        "How long a task submission waits for a worker lease before erroring."),
+    "worker_start_timeout_s": (float, 60.0,
+        "How long the worker pool waits for a forked worker to register."),
+    "idle_worker_keep_s": (float, 300.0,
+        "Idle workers beyond the soft pool limit are reaped after this long."),
+    "heartbeat_period_s": (float, 1.0,
+        "Node -> controller liveness heartbeat period (reference: raylet "
+        "report period / GcsHealthCheckManager)."),
+    "health_check_failure_threshold": (int, 5,
+        "Missed heartbeats before the controller declares a node dead "
+        "(reference: health_check_failure_threshold, ray_config_def.h:846)."),
+    "scheduler_spread_threshold": (float, 0.5,
+        "Hybrid policy: prefer the local/first node until its utilization "
+        "crosses this fraction, then spread (reference: "
+        "scheduler_spread_threshold, hybrid_scheduling_policy.cc)."),
+    "max_pending_lease_requests_per_key": (int, 10,
+        "Max in-flight worker-lease requests per scheduling key (reference: "
+        "ClusterSizeBasedLeaseRequestRateLimiter, core_worker.h:1963)."),
+    "task_retry_delay_ms": (int, 100,
+        "Delay before retrying a failed-but-retriable task."),
+    "actor_restart_delay_ms": (int, 200,
+        "Delay before restarting a dead actor with restarts remaining."),
+    "get_poll_interval_s": (float, 0.01,
+        "Polling interval for blocking get on remote objects."),
+    "rpc_connect_retries": (int, 20,
+        "TCP connect attempts (50ms apart) before an RPC endpoint is dead."),
+    "log_to_driver": (bool, True,
+        "Forward worker stdout/stderr lines to the driver process."),
+    "event_buffer_max": (int, 10000,
+        "Max buffered task state-transition events per worker (reference: "
+        "TaskEventBuffer, task_event_buffer.h:206)."),
+}
+
+
+class _Config:
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        for name, (typ, default, _doc) in _FLAG_DEFS.items():
+            env = os.environ.get(f"RAY_TPU_{name.upper()}")
+            if env is not None:
+                if typ is bool:
+                    self._values[name] = env.lower() in ("1", "true", "yes")
+                else:
+                    self._values[name] = typ(env)
+            else:
+                self._values[name] = default
+
+    def __getattr__(self, name: str):
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def update(self, overrides: Dict[str, Any]) -> None:
+        """Apply ``_system_config`` style overrides (validated by name/type)."""
+        for name, value in overrides.items():
+            if name not in _FLAG_DEFS:
+                raise ValueError(f"Unknown config flag: {name}")
+            typ = _FLAG_DEFS[name][0]
+            self._values[name] = typ(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+config = _Config()

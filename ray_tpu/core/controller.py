@@ -1,0 +1,655 @@
+"""Controller: the cluster control plane (GCS equivalent).
+
+Analogue of the reference's Global Control Service
+(``src/ray/gcs/gcs_server/gcs_server.h:223-289``): one logical process holding
+cluster-level metadata — node membership + health (``GcsNodeManager``,
+``GcsHealthCheckManager``), the actor directory and lifecycle state machine
+*including scheduling and restarts* (``GcsActorManager`` +
+``GcsActorScheduler``: actors are scheduled by the control plane, not by the
+creating client, so restarts survive the creator), placement groups with
+two-phase bundle reservation (``GcsPlacementGroupManager/Scheduler``), jobs
+(``GcsJobManager``), a KV store used for the function table and named actors
+(``GcsInternalKVManager``), and cluster-level node selection for tasks (the
+cluster half of the reference's two-level scheduler,
+``cluster_resource_scheduler.h``).
+
+The data plane stays decentralized exactly as in the reference: object values
+live with their owners; the controller never sees them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import resources as resmath
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu.core.rpc import ClientPool, RpcServer
+
+Addr = Tuple[str, int]
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class NodeRecord:
+    def __init__(self, node_id: NodeID, addr: Addr, resources: Dict[str, float],
+                 labels: Dict[str, str]):
+        self.node_id = node_id
+        self.addr = tuple(addr)
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels)
+        self.queue_len = 0
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id.hex(),
+            "addr": self.addr,
+            "resources": dict(self.total),
+            "available": dict(self.available),
+            "labels": dict(self.labels),
+            "alive": self.alive,
+            "queue_len": self.queue_len,
+        }
+
+
+class ActorRecord:
+    def __init__(self, actor_id: ActorID, info: Dict[str, Any],
+                 spec: Dict[str, Any], opts: Dict[str, Any]):
+        self.actor_id = actor_id
+        self.state = PENDING_CREATION
+        self.addr: Optional[Tuple] = None  # (worker_addr, worker_id, node_addr)
+        self.node_id: Optional[NodeID] = None
+        self.info = info      # name, class_name, resources, max_restarts, ...
+        self.spec = spec      # start_actor payload (cls_key, args_blob, ...)
+        self.opts = opts      # scheduling options (resources, strategy, pg)
+        self.num_restarts = 0
+        self.incarnation = 0
+        self.death_cause: Optional[str] = None
+
+
+class PlacementGroupRecord:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.state = "PENDING"  # PENDING -> CREATING -> CREATED
+        # bundle index -> (node_id, node addr)
+        self.placement: Dict[int, Tuple[NodeID, Addr]] = {}
+
+
+def _utilization(rec: NodeRecord) -> float:
+    """Max fractional utilization across resource kinds (0 = idle)."""
+    utils = []
+    for k, tot in rec.total.items():
+        if tot > 0:
+            utils.append(1.0 - rec.available.get(k, 0.0) / tot)
+    return max(utils) if utils else 0.0
+
+
+class Controller:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeRecord] = {}
+        self._actors: Dict[ActorID, ActorRecord] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._pgs: Dict[PlacementGroupID, PlacementGroupRecord] = {}
+        self._clients = ClientPool()
+        self._stopped = threading.Event()
+        self._server = RpcServer(
+            handlers={
+                "register_node": self.register_node,
+                "unregister_node": self.unregister_node,
+                "heartbeat": self.heartbeat,
+                "list_nodes": self.list_nodes,
+                "pick_node": self.pick_node,
+                "register_actor": self.register_actor,
+                "get_actor": self.get_actor,
+                "list_actors": self.list_actors,
+                "get_named_actor": self.get_named_actor,
+                "report_actor_failure": self.report_actor_failure,
+                "kill_actor": self.kill_actor,
+                "kv_put": self.kv_put,
+                "kv_get": self.kv_get,
+                "kv_del": self.kv_del,
+                "kv_keys": self.kv_keys,
+                "register_job": self.register_job,
+                "finish_job": self.finish_job,
+                "list_jobs": self.list_jobs,
+                "create_placement_group": self.create_placement_group,
+                "get_placement_group": self.get_placement_group,
+                "remove_placement_group": self.remove_placement_group,
+                "cluster_resources": self.cluster_resources,
+                "ping": lambda: "pong",
+            },
+            name="controller",
+            inline_methods={"heartbeat"},
+        )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="controller-health", daemon=True)
+        self._health_thread.start()
+
+    @property
+    def address(self) -> Addr:
+        return self._server.addr
+
+    # ------------------------------------------------------------- nodes
+
+    def register_node(self, node_id_bytes: bytes, addr: Addr,
+                      resources: Dict[str, float],
+                      labels: Dict[str, str]) -> None:
+        node_id = NodeID(node_id_bytes)
+        with self._lock:
+            self._nodes[node_id] = NodeRecord(node_id, addr, resources, labels)
+
+    def unregister_node(self, node_id_bytes: bytes) -> None:
+        node_id = NodeID(node_id_bytes)
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec:
+                rec.alive = False
+        self._on_node_dead(node_id)
+
+    def heartbeat(self, node_id_bytes: bytes, available: Dict[str, float],
+                  queue_len: int) -> None:
+        with self._lock:
+            rec = self._nodes.get(NodeID(node_id_bytes))
+            if rec is None:
+                return
+            rec.available = dict(available)
+            rec.queue_len = queue_len
+            rec.last_heartbeat = time.monotonic()
+            rec.alive = True
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.summary() for r in self._nodes.values()]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        with self._lock:
+            total: Dict[str, float] = {}
+            for rec in self._nodes.values():
+                if rec.alive:
+                    resmath.credit(total, rec.total)
+            return total
+
+    def _health_loop(self) -> None:
+        period = config.heartbeat_period_s
+        threshold = config.health_check_failure_threshold * period
+        while not self._stopped.wait(period):
+            now = time.monotonic()
+            dead_nodes = []
+            with self._lock:
+                for rec in self._nodes.values():
+                    if rec.alive and now - rec.last_heartbeat > threshold:
+                        rec.alive = False
+                        dead_nodes.append(rec.node_id)
+            for node_id in dead_nodes:
+                self._on_node_dead(node_id)
+
+    def _on_node_dead(self, node_id: NodeID) -> None:
+        """Fail (and maybe restart) actors on a dead node (reference:
+        GcsActorManager node-death handling, gcs_actor_manager.h:88)."""
+        with self._lock:
+            affected = [rec.actor_id for rec in self._actors.values()
+                        if rec.node_id == node_id and rec.state == ALIVE]
+        for actor_id in affected:
+            self.report_actor_failure(actor_id.binary(),
+                                      f"node {node_id.hex()} died")
+
+    # ------------------------------------------------- cluster scheduling
+
+    def pick_node(
+        self,
+        resources: Dict[str, float],
+        strategy: Optional[Dict[str, Any]] = None,
+        caller_node_id: Optional[bytes] = None,
+        excluded: Optional[List[bytes]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Cluster-level node selection.
+
+        Default is the reference's hybrid policy
+        (``src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc``):
+        prefer the caller's node while its utilization is below
+        ``scheduler_spread_threshold``, otherwise pick the feasible node with
+        the lowest utilization (ties broken deterministically). ``spread``
+        picks the least-utilized feasible node regardless of locality;
+        ``node_affinity`` pins (hard) or prefers (soft) a node. Returns
+        {node_id, addr} or None if infeasible.
+        """
+        strategy = strategy or {}
+        excluded_ids = {NodeID(b) for b in (excluded or [])}
+        with self._lock:
+            alive = [r for r in self._nodes.values()
+                     if r.alive and r.node_id not in excluded_ids]
+            feasible = [r for r in alive if resmath.fits(r.total, resources)]
+            if not feasible:
+                return None
+
+            kind = strategy.get("kind", "hybrid")
+            if kind == "node_affinity":
+                target = NodeID.from_hex(strategy["node_id"])
+                for r in feasible:
+                    if r.node_id == target:
+                        return self._grant(r, resources)
+                if not strategy.get("soft", False):
+                    return None
+            elif kind == "spread":
+                feasible.sort(key=lambda r: (_utilization(r), r.queue_len,
+                                             r.node_id.binary()))
+                return self._grant(feasible[0], resources)
+
+            # Hybrid: local-first below the spread threshold.
+            if caller_node_id is not None:
+                local = NodeID(caller_node_id)
+                for r in feasible:
+                    if (r.node_id == local
+                            and _utilization(r) < config.scheduler_spread_threshold
+                            and resmath.fits(r.available, resources)):
+                        return self._grant(r, resources)
+            with_room = [r for r in feasible
+                         if resmath.fits(r.available, resources)]
+            pool = with_room or feasible
+            pool.sort(key=lambda r: (_utilization(r), r.queue_len,
+                                     r.node_id.binary()))
+            return self._grant(pool[0], resources)
+
+    def _grant(self, rec: NodeRecord, resources: Dict[str, float]):
+        # Optimistic decrement until the next heartbeat refreshes truth.
+        resmath.deduct(rec.available, resources)
+        return {"node_id": rec.node_id.binary(), "addr": rec.addr}
+
+    # ------------------------------------------------------------ actors
+    #
+    # The controller owns the whole actor lifecycle: REGISTER ->
+    # PENDING_CREATION -> (scheduled on a node, __init__ pushed) -> ALIVE;
+    # on failure, RESTARTING (num_restarts < max_restarts) -> re-scheduled,
+    # else DEAD. Mirrors GcsActorManager + GcsActorScheduler.
+
+    def register_actor(self, actor_id_bytes: bytes, info: Dict[str, Any],
+                       spec: Dict[str, Any], opts: Dict[str, Any]) -> None:
+        actor_id = ActorID(actor_id_bytes)
+        with self._lock:
+            name = info.get("name")
+            if name:
+                existing = self._named_actors.get(name)
+                if existing is not None:
+                    rec = self._actors.get(existing)
+                    if rec is not None and rec.state != DEAD:
+                        raise ValueError(
+                            f"Actor with name {name!r} already exists")
+                self._named_actors[name] = actor_id
+            self._actors[actor_id] = ActorRecord(actor_id, info, spec, opts)
+        threading.Thread(target=self._schedule_actor, args=(actor_id,),
+                         name="actor-schedule", daemon=True).start()
+
+    def _schedule_actor(self, actor_id: ActorID) -> None:
+        """Place the actor on a node, lease a dedicated worker, push
+        ``__init__`` (reference: GcsActorScheduler lease-based scheduling)."""
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None or rec.state == DEAD:
+                return
+            opts = rec.opts
+            spec = dict(rec.spec)
+            incarnation = rec.incarnation
+        try:
+            deadline = time.monotonic() + config.worker_lease_timeout_s
+            excluded: List[bytes] = []
+            while True:
+                placement = opts.get("placement")
+                picked_node_id = None
+                if placement is not None:
+                    pg = self.get_placement_group(placement[0])
+                    if pg is None or placement[1] not in pg["placement"]:
+                        raise RuntimeError(
+                            f"placement group bundle {placement} not ready")
+                    node_id_bytes, node_addr = pg["placement"][placement[1]]
+                    bundle = (placement[0], placement[1])
+                else:
+                    pick = self.pick_node(
+                        opts.get("resources", {"CPU": 1.0}),
+                        opts.get("scheduling_strategy"), None, excluded)
+                    if pick is None:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"no feasible node for actor resources "
+                                f"{opts.get('resources')}")
+                        time.sleep(0.2)
+                        excluded = []
+                        continue
+                    node_addr, node_id_bytes = pick["addr"], pick["node_id"]
+                    picked_node_id = node_id_bytes
+                    bundle = None
+                try:
+                    lease = self._clients.get(tuple(node_addr)).call(
+                        "create_actor_worker",
+                        opts.get("resources", {"CPU": 1.0}), bundle, None,
+                        timeout=config.worker_lease_timeout_s + 10.0)
+                except Exception as e:
+                    self._clients.invalidate(tuple(node_addr))
+                    lease = {"error": f"node unreachable: {e}"}
+                if "error" in lease:
+                    if picked_node_id is not None:
+                        excluded.append(picked_node_id)
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"actor worker lease failed: {lease['error']}")
+                    continue
+                worker_addr = tuple(lease["addr"])
+                reply = self._clients.get(worker_addr).call(
+                    "start_actor", spec, timeout=None)
+                if reply["ok"]:
+                    with self._lock:
+                        rec = self._actors.get(actor_id)
+                        if rec is None or rec.incarnation != incarnation \
+                                or rec.state == DEAD:
+                            # Raced with kill/another restart: release worker.
+                            self._clients.get(tuple(node_addr)).call(
+                                "kill_worker", lease["worker_id"], True)
+                            return
+                        rec.state = ALIVE
+                        rec.addr = (worker_addr, lease["worker_id"],
+                                    tuple(node_addr))
+                        rec.node_id = NodeID(node_id_bytes)
+                    return
+                # __init__ raised: permanent failure, no restart (parity with
+                # the reference: creation-task errors kill the actor).
+                import pickle
+
+                err_desc = "__init__ failed"
+                try:
+                    from ray_tpu.core import serialization
+
+                    err = serialization.deserialize(reply["error_frame"])
+                    err_desc = f"__init__ failed: {getattr(err, 'tb', err)}"
+                except Exception:
+                    pass
+                self._mark_dead_locked_safe(actor_id, err_desc)
+                return
+        except BaseException as e:  # noqa: BLE001
+            self._mark_dead_locked_safe(actor_id, f"creation failed: {e!r}")
+
+    def _mark_dead_locked_safe(self, actor_id: ActorID, reason: str) -> None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is not None:
+                rec.state = DEAD
+                rec.death_cause = reason
+
+    def report_actor_failure(self, actor_id_bytes: bytes,
+                             reason: str = "") -> Dict[str, Any]:
+        """A caller (or node-death handling) observed the actor's worker gone.
+        Restart if budget remains (reference: max_restarts state machine,
+        gcs_actor_manager.h:88); returns the resulting record."""
+        actor_id = ActorID(actor_id_bytes)
+        should_schedule = False
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return None
+            if rec.state in (DEAD, RESTARTING, PENDING_CREATION):
+                return self._actor_summary(rec)
+            max_restarts = rec.info.get("max_restarts", 0)
+            if max_restarts == -1 or rec.num_restarts < max_restarts:
+                rec.state = RESTARTING
+                rec.num_restarts += 1
+                rec.incarnation += 1
+                rec.addr = None
+                should_schedule = True
+            else:
+                rec.state = DEAD
+                rec.death_cause = reason
+            summary = self._actor_summary(rec)
+        if should_schedule:
+            def _delayed():
+                time.sleep(config.actor_restart_delay_ms / 1000.0)
+                self._schedule_actor(actor_id)
+
+            threading.Thread(target=_delayed, name="actor-restart",
+                             daemon=True).start()
+        return summary
+
+    def kill_actor(self, actor_id_bytes: bytes, no_restart: bool = True) -> None:
+        actor_id = ActorID(actor_id_bytes)
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None or rec.state == DEAD:
+                return
+            addr = rec.addr
+            if no_restart:
+                rec.state = DEAD
+                rec.death_cause = "killed via kill()"
+        if addr is not None:
+            worker_addr, worker_id, node_addr = addr
+            try:
+                self._clients.get(tuple(node_addr)).call(
+                    "kill_worker", worker_id, True, timeout=5.0)
+            except Exception:
+                pass
+        if not no_restart:
+            self.report_actor_failure(actor_id_bytes, "killed (restartable)")
+
+    def _actor_summary(self, rec: ActorRecord) -> Dict[str, Any]:
+        return {
+            "actor_id": rec.actor_id.binary(),
+            "state": rec.state,
+            "addr": rec.addr,
+            "node_id": rec.node_id.binary() if rec.node_id else None,
+            "info": rec.info,
+            "num_restarts": rec.num_restarts,
+            "incarnation": rec.incarnation,
+            "death_cause": rec.death_cause,
+        }
+
+    def get_actor(self, actor_id_bytes: bytes) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._actors.get(ActorID(actor_id_bytes))
+            return None if rec is None else self._actor_summary(rec)
+
+    def list_actors(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [self._actor_summary(r) for r in self._actors.values()]
+
+    def get_named_actor(self, name: str) -> Optional[bytes]:
+        with self._lock:
+            actor_id = self._named_actors.get(name)
+            return actor_id.binary() if actor_id else None
+
+    # ---------------------------------------------------------------- kv
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        with self._lock:
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> bool:
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # -------------------------------------------------------------- jobs
+
+    def register_job(self, job_id: str, info: Dict[str, Any]) -> None:
+        with self._lock:
+            self._jobs[job_id] = {"state": "RUNNING", **info}
+
+    def finish_job(self, job_id: str, state: str = "SUCCEEDED") -> None:
+        with self._lock:
+            if job_id in self._jobs:
+                self._jobs[job_id]["state"] = state
+
+    def list_jobs(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return dict(self._jobs)
+
+    # -------------------------------------------- placement groups (2PC)
+
+    def create_placement_group(
+        self,
+        pg_id_bytes: bytes,
+        bundles: List[Dict[str, float]],
+        strategy: str,
+    ) -> Dict[str, Any]:
+        """Reserve all bundles atomically across nodes; idempotent.
+
+        Two-phase commit as in the reference
+        (``gcs_placement_group_scheduler.h`` + raylet
+        ``placement_group_resource_manager.h``): phase 1 reserves each bundle
+        on its chosen node (node-side reservation is idempotent per
+        (pg, bundle)); if any reservation fails, prior reservations are rolled
+        back and the PG returns to PENDING (caller may retry). Concurrent
+        calls for the same PG observe CREATING and back off.
+        """
+        pg_id = PlacementGroupID(pg_id_bytes)
+        with self._lock:
+            rec = self._pgs.get(pg_id)
+            if rec is None:
+                rec = PlacementGroupRecord(pg_id, bundles, strategy)
+                self._pgs[pg_id] = rec
+            if rec.state == "CREATED":
+                return self._pg_summary(rec)
+            if rec.state == "CREATING":
+                return {"state": "PENDING", "reason": "creation in progress"}
+            rec.state = "CREATING"
+            plan = self._plan_bundles(rec.bundles, rec.strategy)
+        if plan is None:
+            with self._lock:
+                rec.state = "PENDING"
+            return {"state": "PENDING", "reason": "infeasible"}
+        reserved: List[Tuple[int, NodeRecord]] = []
+        ok = True
+        for idx, node_rec in plan:
+            try:
+                granted = self._clients.get(node_rec.addr).call(
+                    "reserve_bundle", pg_id_bytes, idx, rec.bundles[idx])
+            except Exception:
+                granted = False
+            if granted:
+                reserved.append((idx, node_rec))
+            else:
+                ok = False
+                break
+        if not ok:
+            for idx, node_rec in reserved:
+                try:
+                    self._clients.get(node_rec.addr).call(
+                        "release_bundle", pg_id_bytes, idx)
+                except Exception:
+                    pass
+            with self._lock:
+                rec.state = "PENDING"
+            return {"state": "PENDING", "reason": "reservation_failed"}
+        with self._lock:
+            rec.state = "CREATED"
+            for idx, node_rec in reserved:
+                rec.placement[idx] = (node_rec.node_id, node_rec.addr)
+                resmath.deduct(node_rec.available, rec.bundles[idx])
+            return self._pg_summary(rec)
+
+    def _plan_bundles(self, bundles, strategy):
+        """Choose a node per bundle honoring PACK/SPREAD/STRICT_PACK/
+        STRICT_SPREAD (reference: common.proto:937-944)."""
+        alive = [r for r in self._nodes.values() if r.alive]
+        if not alive:
+            return None
+        remaining = {r.node_id: dict(r.available) for r in alive}
+        plan: List[Tuple[int, NodeRecord]] = []
+
+        if strategy in ("STRICT_PACK", "PACK"):
+            order = sorted(alive, key=lambda r: (-_utilization(r),
+                                                 r.node_id.binary()))
+            if strategy == "STRICT_PACK":
+                for r in order:
+                    rem = dict(r.available)
+                    if all(resmath.take(rem, b) for b in bundles):
+                        return [(i, r) for i in range(len(bundles))]
+                return None
+            for i, b in enumerate(bundles):
+                placed = False
+                for r in order:
+                    if resmath.take(remaining[r.node_id], b):
+                        plan.append((i, r))
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return plan
+
+        # SPREAD / STRICT_SPREAD: round-robin distinct nodes.
+        order = sorted(alive, key=lambda r: (_utilization(r),
+                                             r.node_id.binary()))
+        used_nodes = set()
+        for i, b in enumerate(bundles):
+            placed = False
+            candidates = [r for r in order if r.node_id not in used_nodes]
+            if strategy == "SPREAD":
+                candidates = candidates + [r for r in order
+                                           if r.node_id in used_nodes]
+            for r in candidates:
+                if resmath.take(remaining[r.node_id], b):
+                    plan.append((i, r))
+                    used_nodes.add(r.node_id)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    def _pg_summary(self, rec: PlacementGroupRecord) -> Dict[str, Any]:
+        return {
+            "pg_id": rec.pg_id.binary(),
+            "state": rec.state,
+            "strategy": rec.strategy,
+            "bundles": rec.bundles,
+            "placement": {i: (nid.binary(), addr)
+                          for i, (nid, addr) in rec.placement.items()},
+        }
+
+    def get_placement_group(self, pg_id_bytes: bytes) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._pgs.get(PlacementGroupID(pg_id_bytes))
+            return self._pg_summary(rec) if rec else None
+
+    def remove_placement_group(self, pg_id_bytes: bytes) -> None:
+        with self._lock:
+            rec = self._pgs.pop(PlacementGroupID(pg_id_bytes), None)
+        if rec is None:
+            return
+        for idx, (node_id, addr) in rec.placement.items():
+            try:
+                self._clients.get(addr).call("release_bundle", pg_id_bytes, idx)
+            except Exception:
+                pass
+            with self._lock:
+                node_rec = self._nodes.get(node_id)
+                if node_rec is not None:
+                    resmath.credit(node_rec.available, rec.bundles[idx])
+
+    # ----------------------------------------------------------- control
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._clients.close_all()
+        self._server.stop()

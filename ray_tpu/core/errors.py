@@ -1,0 +1,92 @@
+"""Exception hierarchy for the runtime.
+
+Analogue of the reference's ``python/ray/exceptions.py``: user-visible errors
+raised by ``get``/``remote``/actor calls. Errors that occur inside a remote
+task are captured, pickled, and re-raised at the caller wrapped in
+``TaskError`` so the original traceback is preserved as text.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception.
+
+    Stored in the object store in place of the task's return value; re-raised
+    on ``get`` (reference: ``RayTaskError`` in ``python/ray/exceptions.py``).
+    """
+
+    def __init__(self, cause: BaseException, task_desc: str = "", tb: str = ""):
+        self.cause = cause
+        self.task_desc = task_desc
+        if tb:
+            self.tb = tb
+        elif isinstance(cause, BaseException):
+            self.tb = "".join(traceback.format_exception(
+                type(cause), cause, cause.__traceback__))
+        else:
+            self.tb = str(cause)
+        super().__init__(f"Task {task_desc} failed:\n{self.tb}")
+
+    def __reduce__(self):
+        # The cause itself may be unpicklable (or carry an unpicklable
+        # traceback); ship a picklable surrogate plus the formatted text.
+        cause = self.cause
+        try:
+            import pickle
+
+            pickle.dumps(cause)
+        except Exception:
+            cause = RayTpuError(repr(self.cause))
+        return (TaskError, (cause, self.task_desc, self.tb))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing a task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """An actor is dead; pending and future calls fail with this."""
+
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} is dead. {reason}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object could not be found or reconstructed."""
+
+
+class ObjectFreedError(RayTpuError):
+    """The object was explicitly freed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(ref, timeout=...)`` timed out."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Setting up a runtime environment for a task/actor failed."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting a task/object died."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor max_pending_calls exceeded."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """Object store is out of memory and eviction could not make room."""

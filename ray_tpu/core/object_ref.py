@@ -1,0 +1,76 @@
+"""ObjectRef: a future for a value in the distributed object store.
+
+Analogue of the reference's ``ObjectRef`` (``python/ray/includes/object_ref.pxi``)
+with the load-bearing architectural invariant preserved: **ownership**
+(reference: SURVEY §1 — the worker that creates a ref by ``.remote()`` or
+``put()`` is its owner; it stores the value or knows where it is, and serves
+location/value queries). A deserialized ref therefore carries the owner's RPC
+address so any process can resolve it without a central directory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+
+Addr = Tuple[str, int]
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "_weakly_referenced", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_addr: Optional[Addr] = None):
+        self.id = object_id
+        self.owner_addr = tuple(owner_addr) if owner_addr else None
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.owner_addr))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to get(self)."""
+        from concurrent.futures import Future
+        import threading
+
+        from ray_tpu.core import api
+
+        fut: Future = Future()
+
+        def _resolve():
+            try:
+                fut.set_result(api.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        """Allow ``await ref`` inside async actors (reference:
+        ``ObjectRef.__await__`` in ``object_ref.pxi``)."""
+        import asyncio
+
+        return asyncio.wrap_future(
+            asyncio.get_event_loop().run_in_executor(None, _blocking_get, self)
+        ).__await__()
+
+
+def _blocking_get(ref: "ObjectRef"):
+    from ray_tpu.core import api
+
+    return api.get(ref)

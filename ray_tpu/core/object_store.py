@@ -1,0 +1,143 @@
+"""Owner-side in-process object store.
+
+Analogue of the reference's ``CoreWorkerMemoryStore``
+(``src/ray/core_worker/store_provider/memory_store/memory_store.h:43``): every
+process holds the values it owns (task returns, ``put`` objects) — or, for
+values that landed in the node's shared-memory store, a locator — and serves
+them to remote borrowers over its RPC server. Entries are created *pending*
+at task-submission time and fulfilled when the task replies, so ``get`` is a
+wait on an event, and remote processes can long-poll the owner.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.errors import ObjectFreedError, GetTimeoutError
+from ray_tpu.core.ids import ObjectID
+
+
+class _Entry:
+    __slots__ = ("event", "data", "shm_ref", "shm_view", "error", "freed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.data: Optional[bytes] = None      # serialized frame (inline path)
+        self.shm_ref = None                    # shm locator dict (shm path)
+        self.shm_view = None                   # pinned local ShmView, if open
+        self.error: Optional[BaseException] = None  # submission-level failure
+        self.freed = False
+
+
+class MemoryStore:
+    def __init__(self):
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._lock = threading.Lock()
+
+    def _entry(self, oid: ObjectID) -> _Entry:
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None:
+                entry = _Entry()
+                self._entries[oid] = entry
+            return entry
+
+    def create_pending(self, oid: ObjectID) -> None:
+        self._entry(oid)
+
+    def put_serialized(self, oid: ObjectID, data: bytes) -> None:
+        entry = self._entry(oid)
+        entry.data = data
+        entry.event.set()
+
+    def put_shm(self, oid: ObjectID, shm_ref) -> None:
+        entry = self._entry(oid)
+        entry.shm_ref = shm_ref
+        entry.event.set()
+
+    def put_error(self, oid: ObjectID, error: BaseException) -> None:
+        entry = self._entry(oid)
+        entry.error = error
+        entry.event.set()
+
+    def is_ready(self, oid: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(oid)
+        return entry is not None and entry.event.is_set()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._entries
+
+    def wait_ready(self, oid: ObjectID, timeout: Optional[float]) -> _Entry:
+        entry = self._entry(oid)
+        if not entry.event.wait(timeout):
+            raise GetTimeoutError(
+                f"Object {oid.hex()} not ready within {timeout}s")
+        if entry.freed:
+            raise ObjectFreedError(f"Object {oid.hex()} was freed")
+        if entry.error is not None:
+            raise entry.error
+        return entry
+
+    def put_shm_ref(self, oid: ObjectID, shm_ref: dict) -> None:
+        entry = self._entry(oid)
+        entry.shm_ref = shm_ref
+        entry.event.set()
+
+    def free(self, oid: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None:
+                return
+            entry.data = None
+            entry.shm_ref = None
+            if entry.shm_view is not None:
+                entry.shm_view.release()
+                entry.shm_view = None
+            entry.freed = True
+            entry.event.set()
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._entries.pop(oid, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def wait_any(
+    store: MemoryStore,
+    oids,
+    num_ready: int,
+    timeout: Optional[float],
+    poll=None,
+):
+    """Block until ``num_ready`` of ``oids`` are ready locally (or ``poll``
+    reports them ready remotely). Returns (ready, not_ready) preserving order.
+    Used by ``api.wait`` (reference: ``CoreWorker::Wait``, core_worker.h:804).
+    """
+    deadline = None if timeout is None else time.monotonic() + timeout
+    interval = 0.005
+    while True:
+        ready = []
+        not_ready = []
+        for oid in oids:
+            if store.is_ready(oid) or (poll is not None and poll(oid)):
+                ready.append(oid)
+            else:
+                not_ready.append(oid)
+        done = len(ready) >= num_ready or not not_ready
+        if not done and deadline is not None and time.monotonic() >= deadline:
+            done = True
+        if done:
+            # Reference semantics (CoreWorker::Wait): the ready list holds at
+            # most num_ready entries; both lists preserve input order.
+            chosen = set(ready[:num_ready])
+            return ([o for o in oids if o in chosen],
+                    [o for o in oids if o not in chosen])
+        time.sleep(interval)
+        interval = min(interval * 1.5, 0.05)

@@ -1,0 +1,92 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+Analogue of the reference's ``python/ray/util/placement_group.py`` API over
+the GCS-side 2PC scheduler (``gcs_placement_group_scheduler.h``). Strategies
+PACK / SPREAD / STRICT_PACK / STRICT_SPREAD match ``common.proto:937-944``.
+On TPU, a placement group is the gang-scheduling primitive: a pod slice is
+reserved as one STRICT_SPREAD group with a bundle per TPU-VM host (see
+``ray_tpu.tpu.slice_placement_group``), generalizing the reference's
+``TPU-{pod_type}-head`` resource hack (``_private/accelerators/tpu.py:381``)
+into a scheduler-native mechanism.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.errors import RayTpuError
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.runtime import get_core_worker
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: List[Dict[str, float]], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        """Block until all bundles are reserved (reference: ``pg.ready()``)."""
+        core = get_core_worker()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = core.controller.call("get_placement_group", self.id.binary())
+            if info is not None and info["state"] == "CREATED":
+                return True
+            # Retry the 2PC reservation (capacity may have freed up).
+            info = core.controller.call(
+                "create_placement_group", self.id.binary(), self.bundles,
+                self.strategy)
+            if info.get("state") == "CREATED":
+                return True
+            time.sleep(0.2)
+        return False
+
+    def bundle_node(self, index: int):
+        """Return (node_id_bytes, node_addr) hosting bundle ``index``."""
+        core = get_core_worker()
+        info = core.controller.call("get_placement_group", self.id.binary())
+        if info is None or index not in info["placement"]:
+            raise RayTpuError(f"bundle {index} of pg {self.id.hex()} not placed")
+        return info["placement"][index]
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    core = get_core_worker()
+    pg_id = PlacementGroupID.from_random()
+    core.controller.call("create_placement_group", pg_id.binary(),
+                         [dict(b) for b in bundles], strategy)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    core = get_core_worker()
+    core.controller.call("remove_placement_group", pg.id.binary())
+
+
+class PlacementGroupSchedulingStrategy:
+    """Pin a task/actor to a bundle of a placement group (reference:
+    ``util/scheduling_strategies.py``)."""
+
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = 0):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft

@@ -1,0 +1,40 @@
+"""Resource-set arithmetic shared by controller and node.
+
+Analogue of the reference's resource model (``src/ray/common/scheduling/
+resource_instance_set.h`` + ``fixed_point.h``): the reference uses fixed-point
+integers to avoid float drift; here a single epsilon-tolerant helper set keeps
+controller and node feasibility decisions consistent (one definition, not
+four).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+EPS = 1e-9
+
+
+def fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
+    """True if ``req`` fits in ``avail`` (missing keys = 0)."""
+    return all(avail.get(k, 0.0) + EPS >= v for k, v in req.items())
+
+
+def take(avail: Dict[str, float], req: Dict[str, float]) -> bool:
+    """Atomically deduct ``req`` from ``avail`` if it fits. Caller holds the
+    lock protecting ``avail``."""
+    if not fits(avail, req):
+        return False
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+    return True
+
+
+def deduct(avail: Dict[str, float], req: Dict[str, float]) -> None:
+    """Deduct without a feasibility check (optimistic accounting)."""
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def credit(avail: Dict[str, float], req: Dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v

@@ -1,0 +1,345 @@
+"""RPC transport: length-prefixed pickle frames over localhost TCP.
+
+Fills the role of the reference's gRPC layer (``src/ray/rpc/grpc_server.h``,
+``grpc_client.h``) for every process boundary in the runtime: driver <->
+controller, node <-> controller, owner <-> worker (task push), worker <->
+node. The wire format is deliberately minimal — an 8-byte big-endian length
+prefix followed by a pickled message dict — because on a TPU VM every hop is
+localhost or DCN-with-TLS-terminated-elsewhere; there is no cross-language
+requirement (the reference needs protobuf for its Java/C++ frontends).
+
+Concurrency model: ``RpcServer`` runs one accept thread, one reader thread per
+connection, and dispatches each request to a shared thread pool so a blocking
+handler (e.g. task execution) never head-of-line-blocks control messages on
+the same connection. ``RpcClient`` multiplexes concurrent in-flight calls over
+one socket with a response-reader thread, mirroring the async client-call
+pattern of ``src/ray/rpc/client_call.h``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.core.config import config
+
+Addr = Tuple[str, int]
+
+_LEN = struct.Struct(">Q")
+
+
+def dumps(obj: Any) -> bytes:
+    """Pickle with cloudpickle fallback for closures/lambdas/local classes."""
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except Exception:
+        return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = io.BytesIO()
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 4 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf.write(chunk)
+        remaining -= len(chunk)
+    return buf.getvalue()
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    header = recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return recv_exact(sock, length)
+
+
+class RpcError(Exception):
+    """Transport-level failure (peer died, connection refused)."""
+
+
+class RemoteCallError(Exception):
+    """The handler on the peer raised; carries the remote exception."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(repr(cause))
+
+
+class RpcServer:
+    """Threaded request/response server.
+
+    ``handlers`` maps method name -> callable(*args, **kwargs). Handlers run
+    on a thread pool; their return value (or raised exception) is shipped back
+    to the caller. A request with ``id is None`` is a one-way notification.
+    """
+
+    def __init__(
+        self,
+        handlers: Dict[str, Callable],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        name: str = "rpc",
+        max_workers: int = 64,
+        inline_methods: Optional[set] = None,
+    ):
+        self._handlers = dict(handlers)
+        # Methods run directly on the connection reader thread instead of the
+        # shared pool. Use for quick, never-blocking handlers that must make
+        # progress even when the pool is saturated with blocking calls (e.g.
+        # a node's return_worker while many lease_worker calls wait).
+        self._inline = set(inline_methods or ())
+        self._name = name
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(256)
+        self.addr: Addr = self._sock.getsockname()
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix=f"{name}-h")
+        self._stopped = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept", daemon=True)
+        self._accept_thread.start()
+
+    def register(self, method: str, fn: Callable) -> None:
+        self._handlers[method] = fn
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name=f"{self._name}-conn", daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                frame = recv_frame(conn)
+                msg = loads(frame)
+                if msg.get("method") in self._inline:
+                    self._handle(conn, send_lock, msg)
+                else:
+                    self._pool.submit(self._handle, conn, send_lock, msg)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn, send_lock, msg) -> None:
+        req_id = msg.get("id")
+        try:
+            handler = self._handlers[msg["method"]]
+            result = handler(*msg.get("args", ()), **msg.get("kwargs", {}))
+            reply = {"id": req_id, "ok": True, "result": result}
+        except BaseException as e:  # noqa: BLE001 — errors must reach the caller
+            reply = {"id": req_id, "ok": False, "error": e}
+        if req_id is None:
+            return
+        try:
+            payload = dumps(reply)
+        except Exception as e:
+            payload = dumps({"id": req_id, "ok": False,
+                             "error": RpcError(f"unpicklable reply: {e!r}")})
+        try:
+            with send_lock:
+                send_frame(conn, payload)
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            for c in self._conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        self._pool.shutdown(wait=False)
+
+
+class RpcClient:
+    """Client multiplexing concurrent calls over one TCP connection."""
+
+    def __init__(self, addr: Addr, connect_timeout: Optional[float] = None):
+        self.addr = tuple(addr)
+        self._sock = _connect(self.addr, connect_timeout)
+        self._send_lock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._pending: Dict[int, _PendingCall] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="rpc-client-read", daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = loads(recv_frame(self._sock))
+                with self._pending_lock:
+                    call = self._pending.pop(msg["id"], None)
+                if call is not None:
+                    call.complete(msg)
+        except (ConnectionError, OSError):
+            self._fail_all(RpcError(f"connection to {self.addr} lost"))
+
+    def _fail_all(self, err: Exception) -> None:
+        self._closed = True
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for call in pending.values():
+            call.fail(err)
+
+    def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        if self._closed:
+            raise RpcError(f"client to {self.addr} is closed")
+        with self._id_lock:
+            self._next_id += 1
+            req_id = self._next_id
+        call = _PendingCall()
+        with self._pending_lock:
+            self._pending[req_id] = call
+        payload = dumps({"id": req_id, "method": method,
+                         "args": args, "kwargs": kwargs})
+        try:
+            with self._send_lock:
+                send_frame(self._sock, payload)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            self._fail_all(RpcError(f"send to {self.addr} failed: {e}"))
+            raise RpcError(f"send to {self.addr} failed: {e}") from e
+        try:
+            return call.wait(timeout)
+        except TimeoutError:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise
+
+    def notify(self, method: str, *args, **kwargs) -> None:
+        """Fire-and-forget one-way message."""
+        payload = dumps({"id": None, "method": method,
+                         "args": args, "kwargs": kwargs})
+        try:
+            with self._send_lock:
+                send_frame(self._sock, payload)
+        except OSError as e:
+            raise RpcError(f"send to {self.addr} failed: {e}") from e
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PendingCall:
+    __slots__ = ("_event", "_msg", "_err")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._msg = None
+        self._err = None
+
+    def complete(self, msg) -> None:
+        self._msg = msg
+        self._event.set()
+
+    def fail(self, err: Exception) -> None:
+        self._err = err
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]):
+        if not self._event.wait(timeout):
+            raise TimeoutError("RPC call timed out")
+        if self._err is not None:
+            raise self._err
+        if not self._msg["ok"]:
+            err = self._msg["error"]
+            raise RemoteCallError(err) from err
+        return self._msg["result"]
+
+
+def _connect(addr: Addr, timeout: Optional[float]) -> socket.socket:
+    retries = config.rpc_connect_retries
+    deadline = None if timeout is None else time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    for _ in range(max(1, retries)):
+        try:
+            sock = socket.create_connection(addr, timeout=5.0)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except OSError as e:
+            last_err = e
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+    raise RpcError(f"could not connect to {addr}: {last_err}")
+
+
+class ClientPool:
+    """Caches one RpcClient per address; thread-safe.
+
+    Mirrors the reference's per-address gRPC client caching in the core worker
+    (``core_worker_client_pool.h``).
+    """
+
+    def __init__(self):
+        self._clients: Dict[Addr, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: Addr) -> RpcClient:
+        addr = tuple(addr)
+        with self._lock:
+            client = self._clients.get(addr)
+            if client is None or client._closed:
+                client = RpcClient(addr)
+                self._clients[addr] = client
+            return client
+
+    def invalidate(self, addr: Addr) -> None:
+        with self._lock:
+            client = self._clients.pop(tuple(addr), None)
+        if client is not None:
+            client.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()

@@ -1,0 +1,741 @@
+"""CoreWorker: the per-process runtime embedded in every driver and worker.
+
+Analogue of the reference's core worker (``src/ray/core_worker/core_worker.h:295``)
+— the single most load-bearing component. Every process (driver or worker)
+embeds one: it owns the in-process object store, serves owned objects to
+borrowers, submits tasks (normal + actor) with owner-side dependency
+resolution, and executes pushed tasks.
+
+Key protocol decisions mirrored from the reference:
+
+* **Ownership** — the submitting process owns task returns and ``put``
+  objects; return values flow back to the owner and are served from its
+  store (``task_manager.h:208``, ``memory_store.h:43``).
+* **Lease-based direct transport** — the submitter resolves dependencies
+  *first* (``dependency_resolver.h`` — this ordering is what prevents the
+  classic hold-a-worker-while-waiting-for-deps deadlock), then asks the
+  cluster scheduler for a node, leases a worker from that node's pool, and
+  pushes the task spec directly owner->worker
+  (``direct_task_transport.h:75``).
+* **Ordered actor calls** — per-caller sequence numbers; the actor executes
+  calls from each caller in submission order unless ``max_concurrency > 1``
+  or the actor is async (``direct_actor_task_submitter.h:74``,
+  ``ActorSchedulingQueue``).
+* **Task retries** — owner-side retry on worker crash
+  (``task_manager.h:269`` RetryTaskIfPossible).
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.errors import (
+    ActorDiedError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import MemoryStore, wait_any
+from ray_tpu.core.rpc import ClientPool, RemoteCallError, RpcError, RpcServer
+
+Addr = Tuple[str, int]
+
+_core_worker: Optional["CoreWorker"] = None
+_core_worker_lock = threading.Lock()
+
+# How long an actor's ordered queue waits for a missing sequence number
+# before treating it as skipped (see ActorExecutionRuntime._run_ordered).
+_GAP_WAIT_S = 30.0
+
+
+def get_core_worker() -> "CoreWorker":
+    if _core_worker is None:
+        raise RayTpuError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first.")
+    return _core_worker
+
+
+def set_core_worker(worker: Optional["CoreWorker"]) -> None:
+    global _core_worker
+    with _core_worker_lock:
+        _core_worker = worker
+
+
+def is_initialized() -> bool:
+    return _core_worker is not None
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        controller_addr: Addr,
+        node_addr: Addr,
+        node_id: NodeID,
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.mode = mode
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id
+        self.node_addr = tuple(node_addr)
+        self.controller_addr = tuple(controller_addr)
+
+        self.store = MemoryStore()
+        self.clients = ClientPool()
+        self.controller = self.clients.get(controller_addr)
+        # Lazily opened shared-memory stores: our own node's (for writes) and
+        # any local store we read from. {path: ShmStore}
+        self._shm_stores: Dict[str, Any] = {}
+        self._shm_lock = threading.Lock()
+        self._fn_cache: Dict[str, Callable] = {}
+        self._fn_cache_lock = threading.Lock()
+        self._actor_runtime: Optional[ActorExecutionRuntime] = None
+        self._current_task_desc = threading.local()
+        self._shutdown = threading.Event()
+
+        self.server = RpcServer(
+            handlers={
+                "get_object": self._handle_get_object,
+                "wait_object": self._handle_wait_object,
+                "peek_object": self._handle_peek_object,
+                "free_object": self._handle_free_object,
+                "push_task": self._handle_push_task,
+                "start_actor": self._handle_start_actor,
+                "push_actor_task": self._handle_push_actor_task,
+                "shutdown_worker": self._handle_shutdown,
+                "ping": lambda: "pong",
+            },
+            name=f"{mode}-core",
+            max_workers=128,
+            inline_methods={"peek_object", "free_object"},
+        )
+        self.addr: Addr = self.server.addr
+        self.submitter = TaskSubmitter(self)
+
+    # -------------------------------------------------- shared-memory store
+
+    def _open_shm(self, path: str):
+        with self._shm_lock:
+            store = self._shm_stores.get(path)
+            if store is None:
+                from ray_tpu._native.objstore import ShmStore
+
+                store = ShmStore(path)
+                self._shm_stores[path] = store
+            return store
+
+    def _shm_locator(self, oid: ObjectID) -> Dict[str, Any]:
+        from ray_tpu.core.node import shm_store_path
+
+        return {
+            "path": shm_store_path(self.node_id),
+            "node_id": self.node_id.binary(),
+            "node_addr": self.node_addr,
+            "oid": oid.binary(),
+        }
+
+    def _try_put_shm(self, oid: ObjectID, frame: bytes) -> Optional[Dict]:
+        """Write a serialized frame into this node's store; returns the
+        locator, or None when the store is unavailable/full (caller falls
+        back to the inline path)."""
+        try:
+            from ray_tpu.core.node import shm_store_path
+
+            store = self._open_shm(shm_store_path(self.node_id))
+            if store.put_bytes(oid.binary(), frame):
+                return self._shm_locator(oid)
+        except OSError:
+            pass
+        return None
+
+    def _resolve_shm(self, locator: Dict[str, Any], cache_oid: ObjectID):
+        """Resolve a locator to a frame buffer. Local node: a pinned
+        zero-copy view (pin held by the store entry until freed — this is the
+        'primary copy pinned' discipline that keeps numpy views into the
+        mmap valid). Remote node: fetch bytes via the node's object server."""
+        if locator["node_id"] == self.node_id.binary():
+            store = self._open_shm(locator["path"])
+            view = store.get_view(locator["oid"])
+            if view is None:
+                raise ObjectLostError(
+                    f"object {cache_oid.hex()} evicted from the local store")
+            entry = self.store._entry(cache_oid)
+            entry.shm_view = view
+            # Read-only: sealed objects are immutable (plasma semantics);
+            # numpy arrays deserialized over this buffer are zero-copy views
+            # and must not scribble on the shared mapping.
+            return view.data.toreadonly()
+        node_client = self.clients.get(tuple(locator["node_addr"]))
+        payload = node_client.call("read_shm_object", locator["oid"])
+        if payload is None:
+            raise ObjectLostError(
+                f"object {cache_oid.hex()} evicted from remote store")
+        self.store.put_serialized(cache_oid, payload)
+        return payload
+
+    # ------------------------------------------------------------ put/get
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        frame = serialization.serialize(value)
+        if len(frame) > config.inline_object_max_bytes:
+            locator = self._try_put_shm(oid, frame)
+            if locator is not None:
+                self.store.put_shm_ref(oid, locator)
+                return ObjectRef(oid, self.addr)
+        self.store.put_serialized(oid, frame)
+        return ObjectRef(oid, self.addr)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list: List[ObjectRef] = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        if len(ref_list) > 1:
+            pool = self._io_pool()
+            values = list(pool.map(
+                lambda r: self._get_one(r, timeout), ref_list))
+        else:
+            values = [self._get_one(r, timeout) for r in ref_list]
+        return values[0] if single else values
+
+    _io_pool_inst: Optional[ThreadPoolExecutor] = None
+    _io_pool_lock = threading.Lock()
+
+    def _io_pool(self) -> ThreadPoolExecutor:
+        with self._io_pool_lock:
+            if self._io_pool_inst is None:
+                self._io_pool_inst = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="core-io")
+            return self._io_pool_inst
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
+        frame = self._get_frame(ref, timeout)
+        value = serialization.deserialize(frame)
+        if isinstance(value, TaskError):
+            raise value
+        return value
+
+    def _get_frame(self, ref: ObjectRef, timeout: Optional[float]):
+        """Fetch the serialized frame for ``ref``: local store (zero-copy shm
+        view when the value lives in this node's store) or owner pull."""
+        if self.store.contains(ref.id) or ref.owner_addr in (None, self.addr):
+            entry = self.store.wait_ready(ref.id, timeout)
+            if entry.data is not None:
+                return entry.data
+            if entry.shm_ref is not None:
+                return self._resolve_shm(entry.shm_ref, ref.id)
+            raise ObjectLostError(f"object {ref.hex()} has no data")
+        # Borrower path: long-poll the owner, then resolve/cache locally.
+        owner = self.clients.get(ref.owner_addr)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 5.0 if deadline is None else min(5.0, deadline - time.monotonic())
+            if step <= 0:
+                from ray_tpu.core.errors import GetTimeoutError
+                raise GetTimeoutError(f"object {ref.hex()} not ready in time")
+            try:
+                result = owner.call("get_object", ref.id.binary(), step,
+                                    timeout=step + 10.0)
+            except RemoteCallError as e:
+                # The owner re-raised a stored error (put_error): surface the
+                # real exception, not the transport wrapper.
+                raise e.cause from None
+            except (RpcError, TimeoutError) as e:
+                raise ObjectLostError(
+                    f"owner of {ref.hex()} at {ref.owner_addr} unreachable: {e}"
+                ) from e
+            if result is None:
+                continue
+            kind, payload = result
+            if kind == "inline":
+                self.store.put_serialized(ref.id, payload)
+                return payload
+            if kind == "shm":
+                self.store.put_shm_ref(ref.id, payload)
+                return self._resolve_shm(payload, ref.id)
+            raise ObjectLostError(f"unknown get_object reply kind {kind!r}")
+
+    def get_serialized(self, ref: ObjectRef, timeout: Optional[float]) -> bytes:
+        """Like _get_frame but always materializes bytes (for RPC shipping)."""
+        frame = self._get_frame(ref, timeout)
+        return frame if isinstance(frame, bytes) else bytes(frame)
+
+    def wait_ready(self, ref: ObjectRef, timeout: Optional[float]) -> None:
+        """Block until ``ref`` is ready, without transferring its value —
+        used by owner-side dependency resolution (dependency_resolver.h
+        resolves availability, not bytes)."""
+        if self.store.contains(ref.id) or ref.owner_addr in (None, self.addr):
+            self.store.wait_ready(ref.id, timeout)
+            return
+        owner = self.clients.get(ref.owner_addr)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 5.0 if deadline is None else min(5.0, deadline - time.monotonic())
+            if step <= 0:
+                from ray_tpu.core.errors import GetTimeoutError
+
+                raise GetTimeoutError(f"object {ref.hex()} not ready in time")
+            try:
+                if owner.call("wait_object", ref.id.binary(), step,
+                              timeout=step + 10.0):
+                    return
+            except RemoteCallError as e:
+                raise e.cause from None
+            except (RpcError, TimeoutError) as e:
+                raise ObjectLostError(
+                    f"owner of {ref.hex()} at {ref.owner_addr} unreachable: {e}"
+                ) from e
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        ids = [r.id for r in refs]
+        by_id = {r.id: r for r in refs}
+
+        def poll(oid: ObjectID) -> bool:
+            ref = by_id[oid]
+            if ref.owner_addr in (None, self.addr):
+                return False
+            try:
+                ready = self.clients.get(ref.owner_addr).call(
+                    "peek_object", oid.binary(), timeout=5.0)
+            except (RpcError, TimeoutError):
+                return False
+            return bool(ready)
+
+        ready_ids, pending_ids = wait_any(
+            self.store, ids, num_returns, timeout, poll=poll)
+        return ([by_id[i] for i in ready_ids], [by_id[i] for i in pending_ids])
+
+    # ------------------------------------------------- owned-object server
+
+    def _handle_get_object(self, oid_bytes: bytes, timeout: float):
+        """Long-poll: returns ("inline", frame) / ("shm", locator), or None
+        on timeout. Owners hand out shm locators rather than bytes so the
+        borrower can read node-locally (owner-based object directory,
+        ownership_based_object_directory.h)."""
+        oid = ObjectID(oid_bytes)
+        try:
+            entry = self.store.wait_ready(oid, timeout)
+        except Exception as e:
+            from ray_tpu.core.errors import GetTimeoutError
+            if isinstance(e, GetTimeoutError):
+                return None
+            raise
+        if entry.shm_ref is not None:
+            return ("shm", entry.shm_ref)
+        if entry.data is None:
+            raise ObjectLostError(f"object {oid.hex()} has no data")
+        return ("inline", entry.data)
+
+    def _handle_wait_object(self, oid_bytes: bytes, timeout: float) -> bool:
+        try:
+            self.store.wait_ready(ObjectID(oid_bytes), timeout)
+            return True
+        except Exception as e:
+            from ray_tpu.core.errors import GetTimeoutError
+            if isinstance(e, GetTimeoutError):
+                return False
+            return True  # ready-with-error counts as ready
+
+    def _handle_peek_object(self, oid_bytes: bytes) -> bool:
+        return self.store.is_ready(ObjectID(oid_bytes))
+
+    def _handle_free_object(self, oid_bytes: bytes) -> None:
+        self.store.free(ObjectID(oid_bytes))
+
+    # -------------------------------------------------- task submission
+
+    def submit_task(self, func_key: str, desc: str,
+                    args: tuple, kwargs: dict, options: Dict[str, Any]
+                    ) -> List[ObjectRef]:
+        task_id = TaskID.from_random()
+        num_returns = options.get("num_returns", 1)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        refs = [ObjectRef(oid, self.addr) for oid in return_ids]
+        for oid in return_ids:
+            self.store.create_pending(oid)
+        arg_refs = _collect_top_level_refs(args, kwargs)
+        # Function body travels via the controller KV (exported once per
+        # cluster, fetched once per worker) — not with every task spec.
+        spec = {
+            "task_id": task_id.binary(),
+            "func_key": func_key,
+            "desc": desc,
+            "args_blob": serialization.serialize((args, kwargs)),
+            "return_ids": [o.binary() for o in return_ids],
+            "owner_addr": self.addr,
+        }
+        self.submitter.submit(spec, options, return_ids, arg_refs)
+        return refs
+
+    # ---------------------------------------------------- task execution
+
+    def _load_function(self, func_key: str, func_blob: Optional[bytes]):
+        with self._fn_cache_lock:
+            fn = self._fn_cache.get(func_key)
+        if fn is not None:
+            return fn
+        if func_blob is None:
+            func_blob = self.controller.call("kv_get", func_key)
+            if func_blob is None:
+                raise RayTpuError(f"function {func_key} not found in KV")
+        fn = serialization.loads_function(func_blob)
+        with self._fn_cache_lock:
+            self._fn_cache[func_key] = fn
+        return fn
+
+    def _resolve_args(self, args_blob: bytes):
+        args, kwargs = serialization.deserialize(args_blob)
+        args = tuple(
+            self._get_one(a, None) if isinstance(a, ObjectRef) else a
+            for a in args)
+        kwargs = {
+            k: self._get_one(v, None) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _handle_push_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """Execute a normal task; reply carries serialized results.
+
+        Reference: the PushTask execution path in ``_raylet.pyx:2259``
+        (task_execution_handler) minus the Cython; results return in-band to
+        the owner (reference inlines <100KB returns the same way)."""
+        try:
+            fn = self._load_function(spec["func_key"], spec.get("func_blob"))
+            args, kwargs = self._resolve_args(spec["args_blob"])
+            self._current_task_desc.value = spec.get("desc", "")
+            result = fn(*args, **kwargs)
+            n = len(spec["return_ids"])
+            if n == 0:
+                results = []
+            elif n == 1:
+                results = [result]
+            else:
+                result = tuple(result)
+                if len(result) != n:
+                    raise ValueError(
+                        f"task {spec['desc']} declared num_returns={n} but "
+                        f"returned {len(result)} values")
+                results = list(result)
+            return {"ok": True, "results": self._pack_results(results)}
+        except BaseException as e:  # noqa: BLE001 — shipped to the owner
+            err = TaskError(e, task_desc=spec.get("desc", ""))
+            return {"ok": False,
+                    "error_frame": serialization.serialize(err)}
+        finally:
+            self._current_task_desc.value = None
+
+    def _pack_results(self, results: List[Any]) -> List[tuple]:
+        """Serialize task returns; large frames go into this node's shm store
+        and ship as locators (reference: small returns in-band to the owner's
+        memory store, large returns plasma-put — core_worker task reply
+        path). Each element is ("inline", bytes) or ("shm", locator)."""
+        packed = []
+        for r in results:
+            frame = serialization.serialize(r)
+            if len(frame) > config.inline_object_max_bytes:
+                oid = ObjectID.from_random()
+                locator = self._try_put_shm(oid, frame)
+                if locator is not None:
+                    packed.append(("shm", locator))
+                    continue
+            packed.append(("inline", frame))
+        return packed
+
+    def fulfil_result(self, oid: ObjectID, packed: tuple) -> None:
+        """Owner-side: record a packed task result."""
+        kind, payload = packed
+        if kind == "shm":
+            self.store.put_shm_ref(oid, payload)
+        else:
+            self.store.put_serialized(oid, payload)
+
+    # -------------------------------------------------------- actor side
+
+    def _handle_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        try:
+            cls = self._load_function(spec["cls_key"], spec.get("cls_blob"))
+            args, kwargs = self._resolve_args(spec["args_blob"])
+            instance = cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, task_desc=f"{spec.get('desc', '')}.__init__")
+            return {"ok": False, "error_frame": serialization.serialize(err)}
+        self._actor_runtime = ActorExecutionRuntime(
+            self, instance,
+            max_concurrency=spec.get("max_concurrency", 1),
+        )
+        return {"ok": True}
+
+    def _handle_push_actor_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        if self._actor_runtime is None:
+            raise ActorDiedError(reason="actor not started on this worker")
+        return self._actor_runtime.execute(spec)
+
+    def _handle_shutdown(self) -> None:
+        self._shutdown.set()
+
+    # --------------------------------------------------------- lifecycle
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self.submitter.stop()
+        self.clients.close_all()
+        self.server.stop()
+
+
+# --------------------------------------------------------------------------
+# Submitter
+# --------------------------------------------------------------------------
+
+
+class TaskSubmitter:
+    """Owner-side async task submitter (reference:
+    ``CoreWorkerDirectTaskSubmitter``, direct_task_transport.h:75)."""
+
+    def __init__(self, core: CoreWorker):
+        self._core = core
+        self._pool = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix="submit")
+        self._stopped = False
+
+    def submit(self, spec, options, return_ids: List[ObjectID],
+               arg_refs: List[ObjectRef]) -> None:
+        self._pool.submit(self._run, spec, options, return_ids, arg_refs)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def _fail(self, return_ids: List[ObjectID], err: BaseException) -> None:
+        for oid in return_ids:
+            self._core.store.put_error(oid, err)
+
+    def _run(self, spec, options, return_ids, arg_refs) -> None:
+        core = self._core
+        try:
+            # 1. Resolve dependencies BEFORE leasing a worker
+            #    (dependency_resolver.h — avoids lease-holding deadlock).
+            #    Readiness only; the executor pulls values itself.
+            for ref in arg_refs:
+                core.wait_ready(ref, None)
+            retries_left = options.get("max_retries", 3)
+            excluded: List[bytes] = []
+            deadline = time.monotonic() + config.worker_lease_timeout_s
+            while True:
+                # 2. Cluster-level node selection.
+                placement = options.get("placement")  # (pg_id_bytes, index)
+                picked_node_id: Optional[bytes] = None
+                if placement is not None:
+                    target = core.controller.call(
+                        "get_placement_group", placement[0])
+                    if target is None or placement[1] not in target["placement"]:
+                        raise RayTpuError(
+                            f"placement group bundle {placement} not ready")
+                    node_addr = target["placement"][placement[1]][1]
+                    bundle = (placement[0], placement[1])
+                else:
+                    pick = core.controller.call(
+                        "pick_node", options.get("resources", {"CPU": 1.0}),
+                        options.get("scheduling_strategy"),
+                        core.node_id.binary(), excluded)
+                    if pick is None:
+                        if time.monotonic() > deadline:
+                            raise RayTpuError(
+                                f"no feasible node for resources "
+                                f"{options.get('resources')}")
+                        time.sleep(0.2)
+                        excluded = []
+                        continue
+                    node_addr = pick["addr"]
+                    picked_node_id = pick["node_id"]
+                    bundle = None
+                # 3. Worker lease from the chosen node. Transport errors
+                #    (node died between pick and lease) count as lease
+                #    failures: exclude the node and re-pick.
+                try:
+                    node_client = core.clients.get(node_addr)
+                    lease = node_client.call(
+                        "lease_worker", options.get("resources", {"CPU": 1.0}),
+                        bundle, None,
+                        timeout=config.worker_lease_timeout_s + 10.0)
+                except (RpcError, RemoteCallError, TimeoutError) as e:
+                    core.clients.invalidate(tuple(node_addr))
+                    lease = {"error": f"node unreachable: {e}"}
+                if "error" in lease:
+                    if picked_node_id is not None:
+                        excluded.append(picked_node_id)
+                    if time.monotonic() > deadline:
+                        raise RayTpuError(f"worker lease failed: {lease['error']}")
+                    continue
+                worker_id, worker_addr = lease["worker_id"], lease["addr"]
+                # 4. Direct push to the leased worker.
+                try:
+                    reply = core.clients.get(worker_addr).call(
+                        "push_task", spec, timeout=None)
+                except (RpcError, RemoteCallError, TimeoutError) as e:
+                    node_client.call("return_worker", worker_id,
+                                     options.get("resources", {"CPU": 1.0}),
+                                     bundle, True)
+                    core.clients.invalidate(worker_addr)
+                    if retries_left > 0 and options.get("retry_on_crash", True):
+                        retries_left -= 1
+                        time.sleep(config.task_retry_delay_ms / 1000.0)
+                        deadline = time.monotonic() + config.worker_lease_timeout_s
+                        continue
+                    raise WorkerCrashedError(
+                        f"worker died executing {spec['desc']}: {e}") from e
+                node_client.call("return_worker", worker_id,
+                                 options.get("resources", {"CPU": 1.0}),
+                                 bundle, False)
+                break
+            # 5. Fulfil owned return objects.
+            if reply["ok"]:
+                for oid, packed in zip(return_ids, reply["results"]):
+                    core.fulfil_result(oid, packed)
+            else:
+                for oid in return_ids:
+                    self._core.store.put_serialized(oid, reply["error_frame"])
+        except BaseException as e:  # noqa: BLE001
+            self._fail(return_ids, e)
+
+
+# --------------------------------------------------------------------------
+# Actor-side execution runtime
+# --------------------------------------------------------------------------
+
+
+class ActorExecutionRuntime:
+    """Executes actor tasks with per-caller ordering.
+
+    Reference: ``ActorSchedulingQueue`` (in-order by sequence number per
+    caller) vs ``OutOfOrderActorSchedulingQueue`` for ``max_concurrency > 1``
+    and async actors (``direct_actor_task_submitter.h``, ``fiber.h``).
+    """
+
+    def __init__(self, core: CoreWorker, instance: Any, max_concurrency: int = 1):
+        self.core = core
+        self.instance = instance
+        self.max_concurrency = max(1, int(max_concurrency))
+        self.is_async = _has_async_methods(instance)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._exec_lock = threading.Lock()  # single-threaded actor body
+        # per-caller ordering state: owner addr -> [next expected seq, heap]
+        self._order: Dict[Addr, List[Any]] = {}
+        if self.is_async:
+            import asyncio
+
+            self._loop = asyncio.new_event_loop()
+            self._loop_thread = threading.Thread(
+                target=self._loop.run_forever, name="actor-asyncio", daemon=True)
+            self._loop_thread.start()
+        elif self.max_concurrency > 1:
+            self._exec_pool = ThreadPoolExecutor(
+                max_workers=self.max_concurrency, thread_name_prefix="actor")
+
+    def execute(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        method_name = spec["method"]
+        desc = spec.get("desc", method_name)
+        try:
+            method = getattr(self.instance, method_name)
+            args, kwargs = self.core._resolve_args(spec["args_blob"])
+            if self.is_async:
+                result = self._run_async(method, args, kwargs)
+            elif self.max_concurrency > 1:
+                result = self._exec_pool.submit(method, *args, **kwargs).result()
+            else:
+                result = self._run_ordered(spec, method, args, kwargs)
+            n = len(spec["return_ids"])
+            if n == 0:
+                results = []
+            elif n == 1:
+                results = [result]
+            else:
+                results = list(tuple(result))
+                if len(results) != n:
+                    raise ValueError(
+                        f"actor method {desc} declared num_returns={n} but "
+                        f"returned {len(results)} values")
+            return {"ok": True, "results": self.core._pack_results(results)}
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, task_desc=desc)
+            return {"ok": False, "error_frame": serialization.serialize(err)}
+
+    def _run_async(self, method, args, kwargs):
+        import asyncio
+        import inspect
+
+        if inspect.iscoroutinefunction(method):
+            fut = asyncio.run_coroutine_threadsafe(
+                method(*args, **kwargs), self._loop)
+            return fut.result()
+        return method(*args, **kwargs)
+
+    def _run_ordered(self, spec, method, args, kwargs):
+        """Execute in per-caller submission order (seq numbers).
+
+        Ordering state is keyed by (caller, epoch) — the epoch is the actor
+        incarnation the caller believed it was talking to, so a restarted
+        actor starts a fresh seq stream per caller. A seq *gap* (an earlier
+        call failed before its push, or the caller's epoch view was stale)
+        would otherwise wait forever; after ``_GAP_WAIT_S`` the queue gives up
+        on the missing seq and proceeds — degraded ordering beats deadlock
+        (the reference bounds this differently: failed submissions send
+        negative acks to the scheduling queue)."""
+        owner = (tuple(spec["owner_addr"]), spec.get("epoch", 0))
+        seq = spec.get("seq")
+        if seq is None:
+            with self._exec_lock:
+                return method(*args, **kwargs)
+        deadline = time.monotonic() + _GAP_WAIT_S
+        with self._cond:
+            state = self._order.setdefault(owner, [0, []])
+            while state[0] < seq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    state[0] = seq  # skip the missing seq(s)
+                    break
+                self._cond.wait(min(remaining, 1.0))
+                state = self._order.setdefault(owner, [0, []])
+        try:
+            with self._exec_lock:
+                return method(*args, **kwargs)
+        finally:
+            with self._cond:
+                state = self._order.setdefault(owner, [0, []])
+                if seq >= state[0]:
+                    state[0] = seq + 1
+                self._cond.notify_all()
+
+
+def _has_async_methods(instance) -> bool:
+    import inspect
+
+    for name in dir(instance):
+        if name.startswith("__"):
+            continue
+        try:
+            attr = getattr(instance, name)
+        except Exception:
+            continue
+        if inspect.iscoroutinefunction(attr):
+            return True
+    return False
+
+
+def _collect_top_level_refs(args: tuple, kwargs: dict) -> List[ObjectRef]:
+    refs = [a for a in args if isinstance(a, ObjectRef)]
+    refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+    return refs

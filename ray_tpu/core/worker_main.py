@@ -1,0 +1,61 @@
+"""Worker process entrypoint.
+
+Analogue of the reference's ``python/ray/_private/workers/default_worker.py``:
+forked by the node supervisor, embeds a CoreWorker, registers its RPC address
+back with the node, and then serves pushed tasks until told to shut down or
+its node disappears (orphan protection — the reference's workers die with
+their raylet too).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-host", required=True)
+    parser.add_argument("--node-port", type=int, required=True)
+    parser.add_argument("--controller-host", required=True)
+    parser.add_argument("--controller-port", type=int, required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+
+    from ray_tpu.core.ids import NodeID, WorkerID
+    from ray_tpu.core.rpc import RpcClient, RpcError
+    from ray_tpu.core.runtime import CoreWorker, set_core_worker
+
+    node_addr = (args.node_host, args.node_port)
+    controller_addr = (args.controller_host, args.controller_port)
+    core = CoreWorker(
+        mode="worker",
+        controller_addr=controller_addr,
+        node_addr=node_addr,
+        node_id=NodeID.from_hex(args.node_id),
+        worker_id=WorkerID.from_hex(args.worker_id),
+    )
+    set_core_worker(core)
+
+    node_client = RpcClient(node_addr)
+    reply = node_client.call("register_worker", core.worker_id.binary(),
+                             core.addr)
+    if "error" in reply:
+        print(f"worker registration failed: {reply}", file=sys.stderr)
+        return 1
+
+    # Serve until shutdown; exit if the node supervisor disappears.
+    while not core._shutdown.is_set():
+        time.sleep(2.0)
+        try:
+            node_client.call("ping", timeout=5.0)
+        except (RpcError, TimeoutError):
+            break
+    core.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

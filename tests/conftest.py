@@ -1,0 +1,52 @@
+"""Test fixtures.
+
+Mirrors the reference's test strategy (SURVEY §4): a real in-process cluster
+per test (``ray_start_regular``) and a multi-node-in-one-machine cluster
+builder (``ray_start_cluster``), plus a virtual 8-device CPU mesh for all
+JAX sharding tests (the reference tests distributed paths with multiple
+raylets on one machine; we additionally test multi-chip SPMD with
+``--xla_force_host_platform_device_count``).
+"""
+
+import os
+
+# Must be set before jax initializes anywhere in the test session (workers
+# inherit this environment too). Forced, not defaulted: the machine may have
+# JAX_PLATFORMS=axon (one real TPU chip) — tests always run on the virtual
+# 8-device CPU mesh; only bench.py touches the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A pytest plugin may import jax before this conftest runs, freezing the
+# env-derived config defaults — update the live config too (backends are
+# still uninitialized at this point, so this takes effect).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    core = ray_tpu.init(num_cpus=4)
+    yield core
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    yield cluster
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster.shutdown()

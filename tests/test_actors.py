@@ -1,0 +1,171 @@
+"""Actor tests (model: reference ``python/ray/tests/test_actor.py``)."""
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+
+@ray_tpu.remote
+class Failing:
+    def __init__(self, fail_init=False):
+        if fail_init:
+            raise RuntimeError("init failed")
+
+    def boom(self):
+        raise RuntimeError("method failed")
+
+    def die(self):
+        import os
+
+        os._exit(1)
+
+
+@ray_tpu.remote
+class AsyncActor:
+    async def double(self, x):
+        import asyncio
+
+        await asyncio.sleep(0.01)
+        return 2 * x
+
+
+def test_actor_basic(ray_start_regular):
+    counter = Counter.remote(10)
+    assert ray_tpu.get(counter.increment.remote()) == 11
+    assert ray_tpu.get(counter.increment.remote(5)) == 16
+    assert ray_tpu.get(counter.get.remote()) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    counter = Counter.remote()
+    refs = [counter.increment.remote() for _ in range(20)]
+    # In-order execution => strictly increasing results.
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_method_error(ray_start_regular):
+    actor = Failing.remote()
+    with pytest.raises(ray_tpu.TaskError, match="method failed"):
+        ray_tpu.get(actor.boom.remote())
+    # Actor survives method errors; a second call still reaches it.
+    with pytest.raises(ray_tpu.TaskError, match="method failed"):
+        ray_tpu.get(actor.boom.remote())
+
+
+def test_actor_init_error(ray_start_regular):
+    actor = Failing.remote(fail_init=True)
+    with pytest.raises(ray_tpu.ActorDiedError, match="init failed"):
+        ray_tpu.get(actor.boom.remote())
+
+
+def test_actor_death_detected(ray_start_regular):
+    actor = Failing.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(actor.boom.remote(), timeout=30)  # actor is up
+    actor.die.remote()
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.TaskError)):
+        ray_tpu.get(actor.boom.remote(), timeout=30)
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(100)
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.get.remote()) == 100
+
+
+def test_kill_actor(ray_start_regular):
+    counter = Counter.remote()
+    assert ray_tpu.get(counter.get.remote()) == 0
+    ray_tpu.kill(counter)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(counter.get.remote())
+
+
+def test_actor_handle_passing(ray_start_regular):
+    counter = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.increment.remote())
+
+    assert ray_tpu.get(bump.remote(counter)) == 1
+    assert ray_tpu.get(counter.get.remote()) == 1
+
+
+def test_async_actor(ray_start_regular):
+    actor = AsyncActor.remote()
+    refs = [actor.double.remote(i) for i in range(5)]
+    assert ray_tpu.get(refs) == [0, 2, 4, 6, 8]
+
+
+def test_max_concurrency(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self):
+            time.sleep(0.5)
+            return 1
+
+    actor = Sleeper.options(max_concurrency=4).remote()
+    ray_tpu.get(actor.nap.remote())  # warm-up: actor worker fork + import
+    start = time.monotonic()
+    ray_tpu.get([actor.nap.remote() for _ in range(4)])
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.5, f"concurrent naps took {elapsed}s (not concurrent)"
+
+
+def test_actor_restart(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def bump(self):
+            self.calls += 1
+            return self.calls
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    actor = Phoenix.options(max_restarts=1).remote()
+    assert ray_tpu.get(actor.bump.remote()) == 1
+    actor.die.remote()
+    # The lost call errors, then the restarted incarnation serves fresh state.
+    deadline = time.monotonic() + 60
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = ray_tpu.get(actor.bump.remote(), timeout=30)
+            break
+        except (ray_tpu.ActorDiedError, ray_tpu.TaskError, Exception):
+            time.sleep(0.5)
+    assert value == 1, f"restarted actor state should reset, got {value}"
+    # Second death exhausts max_restarts=1 -> permanently dead.
+    actor.die.remote()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(actor.bump.remote(), timeout=30)
+            time.sleep(0.5)
+        except Exception:
+            break
+    with pytest.raises((ray_tpu.ActorDiedError, Exception)):
+        ray_tpu.get(actor.bump.remote(), timeout=30)

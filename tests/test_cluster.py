@@ -1,0 +1,151 @@
+"""Multi-node scheduling / placement tests (model: reference
+``python/ray/tests/test_scheduling.py`` + ``test_placement_group.py``,
+using the multiple-nodes-in-one-machine fixture, ``cluster_utils.py:135``)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as core_api
+
+
+@ray_tpu.remote
+def whoami():
+    from ray_tpu.core.runtime import get_core_worker
+
+    return get_core_worker().node_id.hex()
+
+
+@ray_tpu.remote(num_cpus=0, resources={"special": 1})
+def needs_special():
+    return "special"
+
+
+def test_two_nodes_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    refs = [whoami.options(scheduling_strategy="spread").remote()
+            for _ in range(8)]
+    node_ids = set(ray_tpu.get(refs))
+    assert len(node_ids) == 2, f"expected both nodes used, got {node_ids}"
+
+
+def test_custom_resource_routing(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    special = cluster.add_node(num_cpus=1, resources={"special": 2})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    result_node = ray_tpu.get(
+        whoami.options(num_cpus=0, resources={"special": 1}).remote())
+    assert result_node == special.node_id.hex()
+
+
+def test_infeasible_task_errors(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address,
+                 _system_config={"worker_lease_timeout_s": 2.0})
+    with pytest.raises((ray_tpu.RayTpuError, ray_tpu.TaskError)):
+        ray_tpu.get(needs_special.remote(), timeout=30)
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    pg = ray_tpu.placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=10)
+    nodes = {pg.bundle_node(i)[0] for i in range(3)}
+    assert len(nodes) == 3
+
+    # Tasks pinned to bundles land on the bundles' nodes.
+    results = ray_tpu.get([
+        whoami.options(
+            scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i)
+        ).remote()
+        for i in range(3)
+    ])
+    assert set(bytes.fromhex(r) for r in results) == {
+        pg.bundle_node(i)[0] for i in range(3)}
+
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_strict_pack(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    pg = ray_tpu.placement_group([{"CPU": 2}, {"CPU": 2}],
+                                 strategy="STRICT_PACK")
+    assert pg.ready(timeout=10)
+    assert pg.bundle_node(0)[0] == pg.bundle_node(1)[0]
+
+
+def test_placement_group_infeasible_stays_pending(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    pg = ray_tpu.placement_group([{"CPU": 8}], strategy="PACK")
+    assert not pg.ready(timeout=1.0)
+
+
+def test_actor_on_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    target = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    pg = ray_tpu.placement_group(
+        [{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote
+    class NodeReporter:
+        def node(self):
+            from ray_tpu.core.runtime import get_core_worker
+
+            return get_core_worker().node_id.hex()
+
+    actor = NodeReporter.options(
+        num_cpus=1,
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+            placement_group=pg)
+    ).remote()
+    reported = ray_tpu.get(actor.node.remote())
+    assert bytes.fromhex(reported) == pg.bundle_node(0)[0]
+
+
+def test_node_death_detection(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    doomed = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address,
+                 _system_config={"heartbeat_period_s": 0.2,
+                                 "health_check_failure_threshold": 3})
+    import time
+
+    cluster.remove_node(doomed)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if len(alive) == 1:
+            break
+        time.sleep(0.2)
+    assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1

@@ -1,0 +1,129 @@
+"""Core task/object API tests (model: reference ``python/ray/tests/test_basic.py``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def fail():
+    raise ValueError("boom")
+
+
+@ray_tpu.remote(num_returns=2)
+def two_returns():
+    return 1, 2
+
+
+@ray_tpu.remote
+def nested(x):
+    ref = echo.remote(x + 1)
+    return ray_tpu.get(ref)
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_numpy_zero_copy(ray_start_regular):
+    arr = np.arange(1024, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start_regular):
+    a = ray_tpu.put(10)
+    b = add.remote(a, 5)
+    c = add.remote(b, a)
+    assert ray_tpu.get(c) == 25
+
+
+def test_many_tasks(ray_start_regular):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert ray_tpu.get(refs) == [2 * i for i in range(50)]
+
+
+def test_task_error_propagates(ray_start_regular):
+    with pytest.raises(ray_tpu.TaskError) as exc_info:
+        ray_tpu.get(fail.remote())
+    assert "boom" in str(exc_info.value)
+
+
+def test_error_through_dependency(ray_start_regular):
+    bad = fail.remote()
+    downstream = add.remote(bad, 1)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(downstream)
+
+
+def test_multiple_returns(ray_start_regular):
+    r1, r2 = two_returns.remote()
+    assert ray_tpu.get(r1) == 1
+    assert ray_tpu.get(r2) == 2
+
+
+def test_nested_tasks(ray_start_regular):
+    assert ray_tpu.get(nested.remote(1)) == 2
+
+
+def test_wait(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    fast_ref = echo.remote("fast")
+    slow_ref = slow.remote()
+    ready, pending = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                  timeout=10)
+    assert ready == [fast_ref]
+    assert pending == [slow_ref]
+
+
+def test_get_timeout(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_options_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    def three():
+        return 1, 2, 3
+
+    refs = three.options(num_returns=3).remote()
+    assert ray_tpu.get(refs) == [1, 2, 3]
+
+
+def test_cluster_resources(ray_start_regular):
+    assert ray_tpu.cluster_resources().get("CPU") == 4.0
+
+
+def test_large_object_roundtrip(ray_start_regular):
+    arr = np.random.rand(1 << 20)  # 8 MB
+    out = ray_tpu.get(echo.remote(arr))
+    np.testing.assert_array_equal(arr, out)
