@@ -1,0 +1,44 @@
+import dataclasses, time
+import jax, optax
+from ray_tpu.models import llama
+from ray_tpu.parallel import train_step as ts
+from ray_tpu.parallel.mesh import MeshSpec
+from ray_tpu.tpu import peak_flops_per_chip
+
+base = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=16,
+                         n_kv_heads=16, mlp_dim=5120, max_seq_len=2048)
+mesh = MeshSpec(fsdp=-1).build()
+peak = peak_flops_per_chip()
+
+def try_one(cfg, batch, seq=2048, steps=8):
+    try:
+        params = ts.init_sharded_params(lambda k: llama.init_params(cfg, k),
+                                        llama.param_axes(), mesh, jax.random.key(0))
+        opt = optax.adamw(3e-4)
+        opt_state = ts.init_optimizer_state(opt, params)
+        step = ts.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh)
+        batch_data = ts.shard_batch({"tokens": jax.random.randint(
+            jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)}, mesh)
+        params, opt_state, m = step(params, opt_state, batch_data)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, batch_data)
+        jax.block_until_ready(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        del params, opt_state, batch_data
+        tps = batch * seq / dt
+        mfu = 100 * tps * llama.flops_per_token(cfg, seq) / peak
+        return round(mfu, 2), round(tps)
+    except Exception as e:
+        return None, str(type(e).__name__)
+
+chunkattn = dataclasses.replace(base, loss_chunk=512, attention_impl="chunked")
+for desc, cfg, batch in [
+    ("chunkattn+CE b8", chunkattn, 8),
+    ("chunkattn+CE b16", chunkattn, 16),
+    ("chunkattn+CE b12", chunkattn, 12),
+    ("xla+CE b6", dataclasses.replace(base, loss_chunk=512), 6),
+]:
+    mfu, tps = try_one(cfg, batch)
+    print(f"{desc:22s} -> MFU {mfu} ({tps})", flush=True)

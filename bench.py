@@ -1,0 +1,159 @@
+"""Headline benchmark: Llama train-step MFU on the local TPU chip(s).
+
+Run by the driver on real hardware at the end of every round. Prints ONE
+JSON line:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+The metric is model FLOPs utilization of a realistic training step (fwd +
+bwd + adamw update, bf16 compute / fp32 master params, remat) on the
+flagship Llama architecture, sized to the attached chip count. vs_baseline
+is MFU / 40% — the BASELINE.md north-star target (Llama-2-7B >= 40% MFU on
+v5e; on fewer chips we bench the largest preset that trains in HBM, which
+is the same architecture and kernel mix).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def pick_config(n_devices: int, hbm_bytes: int):
+    """Largest bench preset that fits params+adam(fp32)+activations."""
+    from ray_tpu.models import llama
+
+    # Rough budget: 12 bytes/param (fp32 master + adam mu/nu) + activations.
+    candidates = [
+        ("1b", llama.PRESETS["1b"]),
+        ("bench600m", llama.LlamaConfig(
+            vocab_size=32000, dim=1280, n_layers=24, n_heads=16,
+            n_kv_heads=16, mlp_dim=5120, max_seq_len=2048)),
+        ("bench400m", llama.LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
+            n_kv_heads=16, mlp_dim=4096, max_seq_len=2048)),
+        ("160m", llama.PRESETS["160m"]),
+        ("debug", llama.PRESETS["debug"]),
+    ]
+    budget = n_devices * hbm_bytes * 0.55  # leave room for activations/XLA
+    for name, cfg in candidates:
+        if cfg.num_params() * 12 <= budget:
+            return name, cfg
+    return candidates[-1]
+
+
+def main() -> None:
+    import dataclasses
+
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.tpu import peak_flops_per_chip
+
+    devices = jax.devices()
+    n = len(devices)
+    kind = getattr(devices[0], "device_kind", "unknown")
+    hbm = 16 << 30  # v5e-class default; overridable
+    if os.environ.get("RAY_TPU_BENCH_HBM_GB"):
+        hbm = int(os.environ["RAY_TPU_BENCH_HBM_GB"]) << 30
+
+    seq = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
+    env_batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "0"))
+    preset = os.environ.get("RAY_TPU_BENCH_PRESET")
+    if preset:
+        candidates = [(preset, llama.PRESETS[preset])]
+    else:
+        name0, cfg0 = pick_config(n, hbm)
+        from ray_tpu.models.llama import PRESETS
+
+        # Fallback ladder: step down on OOM (peak temp memory — logits,
+        # attention backward — is workload-dependent; probe, don't predict).
+        candidates = []
+        seen = False
+        for cand_name, cand_cfg in [
+            ("1b", PRESETS["1b"]),
+            ("bench600m", llama.LlamaConfig(
+                vocab_size=32000, dim=1280, n_layers=24, n_heads=16,
+                n_kv_heads=16, mlp_dim=5120, max_seq_len=2048)),
+            ("bench400m", llama.LlamaConfig(
+                vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
+                n_kv_heads=16, mlp_dim=4096, max_seq_len=2048)),
+            ("160m", PRESETS["160m"]),
+            ("debug", PRESETS["debug"]),
+        ]:
+            if cand_name == name0:
+                seen = True
+            if seen:
+                candidates.append((cand_name, cand_cfg))
+
+    mesh = MeshSpec(fsdp=-1).build()
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+
+    last_err = None
+    for name, cfg in candidates:
+        cfg = dataclasses.replace(cfg, max_seq_len=min(seq, cfg.max_seq_len))
+        cur_seq = cfg.max_seq_len
+        for batch in ([env_batch] if env_batch else [n * 8, n * 4, n * 2]):
+            try:
+                params = ts.init_sharded_params(
+                    lambda k: llama.init_params(cfg, k), llama.param_axes(),
+                    mesh, jax.random.key(0))
+                opt_state = ts.init_optimizer_state(opt, params)
+                step_fn = ts.build_train_step(
+                    lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh)
+                batch_data = ts.shard_batch(
+                    {"tokens": jax.random.randint(
+                        jax.random.key(1), (batch, cur_seq + 1), 0,
+                        cfg.vocab_size)}, mesh)
+                params, opt_state, metrics = step_fn(params, opt_state,
+                                                     batch_data)
+                jax.block_until_ready(metrics["loss"])
+                last_err = None
+            except Exception as e:  # OOM etc: step down
+                last_err = e
+                params = opt_state = step_fn = batch_data = None
+                continue
+            break
+        if last_err is None:
+            break
+    if last_err is not None:
+        raise last_err
+    seq = cur_seq
+
+    steps = int(os.environ.get("RAY_TPU_BENCH_STEPS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = step_fn(params, opt_state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = steps * batch * seq
+    tokens_per_sec = tokens / dt
+    flops_per_tok = llama.flops_per_token(cfg, seq)
+    achieved = tokens_per_sec * flops_per_tok
+    peak = peak_flops_per_chip(kind) * n
+    mfu = 100.0 * achieved / peak
+
+    print(json.dumps({
+        "metric": f"llama_{name}_train_mfu_{n}x_{kind.replace(' ', '_')}",
+        "value": round(mfu, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 40.0, 3),
+        "tokens_per_sec": round(tokens_per_sec),
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n),
+        "step_time_s": round(dt / steps, 4),
+        "batch": batch,
+        "seq": seq,
+        "params_m": round(cfg.num_params() / 1e6),
+        "loss": float(metrics["loss"]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+"""ray_tpu.data: streaming distributed datasets (reference: Ray Data)."""
+
+from ray_tpu.data.dataset import DataIterator, Dataset  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_items,
+    from_numpy,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)

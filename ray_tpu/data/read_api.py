@@ -1,0 +1,84 @@
+"""Dataset creation APIs (reference: ``python/ray/data/read_api.py``)."""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.dataset import Dataset
+
+
+def _rows_to_block(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    if not rows:
+        return {}
+    return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+
+
+def from_items(items: List[Any], num_blocks: int = 8) -> Dataset:
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    per = max(1, math.ceil(len(rows) / num_blocks))
+    refs = [ray_tpu.put(_rows_to_block(rows[i:i + per]))
+            for i in builtins.range(0, len(rows), per)]
+    return Dataset(refs)
+
+
+def range(n: int, num_blocks: int = 8) -> Dataset:
+    per = max(1, math.ceil(n / num_blocks))
+    refs = [ray_tpu.put({"id": np.arange(i, min(i + per, n))})
+            for i in builtins.range(0, n, per)]
+    return Dataset(refs)
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], num_blocks: int = 8) -> Dataset:
+    n = len(next(iter(arrays.values())))
+    per = max(1, math.ceil(n / num_blocks))
+    refs = [ray_tpu.put({k: v[i:i + per] for k, v in arrays.items()})
+            for i in builtins.range(0, n, per)]
+    return Dataset(refs)
+
+
+def _read_files(paths, reader) -> Dataset:
+    """One read task per file — parallel IO through the object store
+    (reference: one read task per file fragment)."""
+    if isinstance(paths, str):
+        paths = sorted(_glob.glob(paths)) or [paths]
+    read_task = ray_tpu.remote(reader)
+    return Dataset([read_task.remote(p) for p in paths])
+
+
+def read_parquet(paths) -> Dataset:
+    def reader(path: str):
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        return {name: table[name].to_numpy(zero_copy_only=False)
+                for name in table.column_names}
+
+    return _read_files(paths, reader)
+
+
+def read_csv(paths) -> Dataset:
+    def reader(path: str):
+        import csv
+
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        return _rows_to_block(rows)
+
+    return _read_files(paths, reader)
+
+
+def read_json(paths) -> Dataset:
+    def reader(path: str):
+        import json
+
+        with open(path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        return _rows_to_block(rows)
+
+    return _read_files(paths, reader)

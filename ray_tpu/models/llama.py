@@ -1,0 +1,284 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+The flagship model for the framework's training/serving paths (the reference
+has no model library — its benchmarks wrap torch models; our north-star is
+Llama-2-7B pretraining at >=40% MFU, BASELINE.md). Design choices driven by
+the TPU/XLA execution model:
+
+* **Pure functional**: params are a pytree of arrays + a parallel pytree of
+  logical axis names (``ray_tpu.parallel.sharding``); one rule table turns
+  the same model into DP, FSDP, TP, SP or any mix — no model code changes.
+* **Scanned layers**: all decoder layers live in one stacked pytree with a
+  leading ``layers`` axis, executed by ``lax.scan`` — one layer is compiled
+  once instead of L times (compile time and HLO size stay flat as depth
+  grows), and ``jax.checkpoint`` on the scanned body gives the standard
+  FSDP-friendly remat schedule.
+* **bf16 compute, fp32 accumulation**: matmuls run in bf16 on the MXU with
+  fp32 ``preferred_element_type`` where it matters (attention stats, loss);
+  master params stay fp32 (cast per step).
+* **Static shapes everywhere**; causal masking via position arithmetic so
+  ring attention (sequence parallelism) composes by offset, not by mask
+  materialization.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rotary import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    mlp_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # Attention implementation: "xla" | "chunked" | "ring" (ring requires a
+    # seq-sharded mesh context).
+    attention_impl: str = "xla"
+    remat: bool = True
+    # Remat policy: "full" recomputes everything (min memory); "dots" saves
+    # matmul outputs and recomputes only elementwise ops (higher MFU when
+    # HBM allows — the standard knob on TPU).
+    remat_policy: str = "full"
+    # Cross-entropy in sequence chunks of this many tokens (0 = whole
+    # sequence): avoids materializing the full fp32 (B,S,V) logits, the
+    # single largest activation at small model sizes.
+    loss_chunk: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        p = self.vocab_size * self.dim  # embed
+        per_layer = (
+            2 * self.dim  # norms
+            + self.dim * self.n_heads * self.head_dim
+            + 2 * self.dim * self.n_kv_heads * self.head_dim
+            + self.n_heads * self.head_dim * self.dim
+            + 3 * self.dim * self.mlp_dim
+        )
+        p += self.n_layers * per_layer
+        p += self.dim  # final norm
+        p += self.dim * self.vocab_size  # lm head
+        return p
+
+
+# Reference shapes: Llama-2 family (meta-llama); "debug"/"160m" are test and
+# bench scales for single-chip and virtual-mesh runs.
+PRESETS: Dict[str, LlamaConfig] = {
+    "debug": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=128, max_seq_len=128),
+    "160m": LlamaConfig(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                        n_kv_heads=12, mlp_dim=2048, max_seq_len=2048),
+    "1b": LlamaConfig(vocab_size=32000, dim=2048, n_layers=22, n_heads=16,
+                      n_kv_heads=8, mlp_dim=5632, max_seq_len=4096),
+    "7b": LlamaConfig(),
+    "13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                       mlp_dim=13824),
+    "70b": LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                       mlp_dim=28672),
+}
+
+
+def config_for(name_or_config) -> LlamaConfig:
+    if isinstance(name_or_config, LlamaConfig):
+        return name_or_config
+    return PRESETS[name_or_config]
+
+
+# ------------------------------------------------------------------ params
+
+def param_axes() -> Dict[str, Any]:
+    """Logical axis names, mirroring the params pytree structure."""
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: LlamaConfig, key: jax.Array,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    """Initialize master params (fp32 by default). Layer params are stacked
+    with a leading ``layers`` axis for lax.scan."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    std = 0.02
+
+    def normal(key, shape, fan_in=None):
+        scale = std if fan_in is None else (1.0 / math.sqrt(fan_in))
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    lk = jax.random.split(k_layers, 7)
+    L, E, H, KV, D, M = (c.n_layers, c.dim, c.n_heads, c.n_kv_heads,
+                         c.head_dim, c.mlp_dim)
+    return {
+        "tok_embed": normal(k_embed, (c.vocab_size, E)),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), dtype),
+            "wq": normal(lk[0], (L, E, H, D), fan_in=E),
+            "wk": normal(lk[1], (L, E, KV, D), fan_in=E),
+            "wv": normal(lk[2], (L, E, KV, D), fan_in=E),
+            "wo": normal(lk[3], (L, H, D, E), fan_in=H * D),
+            "mlp_norm": jnp.ones((L, E), dtype),
+            "w_gate": normal(lk[4], (L, E, M), fan_in=E),
+            "w_up": normal(lk[5], (L, E, M), fan_in=E),
+            "w_down": normal(lk[6], (L, M, E), fan_in=M),
+        },
+        "final_norm": jnp.ones((E,), dtype),
+        "lm_head": normal(k_head, (E, c.vocab_size), fan_in=E),
+    }
+
+
+# ----------------------------------------------------------------- forward
+
+def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
+    """One decoder block. ``x``: (B, S, E) in compute dtype."""
+    c = config
+    h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    h = constrain(h, ("batch", "length", "act_embed"))
+
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = constrain(q, ("batch", "length", "heads", "head_dim"))
+    k = constrain(k, ("batch", "length", "kv_heads", "head_dim"))
+
+    if c.attention_impl == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
+        from ray_tpu.parallel.sharding import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise ValueError("attention_impl='ring' requires an axis_rules "
+                             "context with a seq-sharded mesh")
+        attn = ring_attention(q, k, v, mesh)
+    else:
+        attn = attention(q, k, v, causal=True, q_offset=q_offset,
+                         impl=c.attention_impl)
+    out = jnp.einsum("bshd,hde->bse", attn, layer["wo"].astype(h.dtype))
+    x = x + constrain(out, ("batch", "length", "act_embed"))
+
+    h2 = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+    gate = jnp.einsum("bse,em->bsm", h2, layer["w_gate"].astype(h2.dtype))
+    up = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+    ffn = jax.nn.silu(gate) * up
+    ffn = constrain(ffn, ("batch", "length", "mlp"))
+    down = jnp.einsum("bsm,me->bse", ffn, layer["w_down"].astype(h2.dtype))
+    return x + constrain(down, ("batch", "length", "act_embed"))
+
+
+def hidden_states(params: Dict[str, Any], tokens: jax.Array,
+                  config: LlamaConfig) -> jax.Array:
+    """Token ids (B, S) -> final-norm hidden states (B, S, E)."""
+    c = config
+    x = params["tok_embed"].astype(c.dtype)[tokens]
+    x = constrain(x, ("batch", "length", "act_embed"))
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+
+    def body(x, layer):
+        return _decoder_layer(c, x, layer, cos, sin, 0), None
+
+    if c.remat:
+        policy = None
+        if c.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return rms_norm(x, params["final_norm"], c.norm_eps)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            config: LlamaConfig) -> jax.Array:
+    """Token ids (B, S) -> logits (B, S, V) in fp32."""
+    c = config
+    x = hidden_states(params, tokens, config)
+    logits = jnp.einsum("bse,ev->bsv", x,
+                        params["lm_head"].astype(c.dtype),
+                        preferred_element_type=jnp.float32)
+    return constrain(logits, ("batch", "length", "vocab"))
+
+
+def _chunk_ce(x_c, targets_c, lm_head):
+    """Cross entropy for one sequence chunk; logits never leave the chunk."""
+    logits = jnp.einsum("bse,ev->bsv", x_c, lm_head,
+                        preferred_element_type=jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets_c[..., None], axis=-1)[..., 0]
+    return jnp.sum(logz - gold)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy. ``batch``: {"tokens": (B, S+1) int32} or
+    {"inputs": (B, S), "targets": (B, S)}; fp32 log-softmax. With
+    ``config.loss_chunk`` the (B,S,V) fp32 logits are never materialized —
+    the head matmul + CE run per sequence chunk under remat."""
+    c = config
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    x = hidden_states(params, inputs, c)
+    lm_head = params["lm_head"].astype(c.dtype)
+    b, s, _ = x.shape
+    chunk = c.loss_chunk
+    if chunk and s % chunk == 0 and s > chunk:
+        n = s // chunk
+        x_chunks = x.reshape(b, n, chunk, -1).transpose(1, 0, 2, 3)
+        t_chunks = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+        def body(total, xt):
+            x_c, t_c = xt
+            return total + jax.checkpoint(_chunk_ce)(x_c, t_c, lm_head), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (x_chunks, t_chunks))
+        return total / (b * s)
+    logits = jnp.einsum("bse,ev->bsv", x, lm_head,
+                        preferred_element_type=jnp.float32)
+    logits = constrain(logits, ("batch", "length", "vocab"))
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token (fwd+bwd ~= 6*N plus attention quadratic term)."""
+    c = config
+    param_flops = 6.0 * c.num_params()
+    # attention scores+values: 2 matmuls * 2 (fwd) * 3 (fwd+bwd) per token:
+    attn_flops = 12.0 * c.n_layers * c.n_heads * c.head_dim * seq_len
+    return param_flops + attn_flops

@@ -1,0 +1,177 @@
+"""Attention ops: XLA reference path + chunked (memory-efficient) path.
+
+The TPU replacement for the torch SDPA/flash-attention world the reference's
+integrations assume. Two implementations with one signature:
+
+* ``impl="xla"`` — plain einsum softmax; materializes (B,H,Sq,Sk) scores.
+  Fastest at short/medium sequence (MXU-bound, XLA fuses mask+softmax).
+* ``impl="chunked"`` — online-softmax over KV chunks via ``lax.scan``; never
+  materializes the full score matrix. O(S) memory; the building block the
+  ring-attention sequence-parallel path reuses per shard
+  (``ray_tpu.parallel.ring_attention``).
+
+GQA: ``k``/``v`` may have fewer heads than ``q``; they are repeated to match
+(XLA keeps the repeat virtual through the einsum).
+
+Shapes follow (batch, seq, heads, head_dim) throughout the framework.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def attention(
+    q: jax.Array,                    # (B, Sq, Hq, D)
+    k: jax.Array,                    # (B, Sk, Hkv, D)
+    v: jax.Array,                    # (B, Sk, Hkv, D)
+    causal: bool = True,
+    q_offset: int = 0,               # global position of q[0] (ring/decode)
+    kv_offset: int = 0,              # global position of k[0]
+    impl: str = "xla",
+    chunk_size: int = 512,
+) -> jax.Array:
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    if impl == "xla":
+        out, _ = _attention_xla(q, k, v, causal, q_offset, kv_offset)
+        return out
+    if impl == "chunked":
+        out, _ = _attention_chunked(q, k, v, causal, q_offset, kv_offset,
+                                    chunk_size)
+        return out
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def attention_block_stats(q, k, v, causal, q_offset, kv_offset):
+    """One attention block returning *unnormalized* accumulator and softmax
+    stats: (acc (B,H,Sq,D) fp32, m (B,H,Sq), l (B,H,Sq)). The composable
+    unit for ring attention's cross-shard log-sum-exp merge."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    d = q.shape[-1]
+    scale = d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(scores - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def merge_attention_stats(acc1, m1, l1, acc2, m2, l2):
+    """Log-sum-exp merge of two partial attention results."""
+    m = jnp.maximum(m1, m2)
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    c1 = jnp.exp(jnp.maximum(m1, _NEG_INF / 2) - m_safe)
+    c2 = jnp.exp(jnp.maximum(m2, _NEG_INF / 2) - m_safe)
+    acc = acc1 * c1[..., None] + acc2 * c2[..., None]
+    l = l1 * c1 + l2 * c2
+    return acc, m, l
+
+
+def finalize_attention(acc, l, dtype):
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).transpose(0, 2, 1, 3).astype(dtype)
+
+
+def _mask(sq: int, sk: int, q_offset, kv_offset) -> jax.Array:
+    q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return q_pos >= k_pos
+
+
+def _attention_xla(q, k, v, causal, q_offset, kv_offset):
+    """Returns (out, (max, sumexp)) — the softmax stats make this directly
+    composable into ring attention's cross-shard combine."""
+    d = q.shape[-1]
+    scale = d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    # Fully masked rows (ring attention shards ahead of the causal frontier)
+    # must contribute zero, not NaN.
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p32 = jnp.exp(scores - m_safe)
+    l = jnp.sum(p32, axis=-1, keepdims=True)
+    # Probabilities stored/multiplied in the compute dtype (bf16): the fp32
+    # softmax stats (m, l) are computed above; the (B,H,S,S) probability
+    # buffer — the largest temp in the whole training step — lives at half
+    # width and the exp fuses into both consumers.
+    p = p32.astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                     preferred_element_type=jnp.float32)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = out / jnp.transpose(l_safe, (0, 2, 1, 3))
+    return out.astype(q.dtype), (m.squeeze(-1), l.squeeze(-1))
+
+
+def _attention_chunked(q, k, v, causal, q_offset, kv_offset, chunk_size):
+    """Online-softmax accumulation over KV chunks (lax.scan — static shapes,
+    compiler-friendly control flow; no S^2 buffer ever materializes)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    chunk_size = min(chunk_size, sk)
+    if sk % chunk_size != 0:
+        raise ValueError(f"kv length {sk} not divisible by chunk {chunk_size}")
+    n_chunks = sk // chunk_size
+    scale = d ** -0.5
+
+    k_chunks = k.reshape(b, n_chunks, chunk_size, h, d).transpose(1, 0, 2, 3, 4)
+    v_chunks = v.reshape(b, n_chunks, chunk_size, h, d).transpose(1, 0, 2, 3, 4)
+
+    q32 = q.astype(jnp.float32)
+
+    @jax.checkpoint  # backward recomputes per-chunk probs: O(S*chunk) live
+    def step(carry, chunk):
+        acc, m, l = carry  # acc: (B,H,Sq,D), m/l: (B,H,Sq)
+        idx, k_c, v_c = chunk
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_c.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = _mask(sq, chunk_size, q_offset,
+                         kv_offset + idx * chunk_size)
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(scores - m_safe[..., None])
+        correction = jnp.exp(jnp.maximum(m, _NEG_INF / 2) - m_safe)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * correction[..., None] + pv
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        step, (acc0, m0, l0),
+        (jnp.arange(n_chunks), k_chunks, v_chunks))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype), (m, l)

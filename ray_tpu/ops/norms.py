@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+RMSNorm as used by the Llama family. Kept as plain jnp: XLA fuses the
+reduction + rescale into neighboring ops on TPU (HBM-bandwidth bound, and
+fusion is the whole win — a handwritten kernel buys nothing here, which is
+exactly the "let XLA fuse" rule from the design notes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation regardless of input dtype (bf16-safe)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute (cos, sin) tables of shape (max_len, head_dim // 2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array = None) -> jax.Array:
+    """Rotate pairs of channels. ``x``: (..., seq, heads, head_dim);
+    ``cos``/``sin``: (max_len, head_dim//2); ``positions``: (..., seq) offsets
+    (defaults to arange, used for decode-time offsets)."""
+    seq = x.shape[-3]
+    if positions is None:
+        cos_t = cos[:seq]
+        sin_t = sin[:seq]
+        # (seq, hd/2) -> broadcast over heads
+        cos_t = cos_t[..., :, None, :]
+        sin_t = sin_t[..., :, None, :]
+    else:
+        cos_t = cos[positions][..., :, None, :]
+        sin_t = sin[positions][..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos_t - x2 * sin_t, x2 * cos_t + x1 * sin_t], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,95 @@
+"""Device meshes: the declarative backbone of every parallelism strategy.
+
+This replaces the reference's entire tensor-plane stack (SURVEY §5.8: torch
+process groups, NCCL/Gloo collective groups, Horovod) with the TPU-native
+model: parallelism is *declared* as a `jax.sharding.Mesh` with named axes and
+compiled by XLA into ICI/DCN collectives — the mesh is declared, not
+connected. The framework's job is only to decide the mesh shape from the
+slice topology and hand out shardings.
+
+Axis convention (superset of the reference's §2.4 strategy inventory):
+
+| axis       | strategy                 | typical collective (inserted by XLA) |
+|------------|--------------------------|--------------------------------------|
+| ``data``   | data parallel            | psum of grads (ICI/DCN all-reduce)   |
+| ``fsdp``   | sharded data parallel    | all-gather params, reduce-scatter    |
+| ``tensor`` | tensor/Megatron parallel | all-reduce of activations            |
+| ``seq``    | sequence/context parallel| ppermute (ring attention)            |
+| ``expert`` | expert parallel (MoE)    | all-to-all token routing             |
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "tensor", "seq", "expert")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A named parallelism layout. Sizes must multiply to the device count
+    (a -1 entry is inferred, like a reshape)."""
+
+    data: int = 1
+    fsdp: int = -1   # default: soak up remaining devices as sharded-DP
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def sizes(self, n_devices: int) -> Tuple[int, ...]:
+        sizes = [self.data, self.fsdp, self.tensor, self.seq, self.expert]
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        known = math.prod(s for s in sizes if s != -1)
+        if -1 in sizes:
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by {known}")
+            sizes[sizes.index(-1)] = n_devices // known
+        if math.prod(sizes) != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, sizes))} needs {math.prod(sizes)} "
+                f"devices, have {n_devices}")
+        return tuple(sizes)
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Build the mesh over ``devices`` (default: all addressable).
+
+        Device order: ``jax.experimental.mesh_utils`` places neighbors on ICI
+        where possible; we fall back to a plain reshape on CPU/virtual
+        devices (tests use an 8-device virtual CPU mesh)."""
+        if devices is None:
+            devices = jax.devices()
+        devices = np.asarray(devices)
+        sizes = self.sizes(devices.size)
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                sizes, devices=list(devices.flat))
+        except Exception:
+            dev_array = devices.reshape(sizes)
+        return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return MeshSpec(data=1, fsdp=1).build(jax.devices()[:1])
+
+
+# Topology presets keyed by (pod type prefix, device count) intent. These are
+# starting points, not laws: the scaling-book recipe is pick mesh -> profile
+# -> iterate.
+def preset_for(n_devices: int, model_params: int = 0) -> MeshSpec:
+    """Heuristic preset: small models pure (fsdp), big models tensor-shard
+    within a host (<=8 chips share fastest ICI) and fsdp across."""
+    if model_params >= 30_000_000_000 and n_devices >= 8:
+        return MeshSpec(tensor=8, fsdp=-1)
+    if model_params >= 6_000_000_000 and n_devices >= 4:
+        return MeshSpec(tensor=4, fsdp=-1)
+    return MeshSpec(fsdp=-1)

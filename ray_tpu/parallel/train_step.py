@@ -1,0 +1,89 @@
+"""SPMD train-step builder: model + optimizer + mesh -> one compiled step.
+
+This is the compute core of the JaxTrainer (the reference's equivalent layer
+is Train's per-worker torch train loop + DDP/NCCL, ``train/torch/config.py``;
+here the entire parallelism stack — DP/FSDP/TP/SP — is inside one jitted
+function and XLA inserts the collectives). The builder:
+
+1. materializes params *directly sharded* (jit init with out_shardings — no
+   host-side full copy, which matters at 7B+),
+2. derives optimizer-state shardings by propagation (jit of optimizer.init
+   over committed-sharded params),
+3. returns a donated, jitted ``step(params, opt_state, batch)`` whose body
+   runs under the mesh's ``axis_rules`` so the model's ``constrain`` calls
+   resolve.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.sharding import (
+    Rules,
+    axis_rules,
+    batch_sharding,
+    spec_for,
+    tree_shardings,
+)
+
+
+def init_sharded_params(init_fn: Callable[[jax.Array], Any],
+                        axes_tree: Any, mesh: Mesh, key: jax.Array,
+                        rules: Optional[Rules] = None):
+    """Run ``init_fn(key)`` with outputs materialized under the mesh's param
+    shardings — each device only ever holds its shard."""
+    shardings = tree_shardings(mesh, axes_tree, rules)
+    with jax.transfer_guard("allow"):
+        init = jax.jit(init_fn, out_shardings=shardings)
+        return init(key)
+
+
+def init_optimizer_state(optimizer: optax.GradientTransformation, params):
+    """optimizer.init jitted over committed-sharded params: XLA propagates
+    param shardings into mu/nu etc. (ZeRO optimizer-state sharding for free —
+    the 'no separate code path' cell of SURVEY §2.4's FSDP row)."""
+    return jax.jit(optimizer.init)(params)
+
+
+def build_train_step(
+    loss_fn: Callable[[Any, Dict[str, jax.Array]], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: Optional[Rules] = None,
+    extra_metrics: Optional[Callable] = None,
+):
+    """Returns ``step(params, opt_state, batch) -> (params, opt_state,
+    metrics)``, jitted with donated state."""
+
+    def step(params, opt_state, batch):
+        with axis_rules(mesh, rules):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            metrics = {"loss": loss,
+                       "grad_norm": optax.global_norm(grads)}
+            if extra_metrics is not None:
+                metrics.update(extra_metrics(new_params, batch))
+        return new_params, new_opt_state, metrics
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def build_eval_step(loss_fn, mesh, rules=None):
+    def eval_step(params, batch):
+        with axis_rules(mesh, rules):
+            return loss_fn(params, batch)
+
+    return jax.jit(eval_step)
+
+
+def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh,
+                rules: Optional[Rules] = None):
+    sh = batch_sharding(mesh, rules)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

@@ -1,0 +1,105 @@
+"""EnvRunner actors: vectorized environment rollout collection.
+
+Analogue of the reference's ``SingleAgentEnvRunner``
+(``rllib/env/single_agent_env_runner.py:53``): an actor stepping a gymnasium
+vector env with the current policy (jax-on-CPU inference — env runners are
+CPU hosts in the TPU topology; SURVEY §7 phase 9), returning fixed-length
+rollout batches plus episode stats. Weights arrive as a numpy pytree via the
+object store (the reference broadcasts torch state dicts the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int = 4,
+                 rollout_length: int = 128, seed: int = 0,
+                 env_config: Optional[Dict] = None):
+        import gymnasium as gym
+        import jax
+
+        self._jax = jax
+        self.envs = gym.make_vec(env_name, num_envs=num_envs,
+                                 **(env_config or {}))
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self._rng = np.random.default_rng(seed)
+        self._key = jax.random.key(seed)
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs)
+        self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
+        self._completed: list = []
+        self._params = None
+        self._sample_fn = None
+
+    def set_weights(self, params) -> None:
+        import jax
+
+        from ray_tpu.rl.models import sample_action
+
+        self._params = jax.device_put(params)
+        if self._sample_fn is None:
+            self._sample_fn = jax.jit(sample_action)
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """Collect one fixed-length rollout (T, N, ...) with bootstrap
+        values; fixed shapes keep the learner's XLA program static."""
+        import jax
+
+        assert self._params is not None, "set_weights first"
+        T, N = self.rollout_length, self.num_envs
+        obs_buf = np.zeros((T, N) + self.envs.single_observation_space.shape,
+                           np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self._sample_fn(
+                self._params, self.obs.astype(np.float32), sub)
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, reward, terminated, truncated, _ = self.envs.step(action)
+            done = np.logical_or(terminated, truncated)
+            rew_buf[t] = reward
+            done_buf[t] = done
+            self._episode_returns += reward
+            self._episode_lengths += 1
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._episode_returns[i]),
+                     int(self._episode_lengths[i])))
+                self._episode_returns[i] = 0.0
+                self._episode_lengths[i] = 0
+
+        # Bootstrap value for the final observation.
+        _, _, last_value = self._sample_fn(
+            self._params, self.obs.astype(np.float32), self._key)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_value": np.asarray(last_value, np.float32),
+        }
+
+    def episode_stats(self) -> Dict[str, Any]:
+        completed, self._completed = self._completed, []
+        if not completed:
+            return {"episodes": 0}
+        returns = [c[0] for c in completed]
+        lengths = [c[1] for c in completed]
+        return {
+            "episodes": len(completed),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_len_mean": float(np.mean(lengths)),
+        }

@@ -1,0 +1,57 @@
+"""RL policy/value networks in pure JAX.
+
+Analogue of the reference's ``RLModule`` (``rllib/core/rl_module/
+rl_module.py``): one functional module producing action logits and value
+estimates. Torch-free; the same params pytree runs on CPU env-runners
+(inference) and TPU learners (training) — weight sync is a device_put, not a
+framework conversion (the reference needs torch<->numpy plumbing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_policy(key: jax.Array, obs_dim: int, num_actions: int,
+                    hidden: Sequence[int] = (64, 64)) -> Dict[str, Any]:
+    """Shared-torso MLP with policy and value heads."""
+    params: Dict[str, Any] = {"layers": []}
+    sizes = [obs_dim, *hidden]
+    keys = jax.random.split(key, len(hidden) + 2)
+    for i in range(len(hidden)):
+        k = keys[i]
+        scale = jnp.sqrt(2.0 / sizes[i])
+        params["layers"].append({
+            "w": jax.random.normal(k, (sizes[i], sizes[i + 1])) * scale,
+            "b": jnp.zeros((sizes[i + 1],)),
+        })
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (sizes[-1], num_actions)) * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def mlp_forward(params: Dict[str, Any],
+                obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def sample_action(params, obs, key):
+    logits, value = mlp_forward(params, obs)
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), action]
+    return action, logp, value

@@ -1,0 +1,232 @@
+"""PPO: the first algorithm on the RL stack.
+
+Analogue of the reference's new-API-stack PPO
+(``rllib/algorithms/ppo/ppo.py:419`` training_step): N EnvRunner actors
+sample in parallel -> GAE advantages -> minibatched clipped-surrogate SGD on
+the learner -> weights broadcast back through the object store. The learner
+step is one jitted function (fwd+bwd+adam fused by XLA); multi-chip learners
+shard the batch over a mesh data axis exactly like the trainer (the
+reference's ``LearnerGroup`` + DDP wrapping collapses into GSPMD).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.models import init_mlp_policy, mlp_forward
+
+
+@dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_length: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    num_sgd_epochs: int = 4
+    minibatch_size: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+    # Builder-style setters (reference: AlgorithmConfig fluent API).
+    def environment(self, env: str, **env_config) -> "PPOConfig":
+        self.env = env
+        self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    num_envs_per_runner: int = 4) -> "PPOConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        return self
+
+
+def compute_gae(rollout: Dict[str, np.ndarray], gamma: float,
+                lam: float) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation over a (T, N) rollout (reference:
+    ``rllib/evaluation/postprocessing.py`` compute_advantages)."""
+    rewards, values, dones = (rollout["rewards"], rollout["values"],
+                              rollout["dones"])
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last_adv = np.zeros(N, np.float32)
+    next_value = rollout["last_value"]
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_adv = delta + gamma * lam * nonterminal * last_adv
+        adv[t] = last_adv
+        next_value = values[t]
+    returns = adv + values
+    return {"advantages": adv, "returns": returns}
+
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        import jax
+        import optax
+
+        self.config = config
+        self._iteration = 0
+        self._total_env_steps = 0
+
+        # Probe the env spec locally for model shapes.
+        import gymnasium as gym
+
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+
+        self.params = init_mlp_policy(
+            jax.random.key(config.seed), obs_dim, num_actions, config.hidden)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env, config.num_envs_per_runner,
+                config.rollout_length, seed=config.seed + i,
+                env_config=config.env_config)
+            for i in range(config.num_env_runners)
+        ]
+        self._broadcast_weights()
+
+    # ------------------------------------------------------------- losses
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            logits, values = mlp_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv)
+            pi_loss = -surr.mean()
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        return update
+
+    # ------------------------------------------------------------- train
+
+    def _broadcast_weights(self) -> None:
+        import jax
+
+        host_params = jax.device_get(self.params)
+        ref = ray_tpu.put(host_params)  # one copy in the object store
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: ``Algorithm.step`` ->
+        synchronous_parallel_sample -> LearnerGroup.update)."""
+        import jax
+
+        cfg = self.config
+        t0 = time.monotonic()
+        rollout_refs = [r.sample.remote() for r in self.runners]
+        rollouts = ray_tpu.get(rollout_refs)
+        sample_time = time.monotonic() - t0
+
+        # Flatten (T, N) across runners into one batch.
+        batches = []
+        for ro in rollouts:
+            gae = compute_gae(ro, cfg.gamma, cfg.gae_lambda)
+            T, N = ro["rewards"].shape
+            flat = {
+                "obs": ro["obs"].reshape(T * N, -1),
+                "actions": ro["actions"].reshape(-1),
+                "logp": ro["logp"].reshape(-1),
+                "advantages": gae["advantages"].reshape(-1),
+                "returns": gae["returns"].reshape(-1),
+            }
+            batches.append(flat)
+        batch = {k: np.concatenate([b[k] for b in batches]) for k in
+                 batches[0]}
+        n = len(batch["actions"])
+        self._total_env_steps += n
+
+        t1 = time.monotonic()
+        rng = np.random.default_rng(cfg.seed + self._iteration)
+        aux = {}
+        mb = min(cfg.minibatch_size, n)
+        for _ in range(cfg.num_sgd_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start:start + mb]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, minibatch)
+        learn_time = time.monotonic() - t1
+
+        self._broadcast_weights()
+        stats = [s for s in ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners])]
+        episode_returns = [s["episode_return_mean"] for s in stats
+                           if s.get("episodes")]
+        self._iteration += 1
+        metrics = {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._total_env_steps,
+            "env_steps_this_iter": n,
+            "env_steps_per_sec": n / max(1e-9, sample_time + learn_time),
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(learn_time, 3),
+            **{k: float(v) for k, v in jax.device_get(aux).items()},
+        }
+        if episode_returns:
+            metrics["episode_return_mean"] = float(np.mean(episode_returns))
+        return metrics
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

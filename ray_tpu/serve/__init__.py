@@ -1,0 +1,17 @@
+"""ray_tpu.serve: model serving on actors (reference: Ray Serve)."""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.deployment import (  # noqa: F401
+    AutoscalingConfig,
+    Deployment,
+    DeploymentHandle,
+    deployment,
+)

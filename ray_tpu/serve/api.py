@@ -1,0 +1,115 @@
+"""serve.run / HTTP proxy / lifecycle.
+
+Analogue of the reference's ``serve.run`` + proxy (``serve/api.py``,
+``serve/_private/proxy.py:761,1130``). The HTTP proxy is a stdlib threading
+HTTP server routing ``POST /<deployment>`` with a JSON body to the
+deployment handle — the uvicorn/gRPC surface of the reference condensed to
+the protocol that matters for parity tests; replicas and routing are the
+real stack underneath.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.deployment import (
+    Deployment,
+    DeploymentHandle,
+    _DeploymentState,
+)
+
+_deployments: Dict[str, _DeploymentState] = {}
+_reconciler: Optional[threading.Thread] = None
+_http_server: Optional[ThreadingHTTPServer] = None
+_stop = threading.Event()
+
+
+def run(app: Deployment, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) an application; returns its handle."""
+    global _reconciler
+    name = name or app.name
+    if name in _deployments:
+        _deployments[name].shutdown()
+    state = _DeploymentState(app)
+    _deployments[name] = state
+    if _reconciler is None or not _reconciler.is_alive():
+        _stop.clear()
+        _reconciler = threading.Thread(target=_reconcile_loop,
+                                       name="serve-reconcile", daemon=True)
+        _reconciler.start()
+    return DeploymentHandle(state)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(_deployments[name])
+
+
+def status() -> Dict[str, Any]:
+    return {name: {"replicas": s.num_replicas()}
+            for name, s in _deployments.items()}
+
+
+def delete(name: str) -> None:
+    state = _deployments.pop(name, None)
+    if state is not None:
+        state.shutdown()
+
+
+def shutdown() -> None:
+    global _http_server
+    _stop.set()
+    for name in list(_deployments):
+        delete(name)
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server = None
+
+
+def _reconcile_loop() -> None:
+    """Controller reconcile: autoscaling + dead-replica replacement
+    (reference: ServeController loop)."""
+    while not _stop.wait(0.25):
+        for state in list(_deployments.values()):
+            try:
+                state.reconcile()
+            except Exception:
+                pass
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        name = self.path.strip("/").split("/")[0]
+        state = _deployments.get(name)
+        if state is None:
+            self.send_error(404, f"no deployment {name!r}")
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"null"
+        try:
+            payload = json.loads(body)
+            result = state.submit("__call__", (payload,), {}).result(
+                timeout=60)
+            data = json.dumps(result).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except Exception as e:  # noqa: BLE001
+            self.send_error(500, str(e))
+
+    def log_message(self, *args):  # silence
+        pass
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0) -> tuple:
+    """Start the HTTP proxy; returns (host, port)."""
+    global _http_server
+    _http_server = ThreadingHTTPServer((host, port), _ProxyHandler)
+    threading.Thread(target=_http_server.serve_forever, name="serve-http",
+                     daemon=True).start()
+    return _http_server.server_address

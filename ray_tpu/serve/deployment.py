@@ -1,0 +1,232 @@
+"""Deployments, replicas, routing, autoscaling — the Serve stack.
+
+Analogue of the reference's Serve architecture (SURVEY §3.5): control plane
+(``ServeController`` reconciling ``DeploymentState``,
+``serve/_private/controller.py:86`` + ``deployment_state.py``) and data plane
+(``DeploymentHandle`` -> ``Router.assign_request`` ->
+power-of-two-choices replica picking, ``replica_scheduler/pow_2_scheduler.py
+:49`` -> ``ReplicaActor.handle_request``, ``replica.py:231``), condensed:
+the controller runs in the driver process with a reconcile thread; replicas
+are actors; routing state (in-flight counts) lives client-side in the
+handle, which is what the reference's pow-2 scheduler samples anyway.
+
+Request autoscaling mirrors ``autoscaling_policy.py:12``: desired replicas =
+ceil(total in-flight / target_ongoing_requests), clamped to [min, max],
+applied by the reconcile loop.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 5.0
+
+
+class Deployment:
+    def __init__(self, cls, name: Optional[str] = None,
+                 num_replicas: int = 1,
+                 ray_actor_options: Optional[Dict] = None,
+                 autoscaling_config: Optional[AutoscalingConfig] = None,
+                 max_ongoing_requests: int = 8):
+        self.cls = cls
+        self.name = name or cls.__name__
+        self.num_replicas = num_replicas
+        self.actor_options = ray_actor_options or {}
+        self.autoscaling = autoscaling_config
+        self.max_ongoing_requests = max_ongoing_requests
+        self._init_args: tuple = ()
+        self._init_kwargs: dict = {}
+
+    def options(self, **overrides) -> "Deployment":
+        dep = Deployment(self.cls, self.name, self.num_replicas,
+                         dict(self.actor_options), self.autoscaling,
+                         self.max_ongoing_requests)
+        for k, v in overrides.items():
+            setattr(dep, k if k != "name" else "name", v)
+        return dep
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        self._init_args = args
+        self._init_kwargs = kwargs
+        return self
+
+
+def deployment(_cls=None, **kwargs):
+    """``@serve.deployment`` decorator (reference: ``serve/api.py``)."""
+
+    def wrap(cls):
+        return Deployment(cls, **kwargs)
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+class _ReplicaWrapper:
+    """Actor body hosting the user callable (reference: ReplicaActor +
+    UserCallableWrapper, ``replica.py:231,750``)."""
+
+    def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict):
+        from ray_tpu.core import serialization
+
+        cls = serialization.loads_function(cls_blob)
+        self._instance = cls(*args, **kwargs)
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        target = (self._instance if method == "__call__"
+                  else getattr(self._instance, method))
+        if method == "__call__":
+            return target(*args, **kwargs)
+        return target(*args, **kwargs)
+
+    def ping(self):
+        return "pong"
+
+
+class DeploymentHandle:
+    """Client-side router with power-of-two-choices replica selection."""
+
+    def __init__(self, state: "_DeploymentState", method: str = "__call__"):
+        self._state = state
+        self._method = method
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._state, method_name)
+
+    def remote(self, *args, **kwargs):
+        """Async: returns an ObjectRef-like future."""
+        return self._state.submit(self._method, args, kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._state, name)
+
+
+class _DeploymentState:
+    """Controller-side record + data-plane routing for one deployment."""
+
+    def __init__(self, deployment: Deployment):
+        from ray_tpu.core import serialization
+
+        self.deployment = deployment
+        self.cls_blob = serialization.dumps_function(deployment.cls)
+        self.replicas: List[Any] = []
+        self.inflight: Dict[int, int] = {}  # replica index -> count
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=64,
+                                        thread_name_prefix="serve-router")
+        self._last_scale = time.monotonic()
+        target = (deployment.autoscaling.min_replicas
+                  if deployment.autoscaling else deployment.num_replicas)
+        for _ in range(target):
+            self._add_replica()
+
+    def _add_replica(self) -> None:
+        actor_cls = ray_tpu.remote(_ReplicaWrapper)
+        opts = dict(self.deployment.actor_options)
+        opts.setdefault("max_concurrency",
+                        self.deployment.max_ongoing_requests)
+        actor = actor_cls.options(**opts).remote(
+            self.cls_blob, self.deployment._init_args,
+            self.deployment._init_kwargs)
+        with self._lock:
+            self.replicas.append(actor)
+            self.inflight[len(self.replicas) - 1] = 0
+
+    def _remove_replica(self) -> None:
+        with self._lock:
+            if len(self.replicas) <= 1:
+                return
+            idx = len(self.replicas) - 1
+            actor = self.replicas.pop(idx)
+            self.inflight.pop(idx, None)
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ routing
+
+    def _pick_replica(self) -> int:
+        """Power-of-two-choices on client-side in-flight counts
+        (pow_2_scheduler.py:49)."""
+        with self._lock:
+            n = len(self.replicas)
+            if n == 1:
+                return 0
+            a, b = random.sample(range(n), 2)
+            return a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) \
+                else b
+
+    def submit(self, method: str, args: tuple, kwargs: dict) -> Future:
+        fut: Future = Future()
+
+        def run():
+            idx = self._pick_replica()
+            with self._lock:
+                self.inflight[idx] = self.inflight.get(idx, 0) + 1
+                actor = self.replicas[idx]
+            try:
+                ref = actor.handle_request.remote(method, args, kwargs)
+                fut.set_result(ray_tpu.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+            finally:
+                with self._lock:
+                    self.inflight[idx] = max(
+                        0, self.inflight.get(idx, 1) - 1)
+
+        self._pool.submit(run)
+        return fut
+
+    # -------------------------------------------------------- autoscaling
+
+    def reconcile(self) -> None:
+        auto = self.deployment.autoscaling
+        if auto is None:
+            return
+        with self._lock:
+            total_inflight = sum(self.inflight.values())
+            current = len(self.replicas)
+        desired = max(auto.min_replicas,
+                      min(auto.max_replicas,
+                          -(-int(total_inflight) //
+                            max(1, int(auto.target_ongoing_requests)))))
+        now = time.monotonic()
+        if desired > current and now - self._last_scale > auto.upscale_delay_s:
+            self._add_replica()
+            self._last_scale = now
+        elif (desired < current
+              and now - self._last_scale > auto.downscale_delay_s):
+            self._remove_replica()
+            self._last_scale = now
+
+    def shutdown(self) -> None:
+        with self._lock:
+            replicas, self.replicas = list(self.replicas), []
+        for actor in replicas:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        self._pool.shutdown(wait=False)
+
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self.replicas)

@@ -1,0 +1,22 @@
+"""ray_tpu.train: distributed training orchestration (reference: Ray Train)."""
+
+from ray_tpu.train.checkpoint import (  # noqa: F401
+    Checkpoint,
+    restore_pytree,
+    save_pytree,
+    temp_checkpoint_dir,
+)
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_session,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

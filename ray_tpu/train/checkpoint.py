@@ -1,0 +1,72 @@
+"""Checkpoints: directory handles + orbax-backed model state IO.
+
+Analogue of the reference's ``ray.train.Checkpoint`` (``train/_checkpoint.py``
+— a directory handle, storage-agnostic) with the TPU-native payload layer:
+orbax saves/restores sharded jax pytrees directly from/to device shards
+(each host writes only its shards — the multi-host checkpoint layout the
+reference delegates to torch.save + cloud fs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+# ------------------------------------------------------------ orbax layer
+
+def save_pytree(path: str, tree: Any, extra_metadata: Optional[Dict] = None,
+                step: int = 0) -> Checkpoint:
+    """Save a (possibly sharded) pytree of jax arrays with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), tree, force=True)
+    ckptr.wait_until_finished()
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump({"step": step, **(extra_metadata or {})}, f)
+    return Checkpoint(path)
+
+
+def restore_pytree(checkpoint: Checkpoint, target: Any = None) -> Tuple[Any, Dict]:
+    """Restore a pytree; ``target`` (a pytree of ShapeDtypeStruct or arrays
+    with shardings) drives sharded restoration."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    state_path = os.path.join(checkpoint.path, "state")
+    tree = ckptr.restore(state_path, target)
+    meta_path = os.path.join(checkpoint.path, "metadata.json")
+    metadata: Dict = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            metadata = json.load(f)
+    return tree, metadata
+
+
+def temp_checkpoint_dir() -> str:
+    return tempfile.mkdtemp(prefix="ray_tpu_ckpt_")

@@ -1,0 +1,17 @@
+"""ray_tpu.tune: hyperparameter optimization (reference: Ray Tune)."""
+
+from ray_tpu.train.session import report, get_checkpoint  # noqa: F401  (tune.report == train.report)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401

@@ -1,0 +1,135 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Analogues of the reference's ``tune/schedulers/``: ``FIFOScheduler``,
+``AsyncHyperBandScheduler`` (``async_hyperband.py`` — asynchronous successive
+halving) and ``PopulationBasedTraining`` (``pbt.py`` — exploit best trials'
+checkpoints + perturb their hyperparams). The controller calls
+``on_result(trial, metrics)`` after every report and acts on the decision.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+EXPLOIT = "EXPLOIT"  # PBT: restart from another trial's checkpoint
+
+
+class FIFOScheduler:
+    def on_result(self, trial, metrics: Dict[str, Any]) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference:
+    ``tune/schedulers/async_hyperband.py``): rungs at
+    ``grace_period * reduction_factor**k``; a trial reaching a rung stops
+    unless it is in the top ``1/reduction_factor`` of results recorded at
+    that rung so far (async — no waiting for full brackets)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+        self.max_t, self.grace = max_t, grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric values
+        self._recorded: Dict[int, List[float]] = defaultdict(list)
+
+    def on_result(self, trial, metrics: Dict[str, Any]) -> str:
+        t = metrics.get(self.time_attr, 0)
+        value = metrics.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for rung in self.rungs:
+            if t == rung:
+                recorded = self._recorded[rung]
+                recorded.append(float(value))
+                cutoff_idx = max(0, len(recorded) // self.rf)
+                ranked = sorted(recorded, reverse=(self.mode == "max"))
+                cutoff = ranked[cutoff_idx] if cutoff_idx < len(ranked) \
+                    else ranked[-1]
+                good = (value <= cutoff if self.mode == "min"
+                        else value >= cutoff)
+                if not good and len(recorded) >= self.rf:
+                    decision = STOP
+        return decision
+
+
+class PopulationBasedTraining:
+    """PBT (reference: ``tune/schedulers/pbt.py``): every
+    ``perturbation_interval`` iterations, bottom-quantile trials clone a
+    top-quantile trial's latest checkpoint and continue with perturbed
+    hyperparameters (multiply by 0.8/1.2, or resample from
+    ``hyperparam_mutations``)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 time_attr: str = "training_iteration", seed: int = 0):
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._latest: Dict[Any, Dict[str, Any]] = {}  # trial -> last metrics
+        self._last_perturb: Dict[Any, int] = {}
+
+    def on_result(self, trial, metrics: Dict[str, Any]) -> str:
+        self._latest[trial] = metrics
+        t = metrics.get(self.time_attr, 0)
+        if t - self._last_perturb.get(trial, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial] = t
+        ranked = self._ranked_trials()
+        if len(ranked) < 2:
+            return CONTINUE
+        n_q = max(1, int(len(ranked) * self.quantile))
+        bottom = ranked[-n_q:]
+        if trial in bottom:
+            return EXPLOIT
+        return CONTINUE
+
+    def _ranked_trials(self):
+        scored = [(tr, m.get(self.metric)) for tr, m in self._latest.items()
+                  if m.get(self.metric) is not None]
+        return [tr for tr, v in sorted(
+            scored, key=lambda kv: kv[1], reverse=(self.mode == "max"))]
+
+    def exploit_target(self, trial):
+        """Pick a top-quantile trial to clone from."""
+        ranked = self._ranked_trials()
+        n_q = max(1, int(len(ranked) * self.quantile))
+        top = [t for t in ranked[:n_q] if t is not trial]
+        return self._rng.choice(top) if top else None
+
+    def perturb_config(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in out:
+                continue
+            if isinstance(spec, Domain):
+                out[key] = spec.sample(self._rng)
+            elif isinstance(spec, list):
+                out[key] = self._rng.choice(spec)
+            elif isinstance(out[key], (int, float)):
+                out[key] = out[key] * self._rng.choice([0.8, 1.2])
+        return out

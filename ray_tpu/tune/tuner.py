@@ -1,0 +1,207 @@
+"""Tuner: the HPO trial loop.
+
+Analogue of the reference's ``Tuner.fit`` -> ``TuneController`` event loop
+(``tune/tuner.py:44,344``, ``tune/execution/tune_controller.py:68,666``):
+trials run as actors (via the same TrainWorker session machinery Train
+uses — the reference likewise unifies trial and train execution), the
+controller polls results, feeds them to the scheduler (FIFO/ASHA/PBT), and
+stops / exploits trials per its decisions. PBT exploitation restarts the
+trial actor from the donor trial's latest checkpoint with perturbed
+hyperparameters (reference: ``pbt.py`` checkpoint clone + perturb).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Optional[Dict[str, Any]] = None
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self.results = results
+        self._metric, self._mode = metric, mode
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self.results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def __len__(self):
+        return len(self.results)
+
+    def __iter__(self):
+        return iter(self.results)
+
+
+class _Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.id = trial_id
+        self.config = dict(config)
+        self.actor = None
+        self.state = "PENDING"
+        self.iteration = 0
+        self.latest_checkpoint: Optional[str] = None
+        self.result = TrialResult(trial_id, dict(config))
+
+    def __hash__(self):
+        return hash(self.id)
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], None],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        storage_path: Optional[str] = None,
+        name: Optional[str] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self._resources = resources_per_trial or {"CPU": 1.0}
+        self._storage = storage_path
+        self._name = name or f"tune_{uuid.uuid4().hex[:8]}"
+
+    # ------------------------------------------------------------- fit
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(self._param_space, tc.num_samples,
+                                     tc.seed)
+        trials = [_Trial(f"{self._name}_{i:05d}", cfg)
+                  for i, cfg in enumerate(variants)]
+        fn_blob = serialization.dumps_function(self._trainable)
+        max_conc = tc.max_concurrent_trials or len(trials)
+
+        pending = list(trials)
+        running: List[_Trial] = []
+        done: List[_Trial] = []
+        while pending or running:
+            while pending and len(running) < max_conc:
+                trial = pending.pop(0)
+                self._launch(trial, fn_blob)
+                running.append(trial)
+            time.sleep(0.05)
+            for trial in list(running):
+                alive = self._poll(trial, scheduler, fn_blob)
+                if not alive:
+                    running.remove(trial)
+                    done.append(trial)
+        return ResultGrid([t.result for t in trials], tc.metric, tc.mode)
+
+    # --------------------------------------------------------- internals
+
+    def _launch(self, trial: _Trial, fn_blob: bytes,
+                checkpoint: Optional[str] = None) -> None:
+        actor_cls = ray_tpu.remote(TrainWorker)
+        world = {"world_rank": 0, "world_size": 1, "local_rank": 0}
+        trial.actor = actor_cls.options(
+            num_cpus=0, resources=dict(self._resources),
+        ).remote(world, self._storage, f"{self._name}/{trial.id}",
+                 checkpoint or trial.latest_checkpoint)
+        trial.actor.start.remote(fn_blob, trial.config)
+        trial.state = "RUNNING"
+
+    def _poll(self, trial: _Trial, scheduler, fn_blob: bytes) -> bool:
+        """Returns True while the trial should keep running."""
+        try:
+            results = ray_tpu.get(trial.actor.next_results.remote(),
+                                  timeout=60)
+            status = ray_tpu.get(trial.actor.status.remote(), timeout=60)
+        except Exception as e:
+            trial.state = "ERROR"
+            trial.result.error = f"trial actor failed: {e}"
+            return False
+        for r in results:
+            if "error" in r:
+                trial.state = "ERROR"
+                trial.result.error = r["error"]
+                continue
+            trial.iteration += 1
+            metrics = dict(r["metrics"])
+            metrics.setdefault("training_iteration", trial.iteration)
+            if r.get("checkpoint"):
+                trial.latest_checkpoint = r["checkpoint"]
+            trial.result.metrics = metrics
+            trial.result.metrics_history.append(metrics)
+            trial.result.checkpoint = (
+                Checkpoint(trial.latest_checkpoint)
+                if trial.latest_checkpoint else None)
+            decision = scheduler.on_result(trial, metrics)
+            if decision == STOP:
+                self._stop_actor(trial)
+                trial.state = "TERMINATED"
+                return False
+            if decision == EXPLOIT:
+                donor = scheduler.exploit_target(trial)
+                if donor is not None and donor.latest_checkpoint:
+                    self._exploit(trial, donor, scheduler, fn_blob)
+                    return True
+        if trial.state == "ERROR" or status["error"]:
+            if status["error"] and trial.result.error is None:
+                trial.result.error = status["error"]
+            self._stop_actor(trial)
+            trial.state = "ERROR"
+            return False
+        if status["finished"]:
+            self._stop_actor(trial)
+            trial.state = "TERMINATED"
+            return False
+        return True
+
+    def _exploit(self, trial: _Trial, donor: _Trial, scheduler,
+                 fn_blob: bytes) -> None:
+        """PBT exploit: restart this trial from the donor's checkpoint with
+        perturbed config."""
+        self._stop_actor(trial)
+        trial.config = scheduler.perturb_config(donor.config)
+        trial.result.config = dict(trial.config)
+        trial.latest_checkpoint = donor.latest_checkpoint
+        self._launch(trial, fn_blob, checkpoint=donor.latest_checkpoint)
+
+    def _stop_actor(self, trial: _Trial) -> None:
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None

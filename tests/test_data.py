@@ -1,0 +1,118 @@
+"""Data library tests (model: reference ``python/ray/data/tests/``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rt_data.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches(ray_start_regular):
+    ds = rt_data.range(100).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    rows = ds.take(3)
+    assert [r["sq"] for r in rows] == [0, 1, 4]
+    assert ds.count() == 100
+
+
+def test_map_and_filter(ray_start_regular):
+    ds = (rt_data.range(50)
+          .map(lambda r: {"id": r["id"], "even": r["id"] % 2 == 0})
+          .filter(lambda r: r["even"]))
+    assert ds.count() == 25
+
+
+def test_iter_batches_fixed_size(ray_start_regular):
+    ds = rt_data.range(100)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [32, 32, 32, 4]
+    # pad_to makes the tail static-shaped (TPU-friendly).
+    batches = list(ds.iter_batches(batch_size=32, pad_to=32))
+    assert all(len(b["id"]) == 32 for b in batches)
+
+
+def test_materialize_and_chain(ray_start_regular):
+    ds = rt_data.range(40).map_batches(
+        lambda b: {"id": b["id"] + 1}).materialize()
+    assert ds.num_blocks() == 8
+    total = sum(r["id"] for r in ds.iter_rows())
+    assert total == sum(range(1, 41))
+
+
+def test_random_shuffle(ray_start_regular):
+    ds = rt_data.range(100).random_shuffle(seed=0)
+    ids = [r["id"] for r in ds.iter_rows()]
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+def test_streaming_split(ray_start_regular):
+    ds = rt_data.range(96)
+    iters = ds.streaming_split(3)
+    all_ids = []
+    for it in iters:
+        for batch in it.iter_batches(batch_size=16):
+            all_ids.extend(batch["id"].tolist())
+    assert sorted(all_ids) == list(range(96))
+
+
+def test_from_items_and_numpy(ray_start_regular):
+    ds = rt_data.from_items([{"x": i, "y": -i} for i in range(10)])
+    assert ds.count() == 10
+    ds2 = rt_data.from_numpy({"a": np.arange(20)})
+    assert ds2.count() == 20
+
+
+def test_read_csv_json_parquet(ray_start_regular, tmp_path):
+    csv_path = tmp_path / "t.csv"
+    csv_path.write_text("a,b\n1,x\n2,y\n")
+    assert rt_data.read_csv(str(csv_path)).count() == 2
+
+    json_path = tmp_path / "t.jsonl"
+    json_path.write_text('{"a": 1}\n{"a": 2}\n{"a": 3}\n')
+    assert rt_data.read_json(str(json_path)).count() == 3
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"v": list(range(7))}),
+                   str(tmp_path / "t.parquet"))
+    ds = rt_data.read_parquet(str(tmp_path / "t.parquet"))
+    assert ds.count() == 7
+    assert sum(r["v"] for r in ds.iter_rows()) == 21
+
+
+def test_train_ingest_path(ray_start_regular):
+    """Dataset -> streaming_split -> JaxTrainer workers (the Train ingest
+    slice, reference: DataConfig -> streaming_split -> per-worker iters)."""
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    ds = rt_data.range(64).map_batches(lambda b: {"id": b["id"] * 2})
+    iters = ds.streaming_split(2)
+
+    def loop(config):
+        from ray_tpu import train
+
+        it = config["iters"][train.get_world_rank()]
+        total = 0
+        for batch in it.iter_batches(batch_size=8):
+            total += int(batch["id"].sum())
+        train.report({"total": total})
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={"iters": iters},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}))
+    result = trainer.fit()
+    assert result.error is None
+    # Both workers together saw every row exactly once.
+    # (rank-0 metrics only cover half; just check it's plausible)
+    assert result.metrics["total"] > 0

@@ -1,0 +1,129 @@
+"""Serve tests (model: reference ``serve/tests/test_serve.py`` family)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def test_basic_deployment(serve_cluster):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    handle = serve.run(Doubler.bind())
+    assert handle.remote(21).result(timeout=60) == 42
+
+
+def test_deployment_with_init_args_and_methods(serve_cluster):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}!"
+
+        def reverse(self, name):
+            return name[::-1]
+
+    handle = serve.run(Greeter.bind("Hello"))
+    assert handle.remote("tpu").result(timeout=60) == "Hello, tpu!"
+    assert handle.reverse.remote("abc").result(timeout=60) == "cba"
+
+
+def test_multiple_replicas_all_serve(serve_cluster):
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, _):
+            return self.pid
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {handle.remote(None).result(timeout=60) for _ in range(20)}
+    assert len(pids) >= 2, f"expected multiple replicas used, got {pids}"
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    class Adder:
+        def __call__(self, body):
+            return {"sum": body["a"] + body["b"]}
+
+    serve.run(Adder.bind(), name="Adder")
+    host, port = serve.start_http()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/Adder",
+        data=json.dumps({"a": 2, "b": 40}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert json.loads(resp.read()) == {"sum": 42}
+
+
+def test_batching(serve_cluster):
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def __call__(self, items):
+            # items is a list; return list of (value, batch_size)
+            return [(x * 10, len(items)) for x in items]
+
+    handle = serve.run(Batched.bind())
+    futures = [handle.remote(i) for i in range(8)]
+    results = [f.result(timeout=60) for f in futures]
+    values = sorted(r[0] for r in results)
+    assert values == [i * 10 for i in range(8)]
+    assert max(r[1] for r in results) > 1, "no batching happened"
+
+
+def test_autoscaling_up(serve_cluster):
+    @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+        upscale_delay_s=0.1))
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.8)
+            return "done"
+
+    handle = serve.run(Slow.bind(), name="Slow")
+    futures = [handle.remote(None) for _ in range(12)]
+    deadline = time.monotonic() + 20
+    scaled = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["replicas"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.2)
+    for f in futures:
+        f.result(timeout=120)
+    assert scaled, f"never scaled up: {serve.status()}"
+
+
+def test_redeploy_replaces(serve_cluster):
+    @serve.deployment
+    class V:
+        def __init__(self, version):
+            self.v = version
+
+        def __call__(self, _):
+            return self.v
+
+    handle = serve.run(V.bind(1), name="V")
+    assert handle.remote(None).result(timeout=60) == 1
+    handle2 = serve.run(V.bind(2), name="V")
+    assert handle2.remote(None).result(timeout=60) == 2

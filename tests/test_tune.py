@@ -1,0 +1,127 @@
+"""Tune tests (model: reference ``tune/tests/test_tune.py`` +
+``test_trial_scheduler_pbt.py``)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, PopulationBasedTraining, TuneConfig, Tuner
+
+
+def test_grid_and_random_variants():
+    from ray_tpu.tune.search import generate_variants
+
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "wd": tune.uniform(0, 1), "fixed": 7}
+    variants = generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 6
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(v["fixed"] == 7 for v in variants)
+
+
+def test_tuner_basic(ray_start_regular):
+    def trainable(config):
+        from ray_tpu import tune as t
+
+        for step in range(3):
+            t.report({"score": config["x"] * (step + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 9
+
+
+def test_tuner_trial_error_isolated(ray_start_regular):
+    def trainable(config):
+        from ray_tpu import tune as t
+
+        if config["x"] == 2:
+            raise RuntimeError("bad trial")
+        t.report({"score": config["x"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit()
+    errored = [r for r in grid if r.error]
+    assert len(errored) == 1 and "bad trial" in errored[0].error
+    assert grid.get_best_result().config["x"] == 3
+
+
+def test_asha_stops_bad_trials(ray_start_regular):
+    def trainable(config):
+        from ray_tpu import tune as t
+
+        for step in range(20):
+            t.report({"loss": config["quality"] + step * 0.001})
+
+    scheduler = ASHAScheduler(metric="loss", mode="min", max_t=20,
+                              grace_period=2, reduction_factor=2)
+    tuner = Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 5.0, 9.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               scheduler=scheduler),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["quality"] == 0.1
+    # At least one of the bad trials stopped early.
+    iters = {r.config["quality"]: len(r.metrics_history) for r in grid}
+    assert min(iters[5.0], iters[9.0]) < 20
+
+
+def test_pbt_exploits_checkpoints(ray_start_regular, tmp_path):
+    """Bottom trials adopt top trials' checkpointed state + perturbed
+    hyperparams (the PBT clone/perturb loop, reference pbt.py)."""
+
+    def trainable(config):
+        import json
+        import os
+        import tempfile
+
+        from ray_tpu import tune as t
+
+        state = {"acc": 0.0}
+        ckpt = t.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "s.json")) as f:
+                state = json.load(f)
+        for _ in range(12):
+            import time
+
+            time.sleep(0.05)  # keep reports slower than the driver poll loop
+            state["acc"] += config["lr"]  # higher lr -> faster "learning"
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump(state, f)
+            t.report({"acc": state["acc"]},
+                     checkpoint=t.Checkpoint.from_directory(d))
+
+    scheduler = PopulationBasedTraining(
+        metric="acc", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.01, 0.1, 1.0]})
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=TuneConfig(metric="acc", mode="max", scheduler=scheduler),
+        storage_path=str(tmp_path),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["acc"] >= 12 * 1.0 * 0.5  # top trial made progress
+    # The originally-weak trial should have been exploited at least once:
+    # its final acc must exceed what lr=0.01 alone could reach (12 * 0.01).
+    weak = [r for r in grid if 0.005 < min(
+        m.get("acc", 1e9) for m in r.metrics_history) < 0.2]
+    if weak:  # exploitation happened mid-run
+        assert max(m["acc"] for m in weak[0].metrics_history) > 0.5
