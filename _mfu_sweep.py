@@ -1,4 +1,4 @@
-import dataclasses, time
+import dataclasses, time, gc
 import jax, optax
 from ray_tpu.models import llama
 from ray_tpu.parallel import train_step as ts
@@ -27,18 +27,30 @@ def try_one(cfg, batch, seq=2048, steps=8):
         jax.block_until_ready(m["loss"])
         dt = (time.perf_counter() - t0) / steps
         del params, opt_state, batch_data
+        gc.collect()
         tps = batch * seq / dt
         mfu = 100 * tps * llama.flops_per_token(cfg, seq) / peak
         return round(mfu, 2), round(tps)
     except Exception as e:
+        gc.collect()
         return None, str(type(e).__name__)
 
-chunkattn = dataclasses.replace(base, loss_chunk=512, attention_impl="chunked")
+ce = dataclasses.replace(base, loss_chunk=512)
+dots = dataclasses.replace(base, loss_chunk=512, remat_policy="dots")
+nore = dataclasses.replace(base, loss_chunk=512, remat=False)
+one_b = dataclasses.replace(llama.PRESETS["1b"], max_seq_len=2048,
+                            loss_chunk=512)
+one_b_dots = dataclasses.replace(one_b, remat_policy="dots")
 for desc, cfg, batch in [
-    ("chunkattn+CE b8", chunkattn, 8),
-    ("chunkattn+CE b16", chunkattn, 16),
-    ("chunkattn+CE b12", chunkattn, 12),
-    ("xla+CE b6", dataclasses.replace(base, loss_chunk=512), 6),
+    ("ce b8", ce, 8),
+    ("ce b16", ce, 16),
+    ("ce+dots b8", dots, 8),
+    ("ce+dots b16", dots, 16),
+    ("ce+noremat b8", nore, 8),
+    ("ce+dots b12", dots, 12),
+    ("1b ce b8", one_b, 8),
+    ("1b ce+dots b8", one_b_dots, 8),
+    ("1b ce b4", one_b, 4),
 ]:
     mfu, tps = try_one(cfg, batch)
     print(f"{desc:22s} -> MFU {mfu} ({tps})", flush=True)

@@ -37,6 +37,9 @@ def _load():
     lib.shm_create.restype = ctypes.c_uint64
     lib.shm_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_seal.restype = ctypes.c_int
+    lib.shm_seal2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_int]
+    lib.shm_seal2.restype = ctypes.c_int
     lib.shm_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     lib.shm_get.restype = ctypes.c_uint64
@@ -84,6 +87,28 @@ class ShmView:
         self.release()
 
 
+class ShmPin:
+    """A primary-copy pin taken at put time (no data view). Released by the
+    owner when the object leaves scope; keeps LRU eviction away from the
+    only copy of a live object."""
+
+    def __init__(self, store: "ShmStore", object_id: bytes):
+        self._store = store
+        self._object_id = object_id
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._store._unpin(self._object_id)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
 class ShmStore:
     """One per process per store file; all methods thread-safe (locking lives
     in the C++ layer)."""
@@ -112,15 +137,17 @@ class ShmStore:
 
     # ------------------------------------------------------------ writer
 
-    def put_bytes(self, object_id: bytes, payload) -> bool:
-        """Create + copy + seal. Returns False when the store can't fit it."""
+    def put_bytes(self, object_id: bytes, payload, pin: bool = False):
+        """Create + copy + seal. Returns None when the store can't fit it;
+        otherwise True, or a ShmPin when ``pin`` (the primary-copy pin the
+        owner must hold until the object is freed)."""
         n = len(payload)
         off = self._lib.shm_create(self._handle, object_id, n)
         if off == 0:
-            return False
+            return None
         self._mv[off:off + n] = payload
-        self._lib.shm_seal(self._handle, object_id)
-        return True
+        self._lib.shm_seal2(self._handle, object_id, 1 if pin else 0)
+        return ShmPin(self, object_id) if pin else True
 
     def create_buffer(self, object_id: bytes, size: int):
         """Reserve a writable buffer; caller fills it then calls seal()."""

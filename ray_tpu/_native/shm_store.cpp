@@ -33,6 +33,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -58,6 +59,8 @@ struct Slot {
   uint32_t state;
   uint32_t pins;
   uint64_t lru_tick;
+  uint64_t owner_pid;   // creator pid: lets eviction reclaim CREATED slots
+                        // whose writer died between create and seal
 };
 
 // Free-list block header, stored inside the data region.
@@ -239,14 +242,27 @@ void release_slot(Handle* h, Slot* s) {
   header(h)->num_objects--;
 }
 
+inline bool pid_dead(uint64_t pid) {
+  return pid != 0 && kill((pid_t)pid, 0) != 0 && errno == ESRCH;
+}
+
 // Evict sealed, unpinned objects (lowest lru_tick first) until at least
 // `needed` aligned bytes could plausibly be free. Returns evicted count.
+// Also reclaims CREATED slots whose creator process died between
+// shm_create and shm_seal (the EOWNERDEAD-leak case).
 int evict_for(Handle* h, uint64_t needed) {
   Header* hd = header(h);
+  Slot* table = slots(h);
   int evicted = 0;
+  for (uint64_t i = 0; i < hd->n_slots; ++i) {
+    Slot* s = &table[i];
+    if (s->state == kCreated && pid_dead(s->owner_pid)) {
+      release_slot(h, s);
+      ++evicted;
+    }
+  }
   while (hd->used_bytes + align_up(needed) > hd->data_size) {
     Slot* victim = nullptr;
-    Slot* table = slots(h);
     for (uint64_t i = 0; i < hd->n_slots; ++i) {
       Slot* s = &table[i];
       if (s->state == kSealed && s->pins == 0 &&
@@ -380,20 +396,27 @@ uint64_t shm_create(void* vh, const uint8_t* id, uint64_t size) {
   s->state = kCreated;
   s->pins = 1;  // creator holds a pin until seal
   s->lru_tick = hd->lru_clock++;
+  s->owner_pid = (uint64_t)getpid();
   hd->num_objects++;
   return hd->data_off + off;
 }
 
-// Seal: object becomes immutable + readable; drops the creator pin.
-int shm_seal(void* vh, const uint8_t* id) {
+// Seal: object becomes immutable + readable. keep_pin != 0 converts the
+// creator pin into a primary-copy pin (owner releases it via shm_unpin when
+// the object goes out of scope), so eviction can never drop the only copy
+// of a live object (reference pins primary copies the same way,
+// local_object_manager.h).
+int shm_seal2(void* vh, const uint8_t* id, int keep_pin) {
   Handle* h = static_cast<Handle*>(vh);
   Locker lock(h);
   Slot* s = find_slot(h, id);
   if (!s || s->state != kCreated) return -1;
   s->state = kSealed;
-  if (s->pins > 0) s->pins--;
+  if (!keep_pin && s->pins > 0) s->pins--;
   return 0;
 }
+
+int shm_seal(void* vh, const uint8_t* id) { return shm_seal2(vh, id, 0); }
 
 // Look up a sealed object. On success returns absolute offset, fills *size,
 // and pins the object if pin != 0. Returns 0 if absent/unsealed.

@@ -347,6 +347,9 @@ class Controller:
                     if time.monotonic() > deadline:
                         raise RuntimeError(
                             f"actor worker lease failed: {lease['error']}")
+                    # PG-bundle leases skip pick_node, so back off here too —
+                    # otherwise this loop busy-spins RPCs at a busy node.
+                    time.sleep(0.2)
                     continue
                 worker_addr = tuple(lease["addr"])
                 reply = self._clients.get(worker_addr).call(

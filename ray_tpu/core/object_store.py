@@ -20,13 +20,15 @@ from ray_tpu.core.ids import ObjectID
 
 
 class _Entry:
-    __slots__ = ("event", "data", "shm_ref", "shm_view", "error", "freed")
+    __slots__ = ("event", "data", "shm_ref", "shm_view", "shm_pin", "error",
+                 "freed")
 
     def __init__(self):
         self.event = threading.Event()
         self.data: Optional[bytes] = None      # serialized frame (inline path)
         self.shm_ref = None                    # shm locator dict (shm path)
         self.shm_view = None                   # pinned local ShmView, if open
+        self.shm_pin = None                    # owner's primary-copy pin
         self.error: Optional[BaseException] = None  # submission-level failure
         self.freed = False
 
@@ -97,6 +99,9 @@ class MemoryStore:
             if entry.shm_view is not None:
                 entry.shm_view.release()
                 entry.shm_view = None
+            if entry.shm_pin is not None:
+                entry.shm_pin.release()
+                entry.shm_pin = None
             entry.freed = True
             entry.event.set()
 

@@ -152,7 +152,12 @@ class CoreWorker:
             from ray_tpu.core.node import shm_store_path
 
             store = self._open_shm(shm_store_path(self.node_id))
-            if store.put_bytes(oid.binary(), frame):
+            # Owner holds the primary-copy pin until free: without it, LRU
+            # eviction under allocation pressure could drop the only copy of
+            # a live object (ObjectLostError on a later get).
+            pin = store.put_bytes(oid.binary(), frame, pin=True)
+            if pin is not None:
+                self.store._entry(oid).shm_pin = pin
                 return self._shm_locator(oid)
         except OSError:
             pass
@@ -578,6 +583,9 @@ class TaskSubmitter:
                         excluded.append(picked_node_id)
                     if time.monotonic() > deadline:
                         raise RayTpuError(f"worker lease failed: {lease['error']}")
+                    # PG-bundle leases don't go through the pick_node backoff
+                    # above; sleep here so a busy node isn't RPC-hammered.
+                    time.sleep(0.2)
                     continue
                 worker_id, worker_addr = lease["worker_id"], lease["addr"]
                 # 4. Direct push to the leased worker.

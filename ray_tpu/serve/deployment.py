@@ -127,7 +127,7 @@ class _DeploymentState:
         self.deployment = deployment
         self.cls_blob = serialization.dumps_function(deployment.cls)
         self.replicas: List[Any] = []
-        self.inflight: Dict[int, int] = {}  # replica index -> count
+        self.inflight: Dict[int, int] = {}  # id(replica actor) -> count
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="serve-router")
@@ -147,15 +147,14 @@ class _DeploymentState:
             self.deployment._init_kwargs)
         with self._lock:
             self.replicas.append(actor)
-            self.inflight[len(self.replicas) - 1] = 0
+            self.inflight[id(actor)] = 0
 
     def _remove_replica(self) -> None:
         with self._lock:
             if len(self.replicas) <= 1:
                 return
-            idx = len(self.replicas) - 1
-            actor = self.replicas.pop(idx)
-            self.inflight.pop(idx, None)
+            actor = self.replicas.pop()
+            self.inflight.pop(id(actor), None)
         try:
             ray_tpu.kill(actor)
         except Exception:
@@ -163,34 +162,41 @@ class _DeploymentState:
 
     # ------------------------------------------------------------ routing
 
-    def _pick_replica(self) -> int:
+    def _acquire_replica(self):
         """Power-of-two-choices on client-side in-flight counts
-        (pow_2_scheduler.py:49)."""
+        (pow_2_scheduler.py:49). Pick + increment happen under one lock
+        acquisition, and inflight is keyed by replica identity, so a
+        concurrent scale-down can't shift indices underneath a request."""
         with self._lock:
             n = len(self.replicas)
             if n == 1:
-                return 0
-            a, b = random.sample(range(n), 2)
-            return a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) \
-                else b
+                actor = self.replicas[0]
+            else:
+                a, b = random.sample(range(n), 2)
+                ca = self.inflight.get(id(self.replicas[a]), 0)
+                cb = self.inflight.get(id(self.replicas[b]), 0)
+                actor = self.replicas[a if ca <= cb else b]
+            self.inflight[id(actor)] = self.inflight.get(id(actor), 0) + 1
+            return actor
+
+    def _release_replica(self, actor) -> None:
+        with self._lock:
+            key = id(actor)
+            if key in self.inflight:
+                self.inflight[key] = max(0, self.inflight[key] - 1)
 
     def submit(self, method: str, args: tuple, kwargs: dict) -> Future:
         fut: Future = Future()
 
         def run():
-            idx = self._pick_replica()
-            with self._lock:
-                self.inflight[idx] = self.inflight.get(idx, 0) + 1
-                actor = self.replicas[idx]
+            actor = self._acquire_replica()
             try:
                 ref = actor.handle_request.remote(method, args, kwargs)
                 fut.set_result(ray_tpu.get(ref))
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
             finally:
-                with self._lock:
-                    self.inflight[idx] = max(
-                        0, self.inflight.get(idx, 1) - 1)
+                self._release_replica(actor)
 
         self._pool.submit(run)
         return fut

@@ -29,18 +29,25 @@ class _GroupStore:
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._rounds: Dict[tuple, Dict[int, Any]] = {}
+        self._reads: Dict[tuple, set] = {}
 
     def put(self, op: str, round_id: int, rank: int, value) -> None:
         self._rounds.setdefault((op, round_id), {})[rank] = value
 
-    def gather(self, op: str, round_id: int):
-        entries = self._rounds.get((op, round_id), {})
+    def gather(self, op: str, round_id: int, rank: int):
+        key = (op, round_id)
+        entries = self._rounds.get(key, {})
         if len(entries) < self.world_size:
             return None
-        return [entries[r] for r in range(self.world_size)]
-
-    def clear(self, op: str, round_id: int) -> None:
-        self._rounds.pop((op, round_id), None)
+        result = [entries[r] for r in range(self.world_size)]
+        # Only clear a round once every rank has read it — a rank-0-side
+        # clear.remote() raced slower ranks' polls and made them time out.
+        reads = self._reads.setdefault(key, set())
+        reads.add(rank)
+        if len(reads) == self.world_size:
+            self._rounds.pop(key, None)
+            self._reads.pop(key, None)
+        return result
 
 
 class CollectiveGroup:
@@ -63,10 +70,9 @@ class CollectiveGroup:
         ray_tpu.get(self._store.put.remote(op, round_id, self.rank, value))
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            gathered = ray_tpu.get(self._store.gather.remote(op, round_id))
+            gathered = ray_tpu.get(
+                self._store.gather.remote(op, round_id, self.rank))
             if gathered is not None:
-                if self.rank == 0:
-                    self._store.clear.remote(op, round_id)
                 return gathered
             time.sleep(0.005)
         raise TimeoutError(f"collective {op} round {round_id} timed out")
