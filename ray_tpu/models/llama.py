@@ -49,8 +49,8 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    # Attention implementation: "xla" | "chunked" | "ring" (ring requires a
-    # seq-sharded mesh context).
+    # Attention implementation: "xla" | "chunked" | "flash" (fused Pallas
+    # kernel) | "ring" (requires a seq-sharded mesh context).
     attention_impl: str = "xla"
     remat: bool = True
     # Remat policy: "full" recomputes everything (min memory); "dots" saves
@@ -184,6 +184,10 @@ def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
             raise ValueError("attention_impl='ring' requires an axis_rules "
                              "context with a seq-sharded mesh")
         attn = ring_attention(q, k, v, mesh)
+    elif c.attention_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True, q_offset=q_offset)
     else:
         attn = attention(q, k, v, causal=True, q_offset=q_offset,
                          impl=c.attention_impl)

@@ -1,0 +1,417 @@
+"""Pallas TPU flash attention: fused, tiled, O(S) memory, custom VJP.
+
+The TPU-native replacement for the flash/splash attention kernels the
+reference world gets from CUDA libraries (its integrations defer to torch
+SDPA; SURVEY §5.7 requires the TPU build to make these kernels first-class).
+Design, per the Pallas TPU playbook:
+
+* Layout (B, H, S, D): the (S, D) minor tile maps q/k/v blocks straight onto
+  (sublane, lane) tiling; D is padded to a lane multiple (128) when needed.
+* Forward: online-softmax over KV tiles with fp32 accumulators in VMEM
+  scratch; emits the log-sum-exp alongside the output so the backward can
+  recompute probabilities without ever materializing the (S, S) score
+  matrix.
+* Backward: two kernels with flash-attention-2 style recomputation — one
+  accumulates dK/dV (grid minor axis = query tiles), one accumulates dQ
+  (grid minor axis = KV tiles). ``delta = rowsum(dO * O)`` is a cheap
+  elementwise pass left to XLA.
+* Causal masking by tile arithmetic: fully-masked tiles are skipped with
+  ``pl.when`` (no compute, only the pipelined fetch), partial tiles mask
+  in-register. ``q_offset`` shifts the causal frontier so ring attention /
+  decode reuse the same kernel per shard.
+* GQA: the KV head for a query head is selected in the BlockSpec index map
+  (``h // group``) — the repeat never materializes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on pure-CPU builds)
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_spec(block_shape, index_map):
+    if _VMEM is None:
+        return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+
+
+def _scratch(shape, dtype):
+    if pltpu is None:
+        return pl.MemoryRef(shape, dtype) if hasattr(pl, "MemoryRef") else None
+    return pltpu.VMEM(shape, dtype)
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, block_q, block_k, causal, q_offset):
+    i = pl.program_id(2)  # query tile
+    j = pl.program_id(3)  # kv tile
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Tile-level causal skip: tile is live unless its every (row, col) has
+    # row < col. Rows start at q_offset + i*block_q, cols at j*block_k.
+    row_max = q_offset + i * block_q + block_q - 1
+    col_min = j * block_k
+    live = jnp.logical_or(not causal, row_max >= col_min)
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0]  # (block_q, D)
+        k = k_ref[0, 0]  # (block_k, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(s - m_safe)
+        corr = jnp.exp(jnp.maximum(m_prev, _NEG_INF / 2) - m_safe)
+        l_ref[:, 0:1] = l_ref[:, 0:1] * corr + jnp.sum(p, axis=-1,
+                                                       keepdims=True)
+        m_ref[:, 0:1] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+
+    @pl.when(j == nk - 1)
+    def _final():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        m = m_ref[:, 0:1]
+        lse = jnp.where(
+            l == 0.0, _NEG_INF,
+            jnp.maximum(m, _NEG_INF / 2) + jnp.log(l_safe))
+        lse_ref[0, 0] = lse[:, 0]
+
+
+def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    group = h // hkv
+    nq, nk = sq // block_q, sk // block_k
+    grid = (b, h, nq, nk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, q_offset=q_offset)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _block_spec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+        ],
+        out_specs=[
+            _block_spec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, d), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------- backward
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, block_q, block_k, causal, q_offset):
+    i = pl.program_id(3)  # query tile (minor)
+    j = pl.program_id(2)  # kv tile
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    row_max = q_offset + i * block_q + block_q - 1
+    col_min = j * block_k
+    live = jnp.logical_or(not causal, row_max >= col_min)
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0]          # (bq, D)
+        k = k_ref[0, 0]          # (bk, D)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]        # (bq, D)
+        lse = lse_ref[0, 0]      # (bq,)
+        delta = delta_ref[0, 0]  # (bq,)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - jnp.maximum(lse, _NEG_INF / 2)[:, None])  # (bq, bk)
+        # dV += P^T dO
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO V^T ; dS = P * (dP - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        # dK += dS^T Q
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == ni - 1)
+    def _final():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc,
+                   *, scale, block_q, block_k, causal, q_offset):
+    i = pl.program_id(2)  # query tile
+    j = pl.program_id(3)  # kv tile (minor)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    row_max = q_offset + i * block_q + block_q - 1
+    col_min = j * block_k
+    live = jnp.logical_or(not causal, row_max >= col_min)
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - jnp.maximum(lse, _NEG_INF / 2)[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale)
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    group = h // hkv
+    nq, nk = sq // block_q, sk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # (B, H, S)
+
+    # dK/dV: one (b, kv-head, kv-tile) program accumulates over all query
+    # tiles of every query head in the group (GQA reduction folded into the
+    # grid's minor axis).
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, q_offset=q_offset)
+    grid_dkv = (b, h, nk, nq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=grid_dkv,
+        in_specs=[
+            _block_spec((1, 1, block_q, d),
+                        lambda b_, h_, j, i: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
+            _block_spec((1, 1, block_q, d),
+                        lambda b_, h_, j, i: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_q), lambda b_, h_, j, i: (b_, h_, i)),
+            _block_spec((1, 1, block_q), lambda b_, h_, j, i: (b_, h_, i)),
+        ],
+        out_specs=[
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, j, i: (b_, h_, j, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_k, d), jnp.float32),
+            _scratch((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    if group > 1:
+        dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2)
+    dk = dk.astype(k.dtype)
+    dv = dv.astype(v.dtype)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, q_offset=q_offset)
+    grid_dq = (b, h, nq, nk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=grid_dq,
+        in_specs=[
+            _block_spec((1, 1, block_q, d),
+                        lambda b_, h_, i, j: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            _block_spec((1, 1, block_k, d),
+                        lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            _block_spec((1, 1, block_q, d),
+                        lambda b_, h_, i, j: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+            _block_spec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+        ],
+        out_specs=[
+            _block_spec((1, 1, block_q, d),
+                        lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)],
+        scratch_shapes=[_scratch((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)[0]
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, q_offset, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, q_offset, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, q_offset, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, q_offset, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, q_offset, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, g, scale, causal, q_offset,
+                      block_q, block_k)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,                # (B, S, Hq, D)
+    k: jax.Array,                # (B, S, Hkv, D)
+    v: jax.Array,                # (B, S, Hkv, D)
+    causal: bool = True,
+    q_offset: int = 0,
+    block_q: int = 256,
+    block_k: int = 256,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Flash attention over (batch, seq, heads, head_dim) tensors.
+
+    Drop-in for ``ray_tpu.ops.attention.attention`` (same signature shape);
+    differentiable via the fused Pallas backward.
+    """
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = d ** -0.5
+
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {sk}) must divide blocks ({block_q}, "
+            f"{block_k})")
+
+    # (B, S, H, D) -> (B, H, S, D): puts (S, D) on the (sublane, lane) tile.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    # Lane-align head_dim (zero-pad is exact: scores unchanged, padded
+    # output columns are sliced off).
+    d_pad = (-d) % 128
+    if d_pad:
+        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
+        qt = jnp.pad(qt, pad)
+        kt = jnp.pad(kt, pad)
+        vt = jnp.pad(vt, pad)
+
+    out = _flash(qt, kt, vt, scale, causal, q_offset, block_q, block_k)
+    if d_pad:
+        out = out[..., :d]
+    return out.transpose(0, 2, 1, 3)

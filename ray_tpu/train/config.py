@@ -22,6 +22,9 @@ class ScalingConfig:
     use_tpu: bool = False
     tpu_chips_per_worker: int = 0
     placement_strategy: str = "PACK"
+    # Multi-host mesh formation (jax.distributed bootstrap across the
+    # worker gang); see ray_tpu.train.jax_backend.JaxConfig.
+    jax_config: Optional[Any] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)

@@ -100,7 +100,7 @@ class JaxTrainer:
                      history: List[Dict[str, Any]]) -> Result:
         sc = self.scaling_config
         group = WorkerGroup(sc.num_workers, sc.worker_resources(),
-                            sc.placement_strategy)
+                            sc.placement_strategy, jax_config=sc.jax_config)
         try:
             group.start(self.run_config.storage_path, self._name,
                         latest_checkpoint)

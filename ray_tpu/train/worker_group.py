@@ -84,12 +84,30 @@ class TrainWorker:
     def ping(self) -> str:
         return "pong"
 
+    # ------------------------------------------------- jax.distributed
+
+    def reserve_coordinator(self, port: int = 0) -> str:
+        """Rank 0: pick the coordinator address for the group."""
+        from ray_tpu.train.jax_backend import pick_coordinator_address
+
+        return pick_coordinator_address(port)
+
+    def init_jax_distributed(self, coordinator: str, num_processes: int,
+                             process_id: int, platform, local_devices) -> int:
+        from ray_tpu.train.jax_backend import init_process
+
+        n = init_process(coordinator, num_processes, process_id, platform,
+                         local_devices)
+        self._session.world.coordinator = coordinator
+        return n
+
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK", jax_config=None):
         self.num_workers = num_workers
         self.resources = dict(resources_per_worker)
+        self.jax_config = jax_config
         self.pg: PlacementGroup = placement_group(
             [dict(self.resources) for _ in range(num_workers)],
             strategy=placement_strategy)
@@ -112,6 +130,27 @@ class WorkerGroup:
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     self.pg, rank),
             ).remote(world, storage_path, experiment_name, latest_checkpoint))
+        if self.jax_config is not None and self.jax_config.distributed:
+            self._bootstrap_jax()
+
+    def _bootstrap_jax(self) -> None:
+        """Form ONE global jax runtime across the gang: rank 0 hosts the
+        coordinator, every worker joins with its process index, and the
+        resulting ``jax.devices()`` spans the group (reference analogue:
+        BackendExecutor + _setup_torch_process_group,
+        train/torch/config.py:65-170)."""
+        jc = self.jax_config
+        coordinator = ray_tpu.get(
+            self.workers[0].reserve_coordinator.remote(jc.coordinator_port))
+        counts = ray_tpu.get([
+            w.init_jax_distributed.remote(coordinator, self.num_workers,
+                                          rank, jc.platform,
+                                          jc.local_device_count)
+            for rank, w in enumerate(self.workers)
+        ], timeout=120.0)
+        if len(set(counts)) != 1:
+            raise ray_tpu.RayTpuError(
+                f"inconsistent global device counts across workers: {counts}")
 
     def run(self, train_fn: Callable, config: Optional[Dict]) -> None:
         fn_blob = serialization.dumps_function(train_fn)
