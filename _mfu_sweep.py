@@ -1,56 +1,86 @@
-import dataclasses, time, gc
-import jax, optax
+"""MFU sweep with honest timing: K steps inside one jitted+donated scan,
+bracketed by a host fetch (block_until_ready under-reports on tunneled
+backends; a scalar fetch forces real completion)."""
+
+import dataclasses
+import functools
+import time
+
+import jax
+import optax
+
 from ray_tpu.models import llama
 from ray_tpu.parallel import train_step as ts
 from ray_tpu.parallel.mesh import MeshSpec
+from ray_tpu.parallel.sharding import axis_rules
 from ray_tpu.tpu import peak_flops_per_chip
 
-base = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=16,
-                         n_kv_heads=16, mlp_dim=5120, max_seq_len=2048)
 mesh = MeshSpec(fsdp=-1).build()
-peak = peak_flops_per_chip()
+PEAK = peak_flops_per_chip(getattr(jax.devices()[0], "device_kind", ""))
+K = 8
 
-def try_one(cfg, batch, seq=2048, steps=8):
-    try:
-        params = ts.init_sharded_params(lambda k: llama.init_params(cfg, k),
-                                        llama.param_axes(), mesh, jax.random.key(0))
-        opt = optax.adamw(3e-4)
-        opt_state = ts.init_optimizer_state(opt, params)
-        step = ts.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh)
-        batch_data = ts.shard_batch({"tokens": jax.random.randint(
-            jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)}, mesh)
-        params, opt_state, m = step(params, opt_state, batch_data)
-        jax.block_until_ready(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, m = step(params, opt_state, batch_data)
-        jax.block_until_ready(m["loss"])
-        dt = (time.perf_counter() - t0) / steps
-        del params, opt_state, batch_data
-        gc.collect()
-        tps = batch * seq / dt
-        mfu = 100 * tps * llama.flops_per_token(cfg, seq) / peak
-        return round(mfu, 2), round(tps)
-    except Exception as e:
-        gc.collect()
-        return None, str(type(e).__name__)
 
-ce = dataclasses.replace(base, loss_chunk=512)
-dots = dataclasses.replace(base, loss_chunk=512, remat_policy="dots")
-nore = dataclasses.replace(base, loss_chunk=512, remat=False)
-one_b = dataclasses.replace(llama.PRESETS["1b"], max_seq_len=2048,
-                            loss_chunk=512)
-one_b_dots = dataclasses.replace(one_b, remat_policy="dots")
-for desc, cfg, batch in [
-    ("ce b8", ce, 8),
-    ("ce b16", ce, 16),
-    ("ce+dots b8", dots, 8),
-    ("ce+dots b16", dots, 16),
-    ("ce+noremat b8", nore, 8),
-    ("ce+dots b12", dots, 12),
-    ("1b ce b8", one_b, 8),
-    ("1b ce+dots b8", one_b_dots, 8),
-    ("1b ce b4", one_b, 4),
-]:
-    mfu, tps = try_one(cfg, batch)
-    print(f"{desc:22s} -> MFU {mfu} ({tps})", flush=True)
+def run(cfg, batch, seq=2048):
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    params = ts.init_sharded_params(lambda k: llama.init_params(cfg, k),
+                                    llama.param_axes(), mesh,
+                                    jax.random.key(0))
+    opt_state = ts.init_optimizer_state(opt, params)
+
+    def body(carry, tokens):
+        p, o = carry
+        with axis_rules(mesh):
+            loss, grads = jax.value_and_grad(
+                lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg))(p)
+            updates, o2 = opt.update(grads, o, p)
+            p2 = optax.apply_updates(p, updates)
+        return (p2, o2), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def multi(params, opt_state, toks):
+        (p, o), losses = jax.lax.scan(body, (params, opt_state), toks)
+        return p, o, losses
+
+    toks = ts.shard_batch(
+        {"t": jax.random.randint(jax.random.key(1), (K, batch, seq + 1), 0,
+                                 cfg.vocab_size)}, mesh)["t"]
+    params, opt_state, losses = multi(params, opt_state, toks)
+    _ = float(losses[-1])
+    t0 = time.perf_counter()
+    params, opt_state, losses = multi(params, opt_state, toks)
+    _ = float(losses[-1])
+    dt = (time.perf_counter() - t0) / K
+    tps = batch * seq / dt
+    mfu = 100 * tps * llama.flops_per_token(cfg, seq) / PEAK
+    return round(mfu, 2), round(tps), round(dt * 1000, 1)
+
+
+d1152 = llama.LlamaConfig(vocab_size=32000, dim=1152, n_layers=24, n_heads=9,
+                          n_kv_heads=9, mlp_dim=4608, max_seq_len=2048)
+d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
+                          n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
+
+CONFIGS = [
+    ("d1152 xla full b8", d1152, 8),
+    ("d1152 flash full b8",
+     dataclasses.replace(d1152, attention_impl="flash"), 8),
+    ("d1152 flash dots b8",
+     dataclasses.replace(d1152, attention_impl="flash",
+                         remat_policy="dots"), 8),
+    ("d1152 flash dots ce512 b16",
+     dataclasses.replace(d1152, attention_impl="flash", remat_policy="dots",
+                         loss_chunk=512), 16),
+    ("d1152 flash full ce512 b16",
+     dataclasses.replace(d1152, attention_impl="flash", loss_chunk=512), 16),
+    ("d1280 flash dots ce512 b8",
+     dataclasses.replace(d1280, attention_impl="flash", remat_policy="dots",
+                         loss_chunk=512), 8),
+]
+
+if __name__ == "__main__":
+    for desc, cfg, b in CONFIGS:
+        try:
+            print(desc, run(cfg, b),
+                  f"params={cfg.num_params()/1e6:.0f}M", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(desc, "FAIL", str(e)[:100].replace("\n", " "), flush=True)

@@ -55,6 +55,31 @@ _FLAG_DEFS: Dict[str, tuple] = {
     "event_buffer_max": (int, 10000,
         "Max buffered task state-transition events per worker (reference: "
         "TaskEventBuffer, task_event_buffer.h:206)."),
+    "object_transfer_chunk_bytes": (int, 8 * 1024 * 1024,
+        "Node-to-node object pulls move in chunks of this size (reference: "
+        "object_manager_default_chunk_size, object_manager.h:117)."),
+    "max_pull_bytes_in_flight": (int, 256 * 1024 * 1024,
+        "Admission control: per-process cap on chunk bytes concurrently in "
+        "flight for remote object pulls (reference: PullManager's "
+        "num_bytes_available budget, pull_manager.h:52)."),
+    "object_spill_dir": (str, "/tmp/ray_tpu_spill",
+        "Directory for objects spilled to disk when the shared-memory store "
+        "is full (reference: local_object_manager.h:110 spill-to-fs)."),
+    "ref_counting_enabled": (bool, True,
+        "Automatic object lifetimes: ObjectRef handles are tracked per "
+        "process and reported to owners; objects free when the cluster-wide "
+        "handle count drops to zero (reference: reference_count.h:61)."),
+    "ref_free_grace_s": (float, 2.0,
+        "An owner frees a zero-refcount object only after it has stayed at "
+        "zero this long (absorbs in-flight handle registrations)."),
+    "ref_flush_interval_s": (float, 0.2,
+        "Batched ref-count updates flush to owners at this period."),
+    "max_lineage_entries": (int, 10000,
+        "Owner-kept task lineage entries for object reconstruction "
+        "(reference: max_lineage_bytes, task_manager.h:215)."),
+    "reconstruction_max_attempts": (int, 3,
+        "How many times a lost object's producing task is re-executed "
+        "(reference: object_recovery_manager.h:41)."),
 }
 
 

@@ -41,6 +41,17 @@ def shm_store_path(node_id: NodeID) -> str:
                         f"{node_id.hex()}.store")
 
 
+def spill_dir(node_id: NodeID) -> str:
+    """Per-node directory for objects spilled to disk when the shm store is
+    full (reference: ``local_object_manager.h:110`` spill-to-filesystem; one
+    dir per node keeps the multi-node-in-one-machine fixture honest)."""
+    return os.path.join(config.object_spill_dir, node_id.hex())
+
+
+def spill_file(node_id: NodeID, oid_bytes: bytes) -> str:
+    return os.path.join(spill_dir(node_id), oid_bytes.hex() + ".bin")
+
+
 def _kill_and_reap(proc: subprocess.Popen, force: bool) -> None:
     """Kill a worker process and reap it so no zombie lingers in the
     (long-lived) driver process hosting this node supervisor."""
@@ -135,6 +146,8 @@ class Node:
                 "reserve_bundle": self.reserve_bundle,
                 "release_bundle": self.release_bundle,
                 "read_shm_object": self.read_shm_object,
+                "read_shm_chunk": self.read_shm_chunk,
+                "free_shm_object": self.free_shm_object,
                 "get_info": self.get_info,
                 "ping": lambda: "pong",
             },
@@ -404,17 +417,58 @@ class Node:
                 self._drain_waiters_locked()
 
     def read_shm_object(self, oid_bytes: bytes) -> Optional[bytes]:
-        """Serve an object from this node's store to a remote reader — the
-        node-to-node transfer path (reference: ObjectManager Push/Pull,
-        object_manager.h:117; chunking omitted since frames ship whole over
-        the framed transport)."""
+        """Serve a whole object from this node's store (or its spill dir) to
+        a remote reader — the small-object node-to-node path (reference:
+        ObjectManager Push/Pull, object_manager.h:117). Large objects go
+        through read_shm_chunk."""
         view = self._shm.get_view(oid_bytes)
-        if view is None:
-            return None
+        if view is not None:
+            try:
+                return bytes(view.data)
+            finally:
+                view.release()
+        return self._read_spill(oid_bytes)
+
+    def read_shm_chunk(self, oid_bytes: bytes, offset: int,
+                       length: int) -> Optional[Tuple[int, bytes]]:
+        """Chunked node-to-node transfer: returns (total_size, chunk bytes)
+        for the requested range, or None when the object is gone (evicted and
+        not spilled). The object is pinned only for the duration of the copy,
+        so a many-chunk pull never wedges eviction (reference: 64 MiB chunked
+        pulls, object_manager.h:117 / pull_manager.h:52)."""
+        view = self._shm.get_view(oid_bytes)
+        if view is not None:
+            try:
+                total = len(view.data)
+                return total, bytes(view.data[offset:offset + length])
+            finally:
+                view.release()
+        path = spill_file(self.node_id, oid_bytes)
         try:
-            return bytes(view.data)
-        finally:
-            view.release()
+            total = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return total, f.read(length)
+        except OSError:
+            return None
+
+    def _read_spill(self, oid_bytes: bytes) -> Optional[bytes]:
+        try:
+            with open(spill_file(self.node_id, oid_bytes), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def free_shm_object(self, oid_bytes: bytes) -> None:
+        """Owner-driven free: reclaim the object's store slot and any spill
+        file (reference: FreeObjects in node_manager.proto; with automatic
+        ref counting the owner calls this when the cluster-wide handle count
+        hits zero)."""
+        self._shm.delete(oid_bytes)
+        try:
+            os.unlink(spill_file(self.node_id, oid_bytes))
+        except OSError:
+            pass
 
     def get_info(self) -> Dict[str, Any]:
         with self._lock:
@@ -446,3 +500,6 @@ class Node:
             os.unlink(self.store_path)
         except OSError:
             pass
+        import shutil
+
+        shutil.rmtree(spill_dir(self.node_id), ignore_errors=True)

@@ -10,19 +10,164 @@ address so any process can resolve it without a central directory.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import threading
+from typing import Dict, Optional, Tuple
 
 from ray_tpu.core.ids import ObjectID
 
 Addr = Tuple[str, int]
 
 
+class _RefTracker:
+    """Per-process ObjectRef handle tracker (the distributed-ref-counting
+    client half; reference: ``src/ray/core_worker/reference_count.h:61``).
+
+    Counts live ``ObjectRef`` instances per (owner, object). When a process's
+    count for an object goes 0 -> 1 it reports +1 to the owner; 1 -> 0
+    reports -1 (so the owner's count is "number of processes holding
+    handles"). Updates are batched and flushed by a daemon thread — the
+    owner-side free grace period absorbs the flush latency. On a local
+    1 -> 0 for a *borrowed* object the borrower also drops its cached copy,
+    releasing the pinned shm view."""
+
+    _instance: Optional["_RefTracker"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        import collections
+
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[Addr, bytes], int] = {}
+        self._dirty: Dict[Addr, Dict[bytes, int]] = {}
+        # Decrements from __del__ land here WITHOUT taking any lock: a
+        # destructor can fire from the GC in the middle of a thread that
+        # already holds self._lock (deque.append is atomic under the GIL).
+        self._pending_decs = collections.deque()
+        self._send_failures: Dict[Addr, int] = {}
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="ref-tracker", daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get(cls) -> "_RefTracker":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def inc(self, owner: Addr, oid: bytes) -> None:
+        with self._lock:
+            key = (owner, oid)
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+            if n == 1:
+                d = self._dirty.setdefault(owner, {})
+                d[oid] = d.get(oid, 0) + 1
+
+    def dec(self, owner: Addr, oid: bytes) -> None:
+        """GC-safe: only enqueues; the flush thread does the bookkeeping."""
+        self._pending_decs.append((owner, oid))
+        self._wake.set()
+
+    def _drain_decs(self) -> None:
+        while True:
+            try:
+                owner, oid = self._pending_decs.popleft()
+            except IndexError:
+                return
+            drop_cache = False
+            with self._lock:
+                key = (owner, oid)
+                n = self._counts.get(key, 0) - 1
+                if n <= 0:
+                    self._counts.pop(key, None)
+                    d = self._dirty.setdefault(owner, {})
+                    d[oid] = d.get(oid, 0) - 1
+                    drop_cache = True
+                else:
+                    self._counts[key] = n
+            if drop_cache:
+                self._drop_borrower_cache(owner, oid)
+
+    def _drop_borrower_cache(self, owner: Addr, oid: bytes) -> None:
+        from ray_tpu.core import runtime
+
+        core = runtime._core_worker
+        if core is None or owner == core.addr:
+            return
+        try:
+            core.store.drop(ObjectID(oid))
+        except Exception:
+            pass
+
+    def _flush_loop(self) -> None:
+        from ray_tpu.core.config import config
+
+        while True:
+            self._wake.wait(config.ref_flush_interval_s)
+            self._wake.clear()
+            self.flush()
+
+    def flush(self) -> None:
+        from ray_tpu.core import runtime
+
+        self._drain_decs()
+        with self._lock:
+            dirty, self._dirty = self._dirty, {}
+        core = runtime._core_worker
+        if core is None:
+            return
+        for owner, deltas in dirty.items():
+            # Net-zero deltas still ship: a ref born and dropped inside one
+            # flush window must mark the object as touched-then-released on
+            # the owner, or it would never become sweepable.
+            if not deltas:
+                continue
+            try:
+                if owner == core.addr:
+                    core.apply_ref_updates(deltas)
+                else:
+                    core.clients.get(owner).notify("ref_update", deltas)
+                self._send_failures.pop(owner, None)
+            except Exception:
+                # Transient failure: merge the deltas back for retry; a
+                # dropped +1/-1 would silently corrupt the owner's count.
+                # After repeated failures the owner is dead — its objects
+                # die with it, so the deltas can be abandoned.
+                fails = self._send_failures.get(owner, 0) + 1
+                self._send_failures[owner] = fails
+                if fails <= 25:
+                    with self._lock:
+                        d = self._dirty.setdefault(owner, {})
+                        for oid, delta in deltas.items():
+                            d[oid] = d.get(oid, 0) + delta
+
+
+def _tracking_enabled() -> bool:
+    from ray_tpu.core.config import config
+
+    return config.ref_counting_enabled
+
+
 class ObjectRef:
-    __slots__ = ("id", "owner_addr", "_weakly_referenced", "__weakref__")
+    __slots__ = ("id", "owner_addr", "_tracked", "_weakly_referenced",
+                 "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_addr: Optional[Addr] = None):
         self.id = object_id
         self.owner_addr = tuple(owner_addr) if owner_addr else None
+        self._tracked = False
+        if self.owner_addr is not None and _tracking_enabled():
+            _RefTracker.get().inc(self.owner_addr, object_id.binary())
+            self._tracked = True
+
+    def __del__(self):
+        if getattr(self, "_tracked", False):
+            try:
+                _RefTracker.get().dec(self.owner_addr, self.id.binary())
+            except Exception:
+                pass
 
     def hex(self) -> str:
         return self.id.hex()
@@ -40,6 +185,9 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
+        from ray_tpu.core import serialization
+
+        serialization.record_serialized_ref(self)
         return (ObjectRef, (self.id, self.owner_addr))
 
     def future(self):

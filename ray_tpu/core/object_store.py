@@ -21,7 +21,7 @@ from ray_tpu.core.ids import ObjectID
 
 class _Entry:
     __slots__ = ("event", "data", "shm_ref", "shm_view", "shm_pin", "error",
-                 "freed")
+                 "freed", "owned", "refcount", "zero_since", "nested")
 
     def __init__(self):
         self.event = threading.Event()
@@ -31,6 +31,13 @@ class _Entry:
         self.shm_pin = None                    # owner's primary-copy pin
         self.error: Optional[BaseException] = None  # submission-level failure
         self.freed = False
+        self.owned = False        # True: this process owns the object
+        self.refcount = 0         # cluster-wide handle count (owner-side)
+        self.zero_since: Optional[float] = None  # when refcount hit <= 0
+        # ObjectRefs nested inside this entry's serialized frame: held as
+        # live handles so the inner objects can't be freed while the frame
+        # is alive (cleared on free/drop).
+        self.nested = None
 
 
 class MemoryStore:
@@ -47,7 +54,65 @@ class MemoryStore:
             return entry
 
     def create_pending(self, oid: ObjectID) -> None:
-        self._entry(oid)
+        self._entry(oid).owned = True
+
+    def reset_pending(self, oid: ObjectID) -> None:
+        """Re-arm an entry for reconstruction: the producing task will be
+        re-executed and fulfil it again (reference:
+        object_recovery_manager.h:96 resubmit path)."""
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None:
+                entry = _Entry()
+                self._entries[oid] = entry
+            entry.owned = True
+            entry.data = None
+            entry.shm_ref = None
+            if entry.shm_view is not None:
+                entry.shm_view.release()
+                entry.shm_view = None
+            if entry.shm_pin is not None:
+                entry.shm_pin.release()
+                entry.shm_pin = None
+            entry.error = None
+            entry.freed = False
+            entry.nested = None
+            entry.event.clear()
+
+    def apply_ref_update(self, oid: ObjectID, delta: int) -> None:
+        """Owner-side handle-count update from a borrower process (or this
+        process's own tracker)."""
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None:
+                if delta <= 0:
+                    return
+                entry = _Entry()
+                self._entries[oid] = entry
+            entry.refcount += delta
+            # delta == 0 means a handle lived and died within one tracker
+            # flush window: the touch still (re)arms the zero clock.
+            if entry.refcount <= 0:
+                if entry.zero_since is None:
+                    entry.zero_since = time.monotonic()
+            else:
+                entry.zero_since = None
+
+    def sweep_dead_refs(self, grace_s: float):
+        """Collect owned, ready objects whose handle count has been zero for
+        longer than ``grace_s``. Returns the freed entries' (oid, shm_ref)
+        pairs so the caller can propagate the free to the node store."""
+        now = time.monotonic()
+        victims = []
+        with self._lock:
+            for oid, entry in list(self._entries.items()):
+                if (entry.owned and not entry.freed
+                        and entry.refcount <= 0
+                        and entry.zero_since is not None
+                        and now - entry.zero_since > grace_s
+                        and entry.event.is_set()):
+                    victims.append((oid, entry.shm_ref))
+        return victims
 
     def put_serialized(self, oid: ObjectID, data: bytes) -> None:
         entry = self._entry(oid)
@@ -89,6 +154,9 @@ class MemoryStore:
         entry.shm_ref = shm_ref
         entry.event.set()
 
+    def mark_owned(self, oid: ObjectID) -> None:
+        self._entry(oid).owned = True
+
     def free(self, oid: ObjectID) -> None:
         with self._lock:
             entry = self._entries.get(oid)
@@ -102,12 +170,48 @@ class MemoryStore:
             if entry.shm_pin is not None:
                 entry.shm_pin.release()
                 entry.shm_pin = None
+            entry.nested = None
             entry.freed = True
+            if entry.zero_since is None:
+                entry.zero_since = time.monotonic()
             entry.event.set()
+
+    def drop(self, oid: ObjectID) -> None:
+        """Release a borrower-cache entry entirely (pins, views, dict slot) so
+        a later get re-pulls from the owner. No-op for owned objects."""
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None or entry.owned:
+                return
+            if entry.shm_view is not None:
+                entry.shm_view.release()
+                entry.shm_view = None
+            if entry.shm_pin is not None:
+                entry.shm_pin.release()
+                entry.shm_pin = None
+            entry.nested = None
+            del self._entries[oid]
 
     def delete(self, oid: ObjectID) -> None:
         with self._lock:
             self._entries.pop(oid, None)
+
+    def set_nested(self, oid: ObjectID, refs) -> None:
+        if refs:
+            self._entry(oid).nested = list(refs)
+
+    def purge_freed(self, ttl_s: float) -> None:
+        """Remove long-freed tombstones. A freed object's cluster-wide count
+        was zero, so nothing should ask for it again; the tombstone only
+        exists to turn late (out-of-band) gets into ObjectFreedError rather
+        than a hang, and a TTL bounds that courtesy."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [oid for oid, e in self._entries.items()
+                    if e.freed and e.zero_since is not None
+                    and now - e.zero_since > ttl_s]
+            for oid in dead:
+                del self._entries[oid]
 
     def size(self) -> int:
         with self._lock:

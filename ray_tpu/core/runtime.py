@@ -103,12 +103,25 @@ class CoreWorker:
         self._current_task_desc = threading.local()
         self._shutdown = threading.Event()
 
+        # Owner-kept task lineage for object reconstruction: return oid ->
+        # shared record of the producing task (reference: task_manager.h:215
+        # lineage, object_recovery_manager.h:41).
+        self._lineage: Dict[ObjectID, Dict[str, Any]] = {}
+        self._lineage_lock = threading.Lock()
+        # Admission control for remote object pulls (reference: PullManager's
+        # memory budget, pull_manager.h:52): bounded chunk slots.
+        slots = max(1, config.max_pull_bytes_in_flight
+                    // config.object_transfer_chunk_bytes)
+        self._pull_slots = threading.BoundedSemaphore(slots)
+
         self.server = RpcServer(
             handlers={
                 "get_object": self._handle_get_object,
                 "wait_object": self._handle_wait_object,
                 "peek_object": self._handle_peek_object,
                 "free_object": self._handle_free_object,
+                "ref_update": self._handle_ref_update,
+                "reconstruct_object": self._handle_reconstruct,
                 "push_task": self._handle_push_task,
                 "start_actor": self._handle_start_actor,
                 "push_actor_task": self._handle_push_actor_task,
@@ -117,10 +130,14 @@ class CoreWorker:
             },
             name=f"{mode}-core",
             max_workers=128,
-            inline_methods={"peek_object", "free_object"},
+            inline_methods={"peek_object", "free_object", "ref_update"},
         )
         self.addr: Addr = self.server.addr
         self.submitter = TaskSubmitter(self)
+        if config.ref_counting_enabled:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="ref-sweeper", daemon=True)
+            self._sweeper.start()
 
     # -------------------------------------------------- shared-memory store
 
@@ -145,9 +162,11 @@ class CoreWorker:
         }
 
     def _try_put_shm(self, oid: ObjectID, frame: bytes) -> Optional[Dict]:
-        """Write a serialized frame into this node's store; returns the
-        locator, or None when the store is unavailable/full (caller falls
-        back to the inline path)."""
+        """Write a serialized frame into this node's store; falls back to the
+        node's spill directory when the store can't fit it (reference:
+        local_object_manager.h:110 spill-to-fs — here spilling happens at
+        write time because pinned primary copies are not evictable). Returns
+        the locator, or None only when both paths fail."""
         try:
             from ray_tpu.core.node import shm_store_path
 
@@ -161,38 +180,101 @@ class CoreWorker:
                 return self._shm_locator(oid)
         except OSError:
             pass
-        return None
+        return self._try_spill(oid, frame)
+
+    def _try_spill(self, oid: ObjectID, frame: bytes) -> Optional[Dict]:
+        """Write the frame to this node's spill dir and return a locator the
+        node's object server can resolve (read_shm_* check the spill dir)."""
+        try:
+            from ray_tpu.core.node import spill_dir, spill_file
+
+            os.makedirs(spill_dir(self.node_id), exist_ok=True)
+            path = spill_file(self.node_id, oid.binary())
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(frame)
+            os.rename(tmp, path)
+            loc = self._shm_locator(oid)
+            loc["spill"] = path
+            return loc
+        except OSError:
+            return None
 
     def _resolve_shm(self, locator: Dict[str, Any], cache_oid: ObjectID):
         """Resolve a locator to a frame buffer. Local node: a pinned
         zero-copy view (pin held by the store entry until freed — this is the
         'primary copy pinned' discipline that keeps numpy views into the
-        mmap valid). Remote node: fetch bytes via the node's object server."""
+        mmap valid), falling back to the spill file. Remote node: chunked
+        fetch via the node's object server with admission control."""
         if locator["node_id"] == self.node_id.binary():
-            store = self._open_shm(locator["path"])
-            view = store.get_view(locator["oid"])
-            if view is None:
+            try:
+                store = self._open_shm(locator["path"])
+                view = store.get_view(locator["oid"])
+            except OSError:  # store file gone (node supervisor died)
+                view = None
+            if view is not None:
+                entry = self.store._entry(cache_oid)
+                entry.shm_view = view
+                # Read-only: sealed objects are immutable (plasma
+                # semantics); numpy arrays deserialized over this buffer are
+                # zero-copy views and must not scribble on the mapping.
+                return view.data.toreadonly()
+            spill = locator.get("spill")
+            if spill is None:
+                from ray_tpu.core.node import spill_file
+
+                spill = spill_file(self.node_id, locator["oid"])
+            try:
+                with open(spill, "rb") as f:
+                    return f.read()
+            except OSError:
                 raise ObjectLostError(
-                    f"object {cache_oid.hex()} evicted from the local store")
-            entry = self.store._entry(cache_oid)
-            entry.shm_view = view
-            # Read-only: sealed objects are immutable (plasma semantics);
-            # numpy arrays deserialized over this buffer are zero-copy views
-            # and must not scribble on the shared mapping.
-            return view.data.toreadonly()
-        node_client = self.clients.get(tuple(locator["node_addr"]))
-        payload = node_client.call("read_shm_object", locator["oid"])
-        if payload is None:
-            raise ObjectLostError(
-                f"object {cache_oid.hex()} evicted from remote store")
+                    f"object {cache_oid.hex()} evicted from the local store"
+                ) from None
+        payload = self._pull_remote(locator, cache_oid)
         self.store.put_serialized(cache_oid, payload)
         return payload
+
+    def _pull_remote(self, locator: Dict[str, Any],
+                     cache_oid: ObjectID) -> bytes:
+        """Chunked node-to-node pull (reference: ObjectManager 64 MiB chunk
+        pulls, object_manager.h:117, gated by the PullManager memory budget,
+        pull_manager.h:52 — here a bounded semaphore of chunk slots)."""
+        node_client = self.clients.get(tuple(locator["node_addr"]))
+        chunk = config.object_transfer_chunk_bytes
+        oid = locator["oid"]
+
+        def fetch(offset: int):
+            with self._pull_slots:
+                got = node_client.call("read_shm_chunk", oid, offset, chunk)
+            if got is None:
+                raise ObjectLostError(
+                    f"object {cache_oid.hex()} evicted from remote store "
+                    f"mid-pull at offset {offset}")
+            return got
+
+        try:
+            total, data = fetch(0)
+            if total <= len(data):
+                return data
+            offsets = list(range(len(data), total, chunk))
+            # Remaining chunks pull in parallel on the io pool, each gated
+            # by the chunk-slot budget (multiplexed client pipelines them).
+            rest = list(self._io_pool().map(lambda off: fetch(off)[1],
+                                            offsets))
+            return b"".join([data] + rest)
+        except (RpcError, RemoteCallError, TimeoutError) as e:
+            raise ObjectLostError(
+                f"node holding {cache_oid.hex()} unreachable: {e}") from e
 
     # ------------------------------------------------------------ put/get
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
-        frame = serialization.serialize(value)
+        self.store.mark_owned(oid)
+        with serialization.capture_refs() as nested:
+            frame = serialization.serialize(value)
+        self.store.set_nested(oid, nested)  # pin refs inside the frame
         if len(frame) > config.inline_object_max_bytes:
             locator = self._try_put_shm(oid, frame)
             if locator is not None:
@@ -234,16 +316,43 @@ class CoreWorker:
 
     def _get_frame(self, ref: ObjectRef, timeout: Optional[float]):
         """Fetch the serialized frame for ``ref``: local store (zero-copy shm
-        view when the value lives in this node's store) or owner pull."""
-        if self.store.contains(ref.id) or ref.owner_addr in (None, self.addr):
+        view when the value lives in this node's store) or owner pull. Lost
+        objects (evicted / node died) are reconstructed by re-executing the
+        producing task when lineage is known (object_recovery_manager.h:41)."""
+        if ref.owner_addr in (None, self.addr):
+            attempts = 0
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while True:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                entry = self.store.wait_ready(ref.id, left)
+                try:
+                    if entry.data is not None:
+                        return entry.data
+                    if entry.shm_ref is not None:
+                        return self._resolve_shm(entry.shm_ref, ref.id)
+                    raise ObjectLostError(
+                        f"object {ref.hex()} has no data")
+                except ObjectLostError:
+                    attempts += 1
+                    if (attempts > config.reconstruction_max_attempts
+                            or not self._try_reconstruct(ref.id)):
+                        raise
+        if self.store.contains(ref.id):
             entry = self.store.wait_ready(ref.id, timeout)
-            if entry.data is not None:
-                return entry.data
-            if entry.shm_ref is not None:
-                return self._resolve_shm(entry.shm_ref, ref.id)
-            raise ObjectLostError(f"object {ref.hex()} has no data")
+            try:
+                if entry.data is not None:
+                    return entry.data
+                if entry.shm_ref is not None:
+                    return self._resolve_shm(entry.shm_ref, ref.id)
+            except ObjectLostError:
+                # Cached locator went stale (node died): drop the cache and
+                # fall through to the owner pull below.
+                self.store.drop(ref.id)
         # Borrower path: long-poll the owner, then resolve/cache locally.
         owner = self.clients.get(ref.owner_addr)
+        recon_asked = 0
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             step = 5.0 if deadline is None else min(5.0, deadline - time.monotonic())
@@ -268,8 +377,26 @@ class CoreWorker:
                 self.store.put_serialized(ref.id, payload)
                 return payload
             if kind == "shm":
+                try:
+                    frame = self._resolve_shm(payload, ref.id)
+                except ObjectLostError:
+                    # The store copy is gone (evicted or node death). Ask
+                    # the owner to reconstruct it, then retry the long-poll.
+                    self.store.drop(ref.id)
+                    recon_asked += 1
+                    if recon_asked > config.reconstruction_max_attempts:
+                        raise
+                    try:
+                        if not owner.call("reconstruct_object",
+                                          ref.id.binary()):
+                            raise
+                    except (RpcError, RemoteCallError, TimeoutError):
+                        raise ObjectLostError(
+                            f"owner of {ref.hex()} unreachable for "
+                            f"reconstruction") from None
+                    continue
                 self.store.put_shm_ref(ref.id, payload)
-                return self._resolve_shm(payload, ref.id)
+                return frame
             raise ObjectLostError(f"unknown get_object reply kind {kind!r}")
 
     def get_serialized(self, ref: ObjectRef, timeout: Optional[float]) -> bytes:
@@ -358,7 +485,91 @@ class CoreWorker:
         return self.store.is_ready(ObjectID(oid_bytes))
 
     def _handle_free_object(self, oid_bytes: bytes) -> None:
-        self.store.free(ObjectID(oid_bytes))
+        self.free_object(ObjectID(oid_bytes))
+
+    # -------------------------------------------- distributed ref counting
+
+    def _handle_ref_update(self, deltas: Dict[bytes, int]) -> None:
+        self.apply_ref_updates(deltas)
+
+    def apply_ref_updates(self, deltas: Dict[bytes, int]) -> None:
+        for oid_bytes, delta in deltas.items():
+            self.store.apply_ref_update(ObjectID(oid_bytes), delta)
+
+    def _sweep_loop(self) -> None:
+        """Owner-side lifetime sweeper: frees owned objects whose
+        cluster-wide handle count has stayed at zero past the grace period
+        (reference: ReferenceCounter deleting out-of-scope objects,
+        reference_count.h:61)."""
+        while not self._shutdown.wait(max(0.2, config.ref_free_grace_s / 4)):
+            try:
+                for oid, _loc in self.store.sweep_dead_refs(
+                        config.ref_free_grace_s):
+                    self.free_object(oid)
+                # Freed tombstones don't live forever (a long-running owner
+                # would otherwise accumulate one per dead object).
+                self.store.purge_freed(max(60.0,
+                                           config.ref_free_grace_s * 30))
+            except Exception:
+                pass
+
+    def free_object(self, oid: ObjectID) -> None:
+        """Full owner-side free: in-process entry, primary shm copy (pin +
+        slot), spill file, and lineage."""
+        with self.store._lock:
+            entry = self.store._entries.get(oid)
+            locator = entry.shm_ref if entry is not None else None
+        self.store.free(oid)
+        if locator is not None:
+            try:
+                self.clients.get(tuple(locator["node_addr"])).notify(
+                    "free_shm_object", locator["oid"])
+            except Exception:
+                pass
+        with self._lineage_lock:
+            self._lineage.pop(oid, None)
+
+    # ---------------------------------------------- lineage/reconstruction
+
+    def record_lineage(self, return_ids: List[ObjectID],
+                       spec: Dict[str, Any], options: Dict[str, Any]) -> None:
+        """Owner-kept lineage: remember how to re-produce these objects
+        (reference: TaskManager lineage, task_manager.h:215). Bounded FIFO."""
+        record = {"spec": spec, "options": options,
+                  "return_ids": list(return_ids), "lock": threading.Lock(),
+                  "attempts": 0}
+        with self._lineage_lock:
+            for oid in return_ids:
+                self._lineage[oid] = record
+            while len(self._lineage) > config.max_lineage_entries:
+                self._lineage.pop(next(iter(self._lineage)))
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Re-execute the producing task of a lost object (reference:
+        ObjectRecoveryManager, object_recovery_manager.h:41,96-106). Returns
+        False when no lineage is known (e.g. a put object)."""
+        with self._lineage_lock:
+            record = self._lineage.get(oid)
+        if record is None:
+            return False
+        with record["lock"]:
+            # If another thread already reset this entry, just wait on it.
+            if not self.store.is_ready(oid):
+                return True
+            if record["attempts"] >= config.reconstruction_max_attempts:
+                return False
+            record["attempts"] += 1
+            for rid in record["return_ids"]:
+                self.store.reset_pending(rid)
+            arg_refs = _collect_top_level_refs(
+                *serialization.deserialize(record["spec"]["args_blob"]))
+            self.submitter.submit(record["spec"], record["options"],
+                                  record["return_ids"], arg_refs)
+        return True
+
+    def _handle_reconstruct(self, oid_bytes: bytes) -> bool:
+        """Borrower-requested reconstruction of an owned object."""
+        return self._try_reconstruct(ObjectID(oid_bytes))
 
     # -------------------------------------------------- task submission
 
@@ -374,15 +585,23 @@ class CoreWorker:
         arg_refs = _collect_top_level_refs(args, kwargs)
         # Function body travels via the controller KV (exported once per
         # cluster, fetched once per worker) — not with every task spec.
+        # All refs pickled into args (any nesting depth) are captured and
+        # kept alive by the submitter until the task replies, so the owner
+        # can't free them while the task is in flight.
+        with serialization.capture_refs() as held_refs:
+            args_blob = serialization.serialize((args, kwargs))
         spec = {
             "task_id": task_id.binary(),
             "func_key": func_key,
             "desc": desc,
-            "args_blob": serialization.serialize((args, kwargs)),
+            "args_blob": args_blob,
             "return_ids": [o.binary() for o in return_ids],
             "owner_addr": self.addr,
         }
-        self.submitter.submit(spec, options, return_ids, arg_refs)
+        if options.get("max_retries", 3) > 0:
+            self.record_lineage(return_ids, spec, options)
+        self.submitter.submit(spec, options, return_ids, arg_refs,
+                              held_refs)
         return refs
 
     # ---------------------------------------------------- task execution
@@ -446,22 +665,29 @@ class CoreWorker:
         """Serialize task returns; large frames go into this node's shm store
         and ship as locators (reference: small returns in-band to the owner's
         memory store, large returns plasma-put — core_worker task reply
-        path). Each element is ("inline", bytes) or ("shm", locator)."""
+        path). Each element is ("inline", bytes, nested_refs) or
+        ("shm", locator, nested_refs); nested_refs are the ObjectRefs pickled
+        inside the frame — the owner pins them for the frame's lifetime."""
         packed = []
         for r in results:
-            frame = serialization.serialize(r)
+            with serialization.capture_refs() as nested:
+                frame = serialization.serialize(r)
             if len(frame) > config.inline_object_max_bytes:
                 oid = ObjectID.from_random()
                 locator = self._try_put_shm(oid, frame)
                 if locator is not None:
-                    packed.append(("shm", locator))
+                    packed.append(("shm", locator, nested))
                     continue
-            packed.append(("inline", frame))
+            packed.append(("inline", frame, nested))
         return packed
 
     def fulfil_result(self, oid: ObjectID, packed: tuple) -> None:
-        """Owner-side: record a packed task result."""
-        kind, payload = packed
+        """Owner-side: record a packed task result; refs nested in the frame
+        (already re-materialized by the RPC deserializer, so their handles
+        are registered) stay pinned by the entry."""
+        kind, payload = packed[0], packed[1]
+        if len(packed) > 2 and packed[2]:
+            self.store.set_nested(oid, packed[2])
         if kind == "shm":
             self.store.put_shm_ref(oid, payload)
         else:
@@ -516,8 +742,13 @@ class TaskSubmitter:
         self._stopped = False
 
     def submit(self, spec, options, return_ids: List[ObjectID],
-               arg_refs: List[ObjectRef]) -> None:
-        self._pool.submit(self._run, spec, options, return_ids, arg_refs)
+               arg_refs: List[ObjectRef],
+               held_refs: Optional[List[ObjectRef]] = None) -> None:
+        # held_refs: every ref serialized into the args (incl. nested) —
+        # passing them through the closure keeps their handles registered
+        # until _run returns, which is exactly the in-flight window.
+        self._pool.submit(self._run, spec, options, return_ids, arg_refs,
+                          held_refs)
 
     def stop(self) -> None:
         self._stopped = True
@@ -527,7 +758,8 @@ class TaskSubmitter:
         for oid in return_ids:
             self._core.store.put_error(oid, err)
 
-    def _run(self, spec, options, return_ids, arg_refs) -> None:
+    def _run(self, spec, options, return_ids, arg_refs,
+             held_refs=None) -> None:
         core = self._core
         try:
             # 1. Resolve dependencies BEFORE leasing a worker

@@ -19,14 +19,40 @@ host staging and safe numpy views)::
 
 from __future__ import annotations
 
+import contextlib
 import pickle
 import struct
+import threading
 from typing import Any, List, Tuple
 
 import cloudpickle
 
 _ALIGN = 64
 _U64 = struct.Struct("<Q")
+
+# Nested-ObjectRef capture: while a capture is active on this thread, every
+# ObjectRef pickled (at any nesting depth) is recorded. The runtime pins
+# those refs for as long as the serialized frame is alive, so an object
+# reachable only through a stored/in-flight frame can't be freed (reference:
+# ReferenceCounter tracking refs found at serialization time,
+# reference_count.h:61).
+_capture = threading.local()
+
+
+@contextlib.contextmanager
+def capture_refs():
+    prev = getattr(_capture, "refs", None)
+    _capture.refs = []
+    try:
+        yield _capture.refs
+    finally:
+        _capture.refs = prev
+
+
+def record_serialized_ref(ref) -> None:
+    refs = getattr(_capture, "refs", None)
+    if refs is not None:
+        refs.append(ref)
 
 
 def _align(n: int) -> int:

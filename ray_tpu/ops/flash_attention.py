@@ -41,6 +41,7 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 _NEG_INF = -1e30
+_LANE = 128  # TPU lane width: minor dim of every block must divide into it
 
 
 def _interpret() -> bool:
@@ -117,7 +118,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse = jnp.where(
             l == 0.0, _NEG_INF,
             jnp.maximum(m, _NEG_INF / 2) + jnp.log(l_safe))
-        lse_ref[0, 0] = lse[:, 0]
+        # TPU blocks need a 128-lane minor dim: lse is broadcast across the
+        # lane axis (same trick as jax's in-tree kernel); readers use lane 0.
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], _LANE))
 
 
 def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k):
@@ -142,11 +145,12 @@ def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k):
         ],
         out_specs=[
             _block_spec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-            _block_spec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+            _block_spec((1, 1, block_q, _LANE),
+                        lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, _LANE), jnp.float32),
         ],
         scratch_shapes=[
             _scratch((block_q, d), jnp.float32),
@@ -183,8 +187,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0]          # (bk, D)
         v = v_ref[0, 0]
         do = do_ref[0, 0]        # (bq, D)
-        lse = lse_ref[0, 0]      # (bq,)
-        delta = delta_ref[0, 0]  # (bq,)
+        lse = lse_ref[0, 0][:, 0:1]      # (bq, 1); lane-0 of padded layout
+        delta = delta_ref[0, 0][:, 0:1]  # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -194,7 +198,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - jnp.maximum(lse, _NEG_INF / 2)[:, None])  # (bq, bk)
+        p = jnp.exp(s - jnp.maximum(lse, _NEG_INF / 2))  # (bq, bk)
         # dV += P^T dO
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -203,7 +207,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         # dK += dS^T Q
         dk_acc[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -236,8 +240,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -247,11 +251,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - jnp.maximum(lse, _NEG_INF / 2)[:, None])
+        p = jnp.exp(s - jnp.maximum(lse, _NEG_INF / 2))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale)
+        ds = (p * (dp - delta) * scale)
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -267,8 +271,12 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k):
     group = h // hkv
     nq, nk = sq // block_q, sk // block_k
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # (B, H, S)
+    # (B, H, S, LANE): broadcast across the lane axis so delta's blocks are
+    # TPU-tileable (readers use lane 0, matching the lse layout).
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (b, h, sq, _LANE))
 
     # dK/dV: one (b, kv-head, kv-tile) program accumulates over all query
     # tiles of every query head in the group (GQA reduction folded into the
@@ -289,8 +297,10 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k):
                         lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
             _block_spec((1, 1, block_q, d),
                         lambda b_, h_, j, i: (b_, h_, i, 0)),
-            _block_spec((1, 1, block_q), lambda b_, h_, j, i: (b_, h_, i)),
-            _block_spec((1, 1, block_q), lambda b_, h_, j, i: (b_, h_, i)),
+            _block_spec((1, 1, block_q, _LANE),
+                        lambda b_, h_, j, i: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_q, _LANE),
+                        lambda b_, h_, j, i: (b_, h_, i, 0)),
         ],
         out_specs=[
             _block_spec((1, 1, block_k, d),
@@ -330,8 +340,10 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k):
                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
             _block_spec((1, 1, block_q, d),
                         lambda b_, h_, i, j: (b_, h_, i, 0)),
-            _block_spec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
-            _block_spec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+            _block_spec((1, 1, block_q, _LANE),
+                        lambda b_, h_, i, j: (b_, h_, i, 0)),
+            _block_spec((1, 1, block_q, _LANE),
+                        lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_specs=[
             _block_spec((1, 1, block_q, d),
