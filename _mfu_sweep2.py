@@ -41,14 +41,9 @@ def run(cfg, batch, seq=2048):
         (p, o), losses = jax.lax.scan(body, (params, opt_state), toks)
         return p, o, losses
 
-    # (K, batch, seq): shard the BATCH axis (axis 1) on the data/fsdp mesh
-    # axes; the scan-step axis K stays replicated.
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    toks = jax.device_put(
-        jax.random.randint(jax.random.key(1), (K, batch, seq + 1), 0,
-                           cfg.vocab_size),
-        NamedSharding(mesh, P(None, ("data", "fsdp"), None)))
+    toks = ts.shard_batch(
+        {"t": jax.random.randint(jax.random.key(1), (K, batch, seq + 1), 0,
+                                 cfg.vocab_size)}, mesh)["t"]
     params, opt_state, losses = multi(params, opt_state, toks)
     _ = float(losses[-1])
     t0 = time.perf_counter()
@@ -60,32 +55,15 @@ def run(cfg, batch, seq=2048):
     return round(mfu, 2), round(tps), round(dt * 1000, 1)
 
 
-d1152 = llama.LlamaConfig(vocab_size=32000, dim=1152, n_layers=24, n_heads=9,
-                          n_kv_heads=9, mlp_dim=4608, max_seq_len=2048)
-d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
-                          n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
 
-CONFIGS = [
-    ("d1152 xla full b8", d1152, 8),
-    ("d1152 flash full b8",
-     dataclasses.replace(d1152, attention_impl="flash"), 8),
-    ("d1152 flash dots b8",
-     dataclasses.replace(d1152, attention_impl="flash",
-                         remat_policy="dots"), 8),
-    ("d1152 flash dots ce512 b16",
-     dataclasses.replace(d1152, attention_impl="flash", remat_policy="dots",
-                         loss_chunk=512), 16),
-    ("d1152 flash full ce512 b16",
-     dataclasses.replace(d1152, attention_impl="flash", loss_chunk=512), 16),
-    ("d1280 flash dots ce512 b8",
-     dataclasses.replace(d1280, attention_impl="flash", remat_policy="dots",
-                         loss_chunk=512), 8),
-]
+import sys
+
+from _sweep2_configs import CONFIGS
 
 if __name__ == "__main__":
-    for desc, cfg, b in CONFIGS:
+    for desc, cfg, b, seq in CONFIGS:
         try:
-            print(desc, run(cfg, b),
+            print(desc, run(cfg, b, seq),
                   f"params={cfg.num_params()/1e6:.0f}M", flush=True)
         except Exception as e:  # noqa: BLE001
             print(desc, "FAIL", str(e)[:100].replace("\n", " "), flush=True)

@@ -1,0 +1,17 @@
+import dataclasses
+from ray_tpu.models import llama
+
+d1152 = llama.LlamaConfig(vocab_size=32000, dim=1152, n_layers=24, n_heads=9,
+                          n_kv_heads=9, mlp_dim=4608, max_seq_len=2048)
+d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
+                          n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
+fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
+CONFIGS = [
+    ("d1152 xla full ce512 b16", dataclasses.replace(d1152, loss_chunk=512), 16, 2048),
+    ("d1152 flash full ce512 b24", fl(d1152, loss_chunk=512), 24, 2048),
+    ("d1152 flash full ce512 b32", fl(d1152, loss_chunk=512), 32, 2048),
+    ("d1152 flash norem ce512 b4", fl(d1152, loss_chunk=512, remat=False), 4, 2048),
+    ("d1152 flash full ce512 b8 s4096",
+     fl(dataclasses.replace(d1152, max_seq_len=4096), loss_chunk=512), 8, 4096),
+    ("d1280 flash full ce512 b16", fl(d1280, loss_chunk=512), 16, 2048),
+]

@@ -141,16 +141,21 @@ class ActorHandle:
         return f"ActorHandle({self._actor_id.hex()})"
 
     def _resolve(self, timeout: float = 60.0) -> Dict[str, Any]:
-        """Wait until the actor is ALIVE; raise ActorDiedError if DEAD."""
+        """Wait until the actor is ALIVE; raise ActorDiedError if DEAD.
+
+        Push-driven: one synchronous read, then a long-poll subscription on
+        the controller's actor channel (reference: GCS actor pubsub replacing
+        WaitForActorRefDeleted-style polling; serve long_poll.py:173)."""
         cached = self._cached
         if cached is not None:
             return cached
         core = get_core_worker()
+        record = core.controller.call("get_actor", self._actor_id.binary())
+        if record is None:
+            raise ActorDiedError(self._actor_id, "unknown actor")
         deadline = time.monotonic() + timeout
+        version = 0
         while True:
-            record = core.controller.call("get_actor", self._actor_id.binary())
-            if record is None:
-                raise ActorDiedError(self._actor_id, "unknown actor")
             if record["state"] == ALIVE:
                 self._cached = record
                 self._known_inc = max(self._known_inc, record["incarnation"])
@@ -158,11 +163,21 @@ class ActorHandle:
             if record["state"] == DEAD:
                 raise ActorDiedError(self._actor_id,
                                      record.get("death_cause") or "")
-            if time.monotonic() > deadline:
+            step = min(10.0, deadline - time.monotonic())
+            if step <= 0:
                 raise ActorDiedError(
                     self._actor_id,
                     f"actor stuck in state {record['state']} for {timeout}s")
-            time.sleep(0.02)
+            update = core.controller.call(
+                "psub_poll", "actors", self._actor_id.hex(), version, step,
+                timeout=step + 15.0)
+            if update is None:  # long-poll timed out: re-read and loop
+                record = core.controller.call(
+                    "get_actor", self._actor_id.binary())
+                if record is None:
+                    raise ActorDiedError(self._actor_id, "unknown actor")
+                continue
+            version, record = update
 
     def _incarnation_hint(self) -> int:
         return self._known_inc
@@ -179,11 +194,13 @@ class ActorHandle:
         # actor-side bounded gap wait plus the reset below.
         incarnation = self._incarnation_hint()
         seq = _next_seq(self._actor_id, incarnation)
+        with serialization.capture_refs() as held_refs:
+            args_blob = serialization.serialize((args, kwargs))
         spec = {
             "task_id": TaskID.from_random().binary(),
             "method": method,
             "desc": f"{self._actor_id.hex()[:8]}.{method}",
-            "args_blob": serialization.serialize((args, kwargs)),
+            "args_blob": args_blob,
             "return_ids": [o.binary() for o in return_ids],
             "owner_addr": core.addr,
             "seq": seq,
@@ -194,14 +211,17 @@ class ActorHandle:
         arg_refs = _collect_top_level_refs(args, kwargs)
         sem = _inflight_sem(self._actor_id)
         core.submitter._pool.submit(
-            self._push, core, spec, return_ids, arg_refs, sem)
+            self._push, core, spec, return_ids, arg_refs, sem, held_refs)
         if num_returns == 0:
             return None
         if num_returns == 1:
             return refs[0]
         return refs
 
-    def _push(self, core, spec, return_ids, arg_refs, sem) -> None:
+    def _push(self, core, spec, return_ids, arg_refs, sem,
+              held_refs=None) -> None:
+        # held_refs keeps every ref pickled into the args alive (handles
+        # registered) for the in-flight window; see TaskSubmitter.submit.
         try:
             for ref in arg_refs:
                 core.wait_ready(ref, None)

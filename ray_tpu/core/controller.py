@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import resources as resmath
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu.core.pubsub import Pubsub
 from ray_tpu.core.rpc import ClientPool, RpcServer
 
 Addr = Tuple[str, int]
@@ -108,6 +109,10 @@ class Controller:
         self._pgs: Dict[PlacementGroupID, PlacementGroupRecord] = {}
         self._clients = ClientPool()
         self._stopped = threading.Event()
+        # Long-poll notification hub (reference: src/ray/pubsub/publisher.h
+        # + serve's LongPollHost): actor/job/PG state transitions and KV
+        # writes publish here so clients wait on pushes, not poll loops.
+        self.pubsub = Pubsub()
         self._server = RpcServer(
             handlers={
                 "register_node": self.register_node,
@@ -132,9 +137,14 @@ class Controller:
                 "get_placement_group": self.get_placement_group,
                 "remove_placement_group": self.remove_placement_group,
                 "cluster_resources": self.cluster_resources,
+                "psub_poll": self.pubsub.poll,
+                "psub_poll_many": self.pubsub.poll_many,
+                "psub_publish": self.pubsub.publish,
+                "psub_snapshot": self.pubsub.snapshot,
                 "ping": lambda: "pong",
             },
             name="controller",
+            max_workers=256,  # long-polls park handler threads
             inline_methods={"heartbeat"},
         )
         self._health_thread = threading.Thread(
@@ -291,7 +301,9 @@ class Controller:
                         raise ValueError(
                             f"Actor with name {name!r} already exists")
                 self._named_actors[name] = actor_id
-            self._actors[actor_id] = ActorRecord(actor_id, info, spec, opts)
+            rec = ActorRecord(actor_id, info, spec, opts)
+            self._actors[actor_id] = rec
+            self._publish_actor(rec)
         threading.Thread(target=self._schedule_actor, args=(actor_id,),
                          name="actor-schedule", daemon=True).start()
 
@@ -367,6 +379,7 @@ class Controller:
                         rec.addr = (worker_addr, lease["worker_id"],
                                     tuple(node_addr))
                         rec.node_id = NodeID(node_id_bytes)
+                        self._publish_actor(rec)
                     return
                 # __init__ raised: permanent failure, no restart (parity with
                 # the reference: creation-task errors kill the actor).
@@ -391,6 +404,7 @@ class Controller:
             if rec is not None:
                 rec.state = DEAD
                 rec.death_cause = reason
+                self._publish_actor(rec)
 
     def report_actor_failure(self, actor_id_bytes: bytes,
                              reason: str = "") -> Dict[str, Any]:
@@ -415,6 +429,7 @@ class Controller:
             else:
                 rec.state = DEAD
                 rec.death_cause = reason
+            self._publish_actor(rec)
             summary = self._actor_summary(rec)
         if should_schedule:
             def _delayed():
@@ -435,6 +450,7 @@ class Controller:
             if no_restart:
                 rec.state = DEAD
                 rec.death_cause = "killed via kill()"
+                self._publish_actor(rec)
         if addr is not None:
             worker_addr, worker_id, node_addr = addr
             try:
@@ -444,6 +460,12 @@ class Controller:
                 pass
         if not no_restart:
             self.report_actor_failure(actor_id_bytes, "killed (restartable)")
+
+    def _publish_actor(self, rec: ActorRecord) -> None:
+        """Push the actor's new state to long-poll subscribers (reference:
+        GCS actor channel, pubsub.proto GCS_ACTOR_CHANNEL)."""
+        self.pubsub.publish("actors", rec.actor_id.hex(),
+                            self._actor_summary(rec))
 
     def _actor_summary(self, rec: ActorRecord) -> Dict[str, Any]:
         return {
@@ -478,7 +500,8 @@ class Controller:
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
-            return True
+        self.pubsub.publish("kv", key, None)
+        return True
 
     def kv_get(self, key: str) -> Optional[bytes]:
         with self._lock:
@@ -497,11 +520,17 @@ class Controller:
     def register_job(self, job_id: str, info: Dict[str, Any]) -> None:
         with self._lock:
             self._jobs[job_id] = {"state": "RUNNING", **info}
+        self.pubsub.publish("jobs", job_id, {"state": "RUNNING", **info})
 
     def finish_job(self, job_id: str, state: str = "SUCCEEDED") -> None:
         with self._lock:
             if job_id in self._jobs:
                 self._jobs[job_id]["state"] = state
+                info = dict(self._jobs[job_id])
+            else:
+                info = None
+        if info is not None:
+            self.pubsub.publish("jobs", job_id, info)
 
     def list_jobs(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -569,7 +598,9 @@ class Controller:
             for idx, node_rec in reserved:
                 rec.placement[idx] = (node_rec.node_id, node_rec.addr)
                 resmath.deduct(node_rec.available, rec.bundles[idx])
-            return self._pg_summary(rec)
+            summary = self._pg_summary(rec)
+        self.pubsub.publish("placement_groups", rec.pg_id.hex(), summary)
+        return summary
 
     def _plan_bundles(self, bundles, strategy):
         """Choose a node per bundle honoring PACK/SPREAD/STRICT_PACK/

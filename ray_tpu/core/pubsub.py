@@ -1,0 +1,140 @@
+"""Versioned long-poll pub/sub hub.
+
+TPU-era analogue of the reference's two notification layers: the generalized
+pubsub used for GCS notifications (``src/ray/pubsub/publisher.h`` — one
+long-poll connection per subscriber, batched messages) and Serve's
+``LongPollHost`` (``serve/_private/long_poll.py:173`` — versioned snapshots,
+subscribers re-poll with the last version they saw). The hub keeps only the
+LATEST value per (channel, key) with a monotonically increasing version —
+subscribers that fall behind see the newest state, not an event log, which is
+the right semantics for control-plane state (actor records, serve configs,
+job states) and keeps memory bounded.
+
+Embedded in the controller (server side) and wrapped by :class:`Subscriber`
+(client side). Wakeups are condition-variable broadcast; a poll with an
+up-to-date version parks until publish or timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Pubsub:
+    def __init__(self):
+        self._cond = threading.Condition()
+        # (channel, key) -> (version, value). Versions are per-(channel,key).
+        self._state: Dict[Tuple[str, str], Tuple[int, Any]] = {}
+
+    def publish(self, channel: str, key: str, value: Any) -> int:
+        with self._cond:
+            version = self._state.get((channel, key), (0, None))[0] + 1
+            self._state[(channel, key)] = (version, value)
+            self._cond.notify_all()
+            return version
+
+    def drop(self, channel: str, key: str) -> None:
+        with self._cond:
+            self._state.pop((channel, key), None)
+
+    def poll(self, channel: str, key: str, last_version: int = 0,
+             timeout: float = 30.0) -> Optional[Tuple[int, Any]]:
+        """Long-poll: block until (channel, key) has a version newer than
+        ``last_version``; returns (version, value) or None on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                cur = self._state.get((channel, key))
+                if cur is not None and cur[0] > last_version:
+                    return cur
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(min(remaining, 1.0))
+
+    def poll_many(self, watches: Dict[str, Tuple[str, str, int]],
+                  timeout: float = 30.0):
+        """Multi-key long-poll (Serve's LongPollHost shape): ``watches`` maps
+        a caller-chosen tag -> (channel, key, last_version). Returns
+        {tag: (version, value)} for every watch that has news, or None on
+        timeout. One condition wait covers all watches."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                updates = {}
+                for tag, (channel, key, last) in watches.items():
+                    cur = self._state.get((channel, key))
+                    if cur is not None and cur[0] > last:
+                        updates[tag] = cur
+                if updates:
+                    return updates
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(min(remaining, 1.0))
+
+    def snapshot(self, channel: str) -> Dict[str, Tuple[int, Any]]:
+        with self._cond:
+            return {k: v for (ch, k), v in self._state.items()
+                    if ch == channel}
+
+
+class Subscriber:
+    """Client-side helper: blocking waits and background watch threads over a
+    remote hub exposed via ``psub_poll`` / ``psub_poll_many`` RPCs."""
+
+    def __init__(self, client):
+        self._client = client  # RpcClient to the hub's host process
+
+    def wait_for(self, channel: str, key: str, predicate,
+                 timeout: Optional[float] = None,
+                 last_version: int = 0):
+        """Block until ``predicate(value)`` is true for a published value;
+        returns (version, value). Raises TimeoutError."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        version = last_version
+        while True:
+            step = 30.0
+            if deadline is not None:
+                step = min(step, deadline - time.monotonic())
+                if step <= 0:
+                    raise TimeoutError(
+                        f"pubsub wait on {channel}/{key} timed out")
+            result = self._client.call("psub_poll", channel, key, version,
+                                       step, timeout=step + 15.0)
+            if result is None:
+                continue
+            version, value = result
+            if predicate(value):
+                return version, value
+
+    def watch(self, channel: str, key: str, callback,
+              stop_event: threading.Event,
+              last_version: int = 0) -> threading.Thread:
+        """Spawn a daemon thread invoking ``callback(version, value)`` on
+        every update until ``stop_event`` is set."""
+
+        def _loop():
+            version = last_version
+            while not stop_event.is_set():
+                try:
+                    result = self._client.call("psub_poll", channel, key,
+                                               version, 10.0, timeout=25.0)
+                except Exception:
+                    if stop_event.wait(1.0):
+                        return
+                    continue
+                if result is None:
+                    continue
+                version, value = result
+                try:
+                    callback(version, value)
+                except Exception:
+                    pass
+
+        thread = threading.Thread(target=_loop, daemon=True,
+                                  name=f"psub-watch-{channel}-{key}")
+        thread.start()
+        return thread

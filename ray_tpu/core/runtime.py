@@ -258,10 +258,12 @@ class CoreWorker:
             if total <= len(data):
                 return data
             offsets = list(range(len(data), total, chunk))
-            # Remaining chunks pull in parallel on the io pool, each gated
-            # by the chunk-slot budget (multiplexed client pipelines them).
-            rest = list(self._io_pool().map(lambda off: fetch(off)[1],
-                                            offsets))
+            # Remaining chunks pull in parallel on a dedicated pool (NOT
+            # _io_pool: multi-ref get() already saturates that pool, and
+            # fanning out from inside it would deadlock), gated by the
+            # chunk-slot budget.
+            rest = list(self._chunk_pool().map(lambda off: fetch(off)[1],
+                                               offsets))
             return b"".join([data] + rest)
         except (RpcError, RemoteCallError, TimeoutError) as e:
             raise ObjectLostError(
@@ -298,6 +300,7 @@ class CoreWorker:
         return values[0] if single else values
 
     _io_pool_inst: Optional[ThreadPoolExecutor] = None
+    _chunk_pool_inst: Optional[ThreadPoolExecutor] = None
     _io_pool_lock = threading.Lock()
 
     def _io_pool(self) -> ThreadPoolExecutor:
@@ -306,6 +309,13 @@ class CoreWorker:
                 self._io_pool_inst = ThreadPoolExecutor(
                     max_workers=16, thread_name_prefix="core-io")
             return self._io_pool_inst
+
+    def _chunk_pool(self) -> ThreadPoolExecutor:
+        with self._io_pool_lock:
+            if self._chunk_pool_inst is None:
+                self._chunk_pool_inst = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="chunk-pull")
+            return self._chunk_pool_inst
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
         frame = self._get_frame(ref, timeout)

@@ -159,7 +159,9 @@ def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k):
         ],
         interpret=_interpret(),
     )(q, k, v)
-    return out, lse
+    # Keep only lane 0 (the value; other lanes are the tiling broadcast) so
+    # the residual saved for the backward is (B, H, S), not 128x that.
+    return out, lse[..., 0]
 
 
 # --------------------------------------------------------------- backward
@@ -271,8 +273,9 @@ def _bwd(q, k, v, out, lse, do, scale, causal, q_offset, block_q, block_k):
     group = h // hkv
     nq, nk = sq // block_q, sk // block_k
 
-    # (B, H, S, LANE): broadcast across the lane axis so delta's blocks are
-    # TPU-tileable (readers use lane 0, matching the lse layout).
+    # (B, H, S, LANE): lse and delta broadcast across the lane axis so their
+    # blocks are TPU-tileable (kernels read lane 0).
+    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, _LANE))
     delta = jnp.broadcast_to(
         jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                 axis=-1, keepdims=True),

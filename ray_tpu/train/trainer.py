@@ -17,7 +17,6 @@ trainers instead.
 
 from __future__ import annotations
 
-import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -111,20 +110,28 @@ class JaxTrainer:
 
     def _poll_until_done(self, group: WorkerGroup, history,
                          latest_checkpoint) -> Result:
-        finished = [False] * len(group.workers)
+        """Push-driven result streaming: each worker's ``wait_status`` is a
+        long-poll (blocks inside the actor until news), so the driver sits in
+        ``wait`` on outstanding replies instead of a fixed-period poll loop
+        (VERDICT: delete the 10 Hz ``trainer.py:143`` poll)."""
         error: Optional[str] = None
-        while not all(finished):
-            for i, worker in enumerate(group.workers):
-                if finished[i]:
-                    continue
+        pending: Dict[Any, int] = {
+            worker.wait_status.remote(30.0): i
+            for i, worker in enumerate(group.workers)}
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                    timeout=120.0)
+            if not ready:
+                raise _AttemptFailed("workers unresponsive for 120s",
+                                     latest_checkpoint)
+            for ref in ready:
+                i = pending.pop(ref)
                 try:
-                    results = ray_tpu.get(worker.next_results.remote(),
-                                          timeout=60)
-                    status = ray_tpu.get(worker.status.remote(), timeout=60)
+                    status = ray_tpu.get(ref)
                 except Exception as e:
                     raise _AttemptFailed(
                         f"worker {i} unreachable: {e}", latest_checkpoint)
-                for r in results:
+                for r in status["results"]:
                     if "error" in r:
                         error = r["error"]
                         continue
@@ -135,12 +142,12 @@ class JaxTrainer:
                         if self._callback is not None:
                             self._callback(r)
                 if status["finished"]:
-                    finished[i] = True
                     if status["error"] and error is None:
                         error = status["error"]
                     if status["latest_checkpoint"]:
                         latest_checkpoint = status["latest_checkpoint"]
-            time.sleep(0.1)
+                else:
+                    pending[group.workers[i].wait_status.remote(30.0)] = i
         if error is not None:
             raise _AttemptFailed(f"train loop raised: {error}",
                                  latest_checkpoint)

@@ -81,6 +81,33 @@ class TrainWorker:
             "latest_checkpoint": self._session.latest_checkpoint,
         }
 
+    def wait_status(self, timeout: float = 30.0) -> Dict[str, Any]:
+        """Long-poll: block until at least one result is queued (or the loop
+        finishes / timeout), then return drained results + status in one
+        reply. The driver waits on this instead of polling at a fixed period
+        (the push-driven replacement for the 10 Hz ``next_results`` loop)."""
+        import queue as _q
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        out = self.next_results()
+        while not out and not self._session.finished.is_set():
+            remaining = deadline - _t.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                out.append(self._session.results.get(
+                    timeout=min(remaining, 1.0)))
+            except _q.Empty:
+                continue
+        # Order matters: read finished BEFORE the final drain. If the loop
+        # sets finished after our last get() timed out, results queued in
+        # that window must still ship in this reply — the driver stops
+        # calling once it sees finished=True.
+        status = self.status()
+        out.extend(self.next_results())
+        return {"results": out, **status}
+
     def ping(self) -> str:
         return "pong"
 
