@@ -41,9 +41,14 @@ def run(cfg, batch, seq=2048):
         (p, o), losses = jax.lax.scan(body, (params, opt_state), toks)
         return p, o, losses
 
-    toks = ts.shard_batch(
-        {"t": jax.random.randint(jax.random.key(1), (K, batch, seq + 1), 0,
-                                 cfg.vocab_size)}, mesh)["t"]
+    # (K, batch, seq): shard the BATCH axis (axis 1) on the data/fsdp mesh
+    # axes; the scan-step axis K stays replicated.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    toks = jax.device_put(
+        jax.random.randint(jax.random.key(1), (K, batch, seq + 1), 0,
+                           cfg.vocab_size),
+        NamedSharding(mesh, P(None, ("data", "fsdp"), None)))
     params, opt_state, losses = multi(params, opt_state, toks)
     _ = float(losses[-1])
     t0 = time.perf_counter()
@@ -56,14 +61,34 @@ def run(cfg, batch, seq=2048):
 
 
 
-import sys
+import dataclasses
 
-from _sweep2_configs import CONFIGS
+d1152 = llama.LlamaConfig(vocab_size=32000, dim=1152, n_layers=24, n_heads=9,
+                          n_kv_heads=9, mlp_dim=4608, max_seq_len=2048)
+d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
+                          n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
+fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
+CONFIGS = [
+    ("d1152 flash full ce512 b28", fl(d1152, loss_chunk=512), 28, 2048),
+    ("d1152 xla full ce512 b16", dataclasses.replace(d1152, loss_chunk=512), 16, 2048),
+    ("d1152 flash norem ce512 b4", fl(d1152, loss_chunk=512, remat=False), 4, 2048),
+    ("d1152 flash full ce512 b8 s4096",
+     fl(dataclasses.replace(d1152, max_seq_len=4096), loss_chunk=512), 8, 4096),
+    ("d1280 flash full ce512 b16", fl(d1280, loss_chunk=512), 16, 2048),
+    ("d1280 flash full ce512 b24", fl(d1280, loss_chunk=512), 24, 2048),
+]
 
 if __name__ == "__main__":
     for desc, cfg, b, seq in CONFIGS:
-        try:
-            print(desc, run(cfg, b, seq),
-                  f"params={cfg.num_params()/1e6:.0f}M", flush=True)
-        except Exception as e:  # noqa: BLE001
-            print(desc, "FAIL", str(e)[:100].replace("\n", " "), flush=True)
+        for attempt in range(2):
+            try:
+                print(desc, run(cfg, b, seq),
+                      f"params={cfg.num_params()/1e6:.0f}M", flush=True)
+                break
+            except Exception as e:  # noqa: BLE001
+                msg = str(e)[:90].replace("\n", " ")
+                if "remote_compile" in msg and attempt == 0:
+                    print(desc, "retrying after compile-helper 500", flush=True)
+                    continue
+                print(desc, "FAIL", msg, flush=True)
+                break

@@ -1,0 +1,162 @@
+"""Core-runtime microbenchmarks (the ``ray_perf.py`` equivalent).
+
+Measures the framework-overhead envelope the way the reference's
+microbenchmark suite does (``python/ray/_private/ray_perf.py:93-315``, run
+nightly via ``release/microbenchmark/``): tasks/s sync+async, actor calls/s
+1:1 and n:n, object put/get throughput, many-ref ``wait``, and cross-node
+transfer. Prints one JSON line per metric and a summary table; run with
+
+    python microbench.py [--quick]
+
+Results are committed to ``MICROBENCH.md`` alongside BASELINE.md's envelope
+rows so every round tracks framework overhead, not just model FLOPs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, n, unit="ops/s"):
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    print(json.dumps({"metric": name, "value": round(rate, 1), "unit": unit,
+                      "n": n, "seconds": round(dt, 3)}), flush=True)
+    return name, rate, unit
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="small iteration counts (CI smoke)")
+    args = parser.parse_args()
+    q = args.quick
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    results = []
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    @ray_tpu.remote
+    def nop_arg(x):
+        return x
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    # Warm the worker pool (first task forks + imports).
+    ray_tpu.get([nop.remote() for _ in range(8)])
+
+    n = 40 if q else 300
+    results.append(timeit(
+        "tasks_sync_per_s",
+        lambda: [ray_tpu.get(nop.remote()) for _ in range(n)], n))
+
+    n = 200 if q else 3000
+    results.append(timeit(
+        "tasks_async_batch_per_s",
+        lambda: ray_tpu.get([nop.remote() for _ in range(n)]), n))
+
+    actor = Counter.options(num_cpus=0).remote()
+    ray_tpu.get(actor.inc.remote())
+    n = 50 if q else 500
+    results.append(timeit(
+        "actor_calls_sync_1_1_per_s",
+        lambda: [ray_tpu.get(actor.inc.remote()) for _ in range(n)], n))
+
+    n = 300 if q else 5000
+    results.append(timeit(
+        "actor_calls_async_1_1_per_s",
+        lambda: ray_tpu.get([actor.inc.remote() for _ in range(n)]), n))
+
+    actors = [Counter.options(num_cpus=0).remote() for _ in range(8)]
+    ray_tpu.get([a.inc.remote() for a in actors])
+    n = 400 if q else 8000
+    results.append(timeit(
+        "actor_calls_async_n_n_per_s",
+        lambda: ray_tpu.get([actors[i % 8].inc.remote() for i in range(n)]),
+        n))
+
+    size = (64 if q else 1024) * 1024 * 1024 // 1024  # MiB scale below
+    mb = 64 if q else 1024
+    blob = np.random.default_rng(0).integers(
+        0, 255, size=(mb * 1024 * 1024,), dtype=np.uint8)
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(blob)
+    dt = time.perf_counter() - t0
+    put_rate = blob.nbytes / dt / 1e9
+    print(json.dumps({"metric": "put_GB_per_s", "value": round(put_rate, 2),
+                      "unit": "GB/s", "bytes": blob.nbytes}), flush=True)
+    results.append(("put_GB_per_s", put_rate, "GB/s"))
+
+    t0 = time.perf_counter()
+    got = ray_tpu.get(ref)
+    dt = time.perf_counter() - t0
+    get_rate = got.nbytes / dt / 1e9
+    print(json.dumps({"metric": "get_GB_per_s", "value": round(get_rate, 2),
+                      "unit": "GB/s", "bytes": got.nbytes}), flush=True)
+    results.append(("get_GB_per_s", get_rate, "GB/s"))
+    del got, blob, ref
+
+    n = 200 if q else 1000
+    refs = [nop_arg.remote(i) for i in range(n)]
+    t0 = time.perf_counter()
+    ready, pending = ray_tpu.wait(refs, num_returns=n, timeout=60.0)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "wait_1k_refs_s", "value": round(dt, 3),
+                      "unit": "s", "ready": len(ready)}), flush=True)
+    results.append(("wait_1k_refs_s", dt, "s"))
+    del refs, ready, pending
+
+    # Cross-node transfer: second in-process node, task pinned there
+    # produces a block, driver pulls it chunked.
+    from ray_tpu.core.api import _local_cluster
+    from ray_tpu.core.node import Node
+
+    controller, _head = _local_cluster
+    side = Node(controller.address, {"CPU": 2.0, "side": 2.0})
+    try:
+        mb = 32 if q else 256
+
+        @ray_tpu.remote(num_cpus=0, resources={"side": 1})
+        def make(mbs):
+            return np.zeros(mbs * 1024 * 1024, dtype=np.uint8)
+
+        ref = make.remote(mb)
+        ray_tpu.wait([ref], timeout=120.0)
+        t0 = time.perf_counter()
+        got = ray_tpu.get(ref, timeout=300.0)
+        dt = time.perf_counter() - t0
+        rate = got.nbytes / dt / 1e9
+        print(json.dumps({"metric": "cross_node_get_GB_per_s",
+                          "value": round(rate, 2), "unit": "GB/s",
+                          "bytes": got.nbytes}), flush=True)
+        results.append(("cross_node_get_GB_per_s", rate, "GB/s"))
+        del got, ref
+    finally:
+        side.stop()
+
+    print("\n| metric | value | unit |\n|---|---|---|")
+    for name, rate, unit in results:
+        print(f"| {name} | {rate:,.1f} | {unit} |")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

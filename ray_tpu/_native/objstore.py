@@ -156,8 +156,14 @@ class ShmStore:
             return None
         return self._mv[off:off + size]
 
-    def seal(self, object_id: bytes) -> None:
+    def seal(self, object_id: bytes, pin: bool = False):
+        """Seal a buffer created via create_buffer; with ``pin`` the primary
+        copy stays unevictable and the returned ShmPin must be held."""
+        if pin:
+            self._lib.shm_seal2(self._handle, object_id, 1)
+            return ShmPin(self, object_id)
         self._lib.shm_seal(self._handle, object_id)
+        return None
 
     # ------------------------------------------------------------ reader
 

@@ -168,9 +168,16 @@ class ActorHandle:
                 raise ActorDiedError(
                     self._actor_id,
                     f"actor stuck in state {record['state']} for {timeout}s")
-            update = core.controller.call(
-                "psub_poll", "actors", self._actor_id.hex(), version, step,
-                timeout=step + 15.0)
+            try:
+                update = core.controller.call(
+                    "psub_poll", "actors", self._actor_id.hex(), version,
+                    step, timeout=step + 15.0)
+            except (RpcError, TimeoutError):
+                # A slow/saturated controller long-poll is NOT an actor
+                # failure (the caller's except-branch would misclassify it
+                # and restart a healthy actor): degrade to a plain re-read.
+                time.sleep(0.2)
+                update = None
             if update is None:  # long-poll timed out: re-read and loop
                 record = core.controller.call(
                     "get_actor", self._actor_id.binary())

@@ -80,6 +80,15 @@ _FLAG_DEFS: Dict[str, tuple] = {
     "reconstruction_max_attempts": (int, 3,
         "How many times a lost object's producing task is re-executed "
         "(reference: object_recovery_manager.h:41)."),
+    "accel_env_vars": (str, "PALLAS_AXON_POOL_IPS",
+        "Comma-separated env vars stripped from CPU-only workers at fork: "
+        "site hooks keyed on these attach accelerators (and import jax) "
+        "into every python process, a startup tax pure-CPU task workers "
+        "skip. Leases holding a TPU resource keep them."),
+    "dead_actor_cache_count": (int, 1000,
+        "Dead actor records (and their pubsub entries) retained for late "
+        "callers before being reaped (reference: "
+        "maximum_gcs_destroyed_actor_cached_count, ray_config_def.h)."),
 }
 
 

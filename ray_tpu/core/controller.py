@@ -208,6 +208,25 @@ class Controller:
                         dead_nodes.append(rec.node_id)
             for node_id in dead_nodes:
                 self._on_node_dead(node_id)
+            self._reap_dead_actors()
+
+    def _reap_dead_actors(self) -> None:
+        """Bound the DEAD-actor cache (records + pubsub entries) so a
+        long-lived cluster churning actors doesn't grow without limit
+        (reference: maximum_gcs_destroyed_actor_cached_count)."""
+        cap = config.dead_actor_cache_count
+        with self._lock:
+            dead = [a for a, r in self._actors.items() if r.state == DEAD]
+            if len(dead) <= cap:
+                return
+            victims = dead[:len(dead) - cap]  # dict order = oldest first
+            for actor_id in victims:
+                rec = self._actors.pop(actor_id)
+                name = rec.info.get("name")
+                if name and self._named_actors.get(name) == actor_id:
+                    del self._named_actors[name]
+        for actor_id in victims:
+            self.pubsub.drop("actors", actor_id.hex())
 
     def _on_node_dead(self, node_id: NodeID) -> None:
         """Fail (and maybe restart) actors on a dead node (reference:

@@ -91,6 +91,7 @@ class WorkerHandle:
         self.registered = threading.Event()
         self.idle = False
         self.dedicated = False  # actor workers are never pooled
+        self.tpu = False        # forked with accelerator env (see _fork_worker)
         self.last_used = time.monotonic()
         # Resources held by the current lease; credited back exactly once
         # (on lease return, worker kill, or death-reap — whichever first).
@@ -183,9 +184,13 @@ class Node:
         resources: Dict[str, float],
         bundle: Optional[BundleKey] = None,
         timeout: Optional[float] = None,
+        dedicated: bool = False,
     ) -> Dict[str, Any]:
         """Block until resources are free, then hand out a pooled or freshly
-        forked worker. Returns {worker_id, addr} or {error}."""
+        forked worker. Returns {worker_id, addr} or {error}. ``dedicated``
+        leases always fork: actor workers must never drain the task pool
+        (the reference worker pool likewise matches leases to pooled workers
+        only for normal tasks; actors hold their worker for life)."""
         timeout = timeout if timeout is not None else config.worker_lease_timeout_s
         bundle = tuple(bundle) if bundle is not None else None
         waiter = _LeaseWaiter(dict(resources), bundle)
@@ -206,8 +211,13 @@ class Node:
                     self._waiters.remove(waiter)
                 if not waiter.granted:
                     return {"error": "lease timeout"}
+        needs_tpu = resources.get("TPU", 0) > 0
         try:
-            handle = self._take_or_fork_worker()
+            if dedicated:
+                handle = self._fork_worker(dedicated=True,
+                                           needs_tpu=needs_tpu)
+            else:
+                handle = self._take_or_fork_worker(needs_tpu)
         except Exception as e:
             self._credit(resources, bundle)
             return {"error": f"worker start failed: {e!r}"}
@@ -272,20 +282,37 @@ class Node:
             # this lease; crediting again here would double-count.
             self._drain_waiters_locked()
 
-    def _take_or_fork_worker(self) -> WorkerHandle:
+    def _take_or_fork_worker(self, needs_tpu: bool = False) -> WorkerHandle:
         with self._lock:
+            kept: List[WorkerHandle] = []
+            found = None
             while self._idle:
                 handle = self._idle.pop()
-                if handle.proc.poll() is None:
+                if handle.proc.poll() is not None:
+                    self._remove_worker_locked(handle)
+                elif found is None and handle.tpu == needs_tpu:
                     handle.idle = False
-                    return handle
-                self._remove_worker_locked(handle)
-        return self._fork_worker()
+                    found = handle
+                else:
+                    kept.append(handle)
+            self._idle.extend(kept)
+            if found is not None:
+                return found
+        return self._fork_worker(needs_tpu=needs_tpu)
 
-    def _fork_worker(self, dedicated: bool = False) -> WorkerHandle:
+    def _fork_worker(self, dedicated: bool = False,
+                     needs_tpu: bool = False) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self._extra_env)
+        if not needs_tpu:
+            # CPU-only workers skip accelerator attach: site hooks keyed on
+            # these vars import jax (+PJRT registration) into EVERY python
+            # process, a ~2s startup tax per fork that pure-CPU task workers
+            # never need. TPU-resourced leases keep them (configurable).
+            for var in config.accel_env_vars.split(","):
+                if var:
+                    env.pop(var.strip(), None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         extra_paths = [pkg_root] + [p for p in sys.path if p]
@@ -304,6 +331,7 @@ class Node:
         )
         handle = WorkerHandle(worker_id, proc)
         handle.dedicated = dedicated
+        handle.tpu = needs_tpu
         with self._lock:
             self._workers[worker_id] = handle
         if not handle.registered.wait(config.worker_start_timeout_s):
@@ -326,15 +354,10 @@ class Node:
     def create_actor_worker(self, resources: Dict[str, float],
                             bundle: Optional[BundleKey] = None,
                             timeout: Optional[float] = None) -> Dict[str, Any]:
-        """Lease a dedicated (never pooled) worker for an actor."""
-        result = self.lease_worker(resources, bundle=bundle, timeout=timeout)
-        if "error" in result:
-            return result
-        with self._lock:
-            handle = self._workers.get(WorkerID(result["worker_id"]))
-            if handle is not None:
-                handle.dedicated = True
-        return result
+        """Lease a dedicated worker for an actor — always a fresh fork, so
+        actors can't drain the task worker pool."""
+        return self.lease_worker(resources, bundle=bundle, timeout=timeout,
+                                 dedicated=True)
 
     def kill_worker(self, worker_id_bytes: bytes, force: bool = True) -> None:
         worker_id = WorkerID(worker_id_bytes)

@@ -161,38 +161,51 @@ class CoreWorker:
             "oid": oid.binary(),
         }
 
-    def _try_put_shm(self, oid: ObjectID, frame: bytes) -> Optional[Dict]:
-        """Write a serialized frame into this node's store; falls back to the
-        node's spill directory when the store can't fit it (reference:
-        local_object_manager.h:110 spill-to-fs — here spilling happens at
-        write time because pinned primary copies are not evictable). Returns
-        the locator, or None only when both paths fail."""
+    def _try_put_frame(self, oid: ObjectID, total: int,
+                       write) -> Optional[Dict]:
+        """Reserve ``total`` bytes in this node's store and let ``write``
+        fill them in place (single copy: pickle buffers -> shm mmap); falls
+        back to the node's spill directory when the store can't fit it
+        (reference: local_object_manager.h:110 spill-to-fs — spilling
+        happens at write time because pinned primary copies are not
+        evictable). Returns the locator, or None only when both fail."""
         try:
             from ray_tpu.core.node import shm_store_path
 
             store = self._open_shm(shm_store_path(self.node_id))
-            # Owner holds the primary-copy pin until free: without it, LRU
-            # eviction under allocation pressure could drop the only copy of
-            # a live object (ObjectLostError on a later get).
-            pin = store.put_bytes(oid.binary(), frame, pin=True)
-            if pin is not None:
-                self.store._entry(oid).shm_pin = pin
+            buf = store.create_buffer(oid.binary(), total)
+            if buf is not None:
+                write(buf)
+                # Owner holds the primary-copy pin until free: without it,
+                # LRU eviction under pressure could drop the only copy of a
+                # live object (ObjectLostError on a later get).
+                self.store._entry(oid).shm_pin = store.seal(
+                    oid.binary(), pin=True)
                 return self._shm_locator(oid)
         except OSError:
             pass
-        return self._try_spill(oid, frame)
+        return self._try_spill(oid, total, write)
 
-    def _try_spill(self, oid: ObjectID, frame: bytes) -> Optional[Dict]:
-        """Write the frame to this node's spill dir and return a locator the
-        node's object server can resolve (read_shm_* check the spill dir)."""
+    def _try_spill(self, oid: ObjectID, total: int, write) -> Optional[Dict]:
+        """Write the frame into a file in this node's spill dir (mmap-backed,
+        same single-copy discipline) and return a locator the node's object
+        server can resolve (read_shm_* check the spill dir)."""
+        import mmap as _mmap
+
         try:
             from ray_tpu.core.node import spill_dir, spill_file
 
             os.makedirs(spill_dir(self.node_id), exist_ok=True)
             path = spill_file(self.node_id, oid.binary())
             tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(frame)
+            with open(tmp, "wb+") as f:
+                if total:
+                    # Allocate blocks up front: ENOSPC surfaces here as
+                    # OSError (caught below) instead of a SIGBUS when the
+                    # mmap write faults on a sparse hole.
+                    os.posix_fallocate(f.fileno(), 0, total)
+                    with _mmap.mmap(f.fileno(), total) as m:
+                        write(memoryview(m))
             os.rename(tmp, path)
             loc = self._shm_locator(oid)
             loc["spill"] = path
@@ -275,14 +288,16 @@ class CoreWorker:
         oid = ObjectID.from_random()
         self.store.mark_owned(oid)
         with serialization.capture_refs() as nested:
-            frame = serialization.serialize(value)
+            total, write = serialization.build_frame(value)
         self.store.set_nested(oid, nested)  # pin refs inside the frame
-        if len(frame) > config.inline_object_max_bytes:
-            locator = self._try_put_shm(oid, frame)
+        if total > config.inline_object_max_bytes:
+            locator = self._try_put_frame(oid, total, write)
             if locator is not None:
                 self.store.put_shm_ref(oid, locator)
                 return ObjectRef(oid, self.addr)
-        self.store.put_serialized(oid, frame)
+        out = bytearray(total)
+        write(out)
+        self.store.put_serialized(oid, bytes(out))
         return ObjectRef(oid, self.addr)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -681,14 +696,16 @@ class CoreWorker:
         packed = []
         for r in results:
             with serialization.capture_refs() as nested:
-                frame = serialization.serialize(r)
-            if len(frame) > config.inline_object_max_bytes:
+                total, write = serialization.build_frame(r)
+            if total > config.inline_object_max_bytes:
                 oid = ObjectID.from_random()
-                locator = self._try_put_shm(oid, frame)
+                locator = self._try_put_frame(oid, total, write)
                 if locator is not None:
                     packed.append(("shm", locator, nested))
                     continue
-            packed.append(("inline", frame, nested))
+            out = bytearray(total)
+            write(out)
+            packed.append(("inline", bytes(out), nested))
         return packed
 
     def fulfil_result(self, oid: ObjectID, packed: tuple) -> None:

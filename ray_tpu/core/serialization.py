@@ -59,8 +59,12 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
-def serialize(value: Any) -> bytes:
-    """Serialize ``value`` to the framed zero-copy layout."""
+def build_frame(value: Any):
+    """Pickle ``value`` (protocol 5, out-of-band buffers) and compute the
+    frame layout WITHOUT materializing it. Returns ``(total_size, write)``
+    where ``write(buf)`` fills any writable buffer of ``total_size`` bytes —
+    letting callers serialize straight into the shared-memory store with one
+    copy instead of three (build bytearray -> bytes() -> shm memcpy)."""
     buffers: List[pickle.PickleBuffer] = []
     try:
         payload = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
@@ -77,17 +81,27 @@ def serialize(value: Any) -> bytes:
         offsets.append((cursor, raw.nbytes))
         cursor = _align(cursor + raw.nbytes)
     total = cursor if raws else header_size + len(payload)
+
+    def write(out) -> None:
+        out[0:8] = _U64.pack(len(payload))
+        out[8:16] = _U64.pack(len(raws))
+        pos = 16
+        for off, ln in offsets:
+            out[pos:pos + 8] = _U64.pack(off)
+            out[pos + 8:pos + 16] = _U64.pack(ln)
+            pos += 16
+        out[pos:pos + len(payload)] = payload
+        for raw, (off, ln) in zip(raws, offsets):
+            out[off:off + ln] = raw
+
+    return total, write
+
+
+def serialize(value: Any) -> bytes:
+    """Serialize ``value`` to the framed zero-copy layout."""
+    total, write = build_frame(value)
     out = bytearray(total)
-    out[0:8] = _U64.pack(len(payload))
-    out[8:16] = _U64.pack(len(raws))
-    pos = 16
-    for off, ln in offsets:
-        out[pos:pos + 8] = _U64.pack(off)
-        out[pos + 8:pos + 16] = _U64.pack(ln)
-        pos += 16
-    out[pos:pos + len(payload)] = payload
-    for raw, (off, ln) in zip(raws, offsets):
-        out[off:off + ln] = raw
+    write(out)
     return bytes(out)
 
 
