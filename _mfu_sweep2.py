@@ -20,18 +20,41 @@ PEAK = peak_flops_per_chip(getattr(jax.devices()[0], "device_kind", ""))
 K = 8
 
 
-def run(cfg, batch, seq=2048):
+def run(cfg, batch, seq=2048, accum=1):
+    import jax.numpy as jnp
+
     opt = optax.adamw(3e-4, weight_decay=0.1)
     params = ts.init_sharded_params(lambda k: llama.init_params(cfg, k),
-                                    llama.param_axes(), mesh,
+                                    llama.param_axes(cfg), mesh,
                                     jax.random.key(0))
     opt_state = ts.init_optimizer_state(opt, params)
 
     def body(carry, tokens):
         p, o = carry
         with axis_rules(mesh):
-            loss, grads = jax.value_and_grad(
-                lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg))(p)
+            if accum == 1:
+                loss, grads = jax.value_and_grad(
+                    lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg))(p)
+            else:
+                # Hoist the fp32->bf16 cast out of the microbatch loop and
+                # accumulate fp32 grads (gradient accumulation).
+                pbf = jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if x.dtype == jnp.float32 else x, p)
+                def micro(g_acc, mtoks):
+                    loss, g = jax.value_and_grad(
+                        lambda pp: llama.loss_fn(
+                            pp, {"tokens": mtoks}, cfg))(pbf)
+                    g_acc = jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                    return g_acc, loss
+                g0 = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                                  p)
+                mb = tokens.reshape(accum, tokens.shape[0] // accum,
+                                    tokens.shape[1])
+                grads, losses = jax.lax.scan(micro, g0, mb)
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss = losses.mean()
             updates, o2 = opt.update(grads, o, p)
             p2 = optax.apply_updates(p, updates)
         return (p2, o2), loss
@@ -69,20 +92,25 @@ d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
                           n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
 fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
 CONFIGS = [
-    ("d1152 flash full ce512 b28", fl(d1152, loss_chunk=512), 28, 2048),
-    ("d1152 xla full ce512 b16", dataclasses.replace(d1152, loss_chunk=512), 16, 2048),
-    ("d1152 flash norem ce512 b4", fl(d1152, loss_chunk=512, remat=False), 4, 2048),
-    ("d1152 flash full ce512 b8 s4096",
-     fl(dataclasses.replace(d1152, max_seq_len=4096), loss_chunk=512), 8, 4096),
-    ("d1280 flash full ce512 b16", fl(d1280, loss_chunk=512), 16, 2048),
-    ("d1280 flash full ce512 b24", fl(d1280, loss_chunk=512), 24, 2048),
+    ("d1152 fused s1024 b48 accum4",
+     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
+        fused_qkv=True, fused_mlp=True), 48 * 4, 1024, 4),
+    ("d1152 fused s1024 b56",
+     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
+        fused_qkv=True, fused_mlp=True), 56, 1024, 1),
+    ("d1152 fused s512 b96",
+     fl(dataclasses.replace(d1152, max_seq_len=512), loss_chunk=512,
+        fused_qkv=True, fused_mlp=True), 96, 512, 1),
+    ("d1280 fused s1024 b40",
+     fl(dataclasses.replace(d1280, max_seq_len=1024), loss_chunk=512,
+        fused_qkv=True, fused_mlp=True), 40, 1024, 1),
 ]
 
 if __name__ == "__main__":
-    for desc, cfg, b, seq in CONFIGS:
+    for desc, cfg, b, seq, acc in CONFIGS:
         for attempt in range(2):
             try:
-                print(desc, run(cfg, b, seq),
+                print(desc, run(cfg, b, seq, acc),
                       f"params={cfg.num_params()/1e6:.0f}M", flush=True)
                 break
             except Exception as e:  # noqa: BLE001

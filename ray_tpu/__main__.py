@@ -1,0 +1,5 @@
+import sys
+
+from ray_tpu.scripts import main
+
+sys.exit(main())

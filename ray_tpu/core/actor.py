@@ -96,6 +96,7 @@ class ActorClass:
             "resources": resources,
             "scheduling_strategy": _strategy_dict(opts.get("scheduling_strategy")),
             "placement": _placement_tuple(opts),
+            "runtime_env": opts.get("runtime_env"),
         }
         core.controller.call("register_actor", actor_id.binary(), info,
                              spec, creation_opts)

@@ -107,6 +107,8 @@ class Controller:
         self._kv: Dict[str, bytes] = {}
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self._pgs: Dict[PlacementGroupID, PlacementGroupRecord] = {}
+        self._metrics: Dict[str, List[Dict[str, Any]]] = {}
+        self._task_events: List[Dict[str, Any]] = []
         self._clients = ClientPool()
         self._stopped = threading.Event()
         # Long-poll notification hub (reference: src/ray/pubsub/publisher.h
@@ -137,6 +139,11 @@ class Controller:
                 "get_placement_group": self.get_placement_group,
                 "remove_placement_group": self.remove_placement_group,
                 "cluster_resources": self.cluster_resources,
+                "push_metrics": self.push_metrics,
+                "list_metrics": self.list_metrics,
+                "metrics_text": self.metrics_text,
+                "push_task_events": self.push_task_events,
+                "list_task_events": self.list_task_events,
                 "psub_poll": self.pubsub.poll,
                 "psub_poll_many": self.pubsub.poll_many,
                 "psub_publish": self.pubsub.publish,
@@ -150,6 +157,10 @@ class Controller:
         self._health_thread = threading.Thread(
             target=self._health_loop, name="controller-health", daemon=True)
         self._health_thread.start()
+        # Discovery file for the state CLI (`python -m ray_tpu status`).
+        from ray_tpu.scripts import write_discovery
+
+        write_discovery(self.address)
 
     @property
     def address(self) -> Addr:
@@ -368,6 +379,7 @@ class Controller:
                     lease = self._clients.get(tuple(node_addr)).call(
                         "create_actor_worker",
                         opts.get("resources", {"CPU": 1.0}), bundle, None,
+                        opts.get("runtime_env"),
                         timeout=config.worker_lease_timeout_s + 10.0)
                 except Exception as e:
                     self._clients.invalidate(tuple(node_addr))
@@ -699,6 +711,42 @@ class Controller:
                 node_rec = self._nodes.get(node_id)
                 if node_rec is not None:
                     resmath.credit(node_rec.available, rec.bundles[idx])
+
+    # ------------------------------------------- metrics + task events
+    #
+    # Observability floor (reference: src/ray/stats/metric_defs.cc export
+    # pipeline + GcsTaskManager's bounded task-event store,
+    # gcs_task_manager.h:80). Workers push; the controller aggregates and
+    # serves the state API / Prometheus text / timeline dump.
+
+    def push_metrics(self, source: Dict[str, Any],
+                     snapshot: List[Dict[str, Any]]) -> None:
+        key = (f"{NodeID(source['node_id']).hex()[:8]}/"
+               f"pid{source.get('pid', 0)}")
+        with self._lock:
+            self._metrics[key] = snapshot
+
+    def list_metrics(self) -> Dict[str, List[Dict[str, Any]]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._metrics.items()}
+
+    def metrics_text(self) -> str:
+        from ray_tpu.util.metrics import prometheus_text
+
+        return prometheus_text(self.list_metrics())
+
+    def push_task_events(self, events: List[Dict[str, Any]]) -> None:
+        cap = config.event_buffer_max
+        with self._lock:
+            self._task_events.extend(events)
+            if len(self._task_events) > cap:
+                # Bounded, priority to the newest (gcs_task_manager evicts
+                # oldest first the same way).
+                del self._task_events[:len(self._task_events) - cap]
+
+    def list_task_events(self, limit: int = 1000) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._task_events[-limit:])
 
     # ----------------------------------------------------------- control
 

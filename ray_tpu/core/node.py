@@ -52,6 +52,17 @@ def spill_file(node_id: NodeID, oid_bytes: bytes) -> str:
     return os.path.join(spill_dir(node_id), oid_bytes.hex() + ".bin")
 
 
+def _runtime_env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
+    if not runtime_env:
+        return ""
+    import hashlib
+    import json
+
+    return hashlib.sha1(
+        json.dumps(runtime_env, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
 def _kill_and_reap(proc: subprocess.Popen, force: bool) -> None:
     """Kill a worker process and reap it so no zombie lingers in the
     (long-lived) driver process hosting this node supervisor."""
@@ -92,6 +103,7 @@ class WorkerHandle:
         self.idle = False
         self.dedicated = False  # actor workers are never pooled
         self.tpu = False        # forked with accelerator env (see _fork_worker)
+        self.env_hash = ""      # runtime-env identity for pool matching
         self.last_used = time.monotonic()
         # Resources held by the current lease; credited back exactly once
         # (on lease return, worker kill, or death-reap — whichever first).
@@ -185,12 +197,18 @@ class Node:
         bundle: Optional[BundleKey] = None,
         timeout: Optional[float] = None,
         dedicated: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Block until resources are free, then hand out a pooled or freshly
         forked worker. Returns {worker_id, addr} or {error}. ``dedicated``
         leases always fork: actor workers must never drain the task pool
         (the reference worker pool likewise matches leases to pooled workers
-        only for normal tasks; actors hold their worker for life)."""
+        only for normal tasks; actors hold their worker for life).
+        ``runtime_env`` (env_vars / working_dir) selects — or forks — a
+        worker built with that environment (reference: the per-node
+        runtime-env agent building envs for the worker pool,
+        runtime_env_agent.py:162; pooled workers are matched by env like
+        worker_pool.h's runtime_env_hash)."""
         timeout = timeout if timeout is not None else config.worker_lease_timeout_s
         bundle = tuple(bundle) if bundle is not None else None
         waiter = _LeaseWaiter(dict(resources), bundle)
@@ -212,12 +230,15 @@ class Node:
                 if not waiter.granted:
                     return {"error": "lease timeout"}
         needs_tpu = resources.get("TPU", 0) > 0
+        env_hash = _runtime_env_hash(runtime_env)
         try:
             if dedicated:
                 handle = self._fork_worker(dedicated=True,
-                                           needs_tpu=needs_tpu)
+                                           needs_tpu=needs_tpu,
+                                           runtime_env=runtime_env)
             else:
-                handle = self._take_or_fork_worker(needs_tpu)
+                handle = self._take_or_fork_worker(needs_tpu, runtime_env,
+                                                   env_hash)
         except Exception as e:
             self._credit(resources, bundle)
             return {"error": f"worker start failed: {e!r}"}
@@ -282,7 +303,9 @@ class Node:
             # this lease; crediting again here would double-count.
             self._drain_waiters_locked()
 
-    def _take_or_fork_worker(self, needs_tpu: bool = False) -> WorkerHandle:
+    def _take_or_fork_worker(self, needs_tpu: bool = False,
+                             runtime_env: Optional[Dict[str, Any]] = None,
+                             env_hash: str = "") -> WorkerHandle:
         with self._lock:
             kept: List[WorkerHandle] = []
             found = None
@@ -290,7 +313,8 @@ class Node:
                 handle = self._idle.pop()
                 if handle.proc.poll() is not None:
                     self._remove_worker_locked(handle)
-                elif found is None and handle.tpu == needs_tpu:
+                elif (found is None and handle.tpu == needs_tpu
+                        and handle.env_hash == env_hash):
                     handle.idle = False
                     found = handle
                 else:
@@ -298,13 +322,21 @@ class Node:
             self._idle.extend(kept)
             if found is not None:
                 return found
-        return self._fork_worker(needs_tpu=needs_tpu)
+        return self._fork_worker(needs_tpu=needs_tpu,
+                                 runtime_env=runtime_env)
 
     def _fork_worker(self, dedicated: bool = False,
-                     needs_tpu: bool = False) -> WorkerHandle:
+                     needs_tpu: bool = False,
+                     runtime_env: Optional[Dict[str, Any]] = None
+                     ) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self._extra_env)
+        workdir = None
+        if runtime_env:
+            env.update({str(k): str(v) for k, v in
+                        (runtime_env.get("env_vars") or {}).items()})
+            workdir = self._materialize_working_dir(runtime_env)
         if not needs_tpu:
             # CPU-only workers skip accelerator attach: site hooks keyed on
             # these vars import jax (+PJRT registration) into EVERY python
@@ -319,6 +351,10 @@ class Node:
         inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
         env["PYTHONPATH"] = os.pathsep.join(
             dict.fromkeys(extra_paths + inherited))
+        if workdir:
+            env["PYTHONPATH"] = os.pathsep.join(
+                [workdir] + [p for p in env.get("PYTHONPATH", "").split(
+                    os.pathsep) if p])
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main",
              "--node-host", self.address[0],
@@ -328,10 +364,12 @@ class Node:
              "--node-id", self.node_id.hex(),
              "--worker-id", worker_id.hex()],
             env=env,
+            cwd=workdir or None,
         )
         handle = WorkerHandle(worker_id, proc)
         handle.dedicated = dedicated
         handle.tpu = needs_tpu
+        handle.env_hash = _runtime_env_hash(runtime_env)
         with self._lock:
             self._workers[worker_id] = handle
         if not handle.registered.wait(config.worker_start_timeout_s):
@@ -340,6 +378,20 @@ class Node:
                 self._workers.pop(worker_id, None)
             raise TimeoutError(f"worker {worker_id.hex()} failed to register")
         return handle
+
+    def _materialize_working_dir(
+            self, runtime_env: Dict[str, Any]) -> Optional[str]:
+        """Resolve runtime_env['working_dir'] to a local directory: plain
+        paths pass through; ``kv://<key>`` zips (uploaded by the driver via
+        ray_tpu.runtime_env.upload_working_dir) are fetched from the
+        controller KV and extracted once per env hash (reference:
+        _private/runtime_env/packaging.py working_dir packages)."""
+        spec = runtime_env.get("working_dir")
+        if not spec:
+            return None
+        from ray_tpu.runtime_env import materialize_working_dir
+
+        return materialize_working_dir(spec, self._controller)
 
     def register_worker(self, worker_id_bytes: bytes, addr: Addr) -> Dict[str, Any]:
         worker_id = WorkerID(worker_id_bytes)
@@ -353,11 +405,13 @@ class Node:
 
     def create_actor_worker(self, resources: Dict[str, float],
                             bundle: Optional[BundleKey] = None,
-                            timeout: Optional[float] = None) -> Dict[str, Any]:
+                            timeout: Optional[float] = None,
+                            runtime_env: Optional[Dict[str, Any]] = None
+                            ) -> Dict[str, Any]:
         """Lease a dedicated worker for an actor — always a fresh fork, so
         actors can't drain the task worker pool."""
         return self.lease_worker(resources, bundle=bundle, timeout=timeout,
-                                 dedicated=True)
+                                 dedicated=True, runtime_env=runtime_env)
 
     def kill_worker(self, worker_id_bytes: bytes, force: bool = True) -> None:
         worker_id = WorkerID(worker_id_bytes)

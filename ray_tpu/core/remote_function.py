@@ -85,6 +85,7 @@ class RemoteFunction:
             "retry_on_crash": opts.get("max_retries", 3) != 0,
             "scheduling_strategy": _strategy_dict(opts.get("scheduling_strategy")),
             "placement": _placement_tuple(opts),
+            "runtime_env": opts.get("runtime_env"),
         }
         refs = core.submit_task(key, self._desc, args, kwargs,
                                 submit_options)

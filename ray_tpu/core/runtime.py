@@ -134,10 +134,14 @@ class CoreWorker:
         )
         self.addr: Addr = self.server.addr
         self.submitter = TaskSubmitter(self)
-        if config.ref_counting_enabled:
-            self._sweeper = threading.Thread(
-                target=self._sweep_loop, name="ref-sweeper", daemon=True)
-            self._sweeper.start()
+        # Owner-side task state-transition buffer (reference:
+        # TaskEventBuffer, task_event_buffer.h:206): flushed to the
+        # controller by the sweeper thread, bounded by event_buffer_max.
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_lock = threading.Lock()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="ref-sweeper", daemon=True)
+        self._sweeper.start()
 
     # -------------------------------------------------- shared-memory store
 
@@ -525,16 +529,33 @@ class CoreWorker:
         """Owner-side lifetime sweeper: frees owned objects whose
         cluster-wide handle count has stayed at zero past the grace period
         (reference: ReferenceCounter deleting out-of-scope objects,
-        reference_count.h:61)."""
+        reference_count.h:61). Doubles as the task-event flusher."""
         while not self._shutdown.wait(max(0.2, config.ref_free_grace_s / 4)):
             try:
-                for oid, _loc in self.store.sweep_dead_refs(
-                        config.ref_free_grace_s):
-                    self.free_object(oid)
-                # Freed tombstones don't live forever (a long-running owner
-                # would otherwise accumulate one per dead object).
-                self.store.purge_freed(max(60.0,
-                                           config.ref_free_grace_s * 30))
+                if config.ref_counting_enabled:
+                    for oid, _loc in self.store.sweep_dead_refs(
+                            config.ref_free_grace_s):
+                        self.free_object(oid)
+                    # Freed tombstones don't live forever (a long-running
+                    # owner would otherwise accumulate one per dead object).
+                    self.store.purge_freed(max(60.0,
+                                               config.ref_free_grace_s * 30))
+                self._flush_task_events()
+            except Exception:
+                pass
+
+    def record_task_event(self, event: Dict[str, Any]) -> None:
+        with self._task_events_lock:
+            self._task_events.append(event)
+            if len(self._task_events) > config.event_buffer_max:
+                del self._task_events[:len(self._task_events) // 2]
+
+    def _flush_task_events(self) -> None:
+        with self._task_events_lock:
+            events, self._task_events = self._task_events, []
+        if events:
+            try:
+                self.controller.notify("push_task_events", events)
             except Exception:
                 pass
 
@@ -788,6 +809,9 @@ class TaskSubmitter:
     def _run(self, spec, options, return_ids, arg_refs,
              held_refs=None) -> None:
         core = self._core
+        t_submit = time.time()
+        t_lease = t_run = None
+        worker_hex = None
         try:
             # 1. Resolve dependencies BEFORE leasing a worker
             #    (dependency_resolver.h — avoids lease-holding deadlock).
@@ -832,7 +856,7 @@ class TaskSubmitter:
                     node_client = core.clients.get(node_addr)
                     lease = node_client.call(
                         "lease_worker", options.get("resources", {"CPU": 1.0}),
-                        bundle, None,
+                        bundle, None, False, options.get("runtime_env"),
                         timeout=config.worker_lease_timeout_s + 10.0)
                 except (RpcError, RemoteCallError, TimeoutError) as e:
                     core.clients.invalidate(tuple(node_addr))
@@ -847,6 +871,8 @@ class TaskSubmitter:
                     time.sleep(0.2)
                     continue
                 worker_id, worker_addr = lease["worker_id"], lease["addr"]
+                t_lease = time.time()
+                worker_hex = WorkerID(worker_id).hex()
                 # 4. Direct push to the leased worker.
                 try:
                     reply = core.clients.get(worker_addr).call(
@@ -866,6 +892,7 @@ class TaskSubmitter:
                 node_client.call("return_worker", worker_id,
                                  options.get("resources", {"CPU": 1.0}),
                                  bundle, False)
+                t_run = time.time()
                 break
             # 5. Fulfil owned return objects.
             if reply["ok"]:
@@ -874,7 +901,20 @@ class TaskSubmitter:
             else:
                 for oid in return_ids:
                     self._core.store.put_serialized(oid, reply["error_frame"])
+            core.record_task_event({
+                "task_id": TaskID(spec["task_id"]).hex(),
+                "desc": spec.get("desc", ""),
+                "state": "FINISHED" if reply["ok"] else "FAILED",
+                "submitted_ts": t_submit, "lease_ts": t_lease,
+                "end_ts": t_run, "worker": worker_hex,
+                "owner": core.addr})
         except BaseException as e:  # noqa: BLE001
+            core.record_task_event({
+                "task_id": TaskID(spec["task_id"]).hex(),
+                "desc": spec.get("desc", ""), "state": "FAILED",
+                "submitted_ts": t_submit, "lease_ts": t_lease,
+                "end_ts": time.time(), "worker": worker_hex,
+                "owner": core.addr, "error": repr(e)})
             self._fail(return_ids, e)
 
 

@@ -1,0 +1,270 @@
+"""Lazy DAGs + compiled execution (the pipeline-parallel substrate).
+
+Analogue of the reference's ``ray.dag`` (``dag/dag_node.py`` ``.bind()``
+graphs) and compiled graphs (``dag/compiled_dag_node.py:389`` — pre-bound
+actor loops + typed channels so repeated execution has no per-call
+task-submission overhead; the declared substrate for pipeline parallelism,
+SURVEY §2.4 PP row).
+
+TPU-era redesign of the execution layer: the reference moves tensors
+between GPU actors over NCCL p2p channels; on TPU, *device* tensor movement
+belongs to XLA collectives inside jitted steps, so what the DAG layer owns
+is the HOST pipeline: stage actors connected by direct actor-to-actor
+pushes (no driver round-trip per hop — each stage calls the next stage's
+``_pipe_push`` itself), with a bounded number of in-flight items for
+backpressure. That gives classic 1F1B-style microbatch pipelining when
+each stage hosts one model partition's jitted step.
+
+Surface:
+
+    with InputNode() as inp:
+        dag = stage_b.bind(stage_a.bind(inp))
+    dag.execute(x)                  # interpreted: one task per node
+    cdag = dag.experimental_compile(max_in_flight=8)
+    futs = [cdag.execute(x) for x in batches]   # pipelined
+    [f.result() for f in futs]
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.remote_function import RemoteFunction
+
+
+class DAGNode:
+    """One node of a lazy ``.bind()`` graph."""
+
+    def __init__(self, kind: str, fn=None, args: tuple = (),
+                 kwargs: Optional[dict] = None):
+        self.kind = kind  # "input" | "task" | "actor_method" | "output"
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs or {}
+
+    # ---------------------------------------------------- interpreted path
+
+    def execute(self, *input_values):
+        """Interpreted execution: walk the graph, submit one task per node
+        (reference: DAGNode.execute before compilation)."""
+        cache: Dict[int, Any] = {}
+
+        def run(node: "DAGNode"):
+            if id(node) in cache:
+                return cache[id(node)]
+            if node.kind == "input":
+                value = input_values[0] if input_values else None
+            elif node.kind == "output":
+                value = [run(a) for a in node.args]
+            else:
+                args = [run(a) if isinstance(a, DAGNode) else a
+                        for a in node.args]
+                kwargs = {k: run(v) if isinstance(v, DAGNode) else v
+                          for k, v in node.kwargs.items()}
+                value = node.fn.remote(*args, **kwargs)
+            cache[id(node)] = value
+            return value
+
+        return run(self)
+
+    # ------------------------------------------------------ compiled path
+
+    def experimental_compile(self, max_in_flight: int = 8) -> "CompiledDAG":
+        return CompiledDAG(self, max_in_flight)
+
+    def _linear_chain(self) -> List["DAGNode"]:
+        """Flatten to a linear stage chain (v1 compiled topology: each node
+        has exactly one DAGNode dependency; the reference's general graphs
+        reduce to this for pipeline parallelism)."""
+        chain: List[DAGNode] = []
+        node: Optional[DAGNode] = self
+        while node is not None and node.kind != "input":
+            if node.kind == "output":
+                if len(node.args) != 1:
+                    raise ValueError(
+                        "compiled DAGs currently support linear pipelines "
+                        "(single output)")
+                node = node.args[0]
+                continue
+            chain.append(node)
+            deps = [a for a in list(node.args) + list(node.kwargs.values())
+                    if isinstance(a, DAGNode)]
+            if len(deps) > 1:
+                raise ValueError(
+                    "compiled DAGs currently support linear pipelines "
+                    f"(node has {len(deps)} upstream nodes)")
+            node = deps[0] if deps else None
+        chain.reverse()
+        return chain
+
+
+class InputNode(DAGNode):
+    def __init__(self):
+        super().__init__("input")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, nodes: List[DAGNode]):
+        super().__init__("output", args=tuple(nodes))
+
+
+def _bind_function(remote_fn: RemoteFunction, *args, **kwargs) -> DAGNode:
+    return DAGNode("task", remote_fn, args, kwargs)
+
+
+class _PipeStage:
+    """Actor hosting one compiled pipeline stage: executes its function and
+    pushes the result straight to the next stage (no driver hop), or queues
+    it for the driver when it is the last stage."""
+
+    def __init__(self, fn_blob: bytes, const_args: tuple,
+                 const_kwargs: dict, arg_template: List[Any]):
+        from ray_tpu.core import serialization
+
+        self._fn = serialization.loads_function(fn_blob)
+        self._const_args = const_args
+        self._const_kwargs = const_kwargs
+        self._arg_template = arg_template  # positions: "__dag__" = dataflow
+        self._next = None
+        import queue as q
+
+        self._out: "q.Queue" = q.Queue()
+
+    def set_next(self, next_handle) -> bool:
+        self._next = next_handle
+        return True
+
+    def _invoke(self, value):
+        args = [value if a == "__dag__" else a for a in self._const_args]
+        kwargs = {k: (value if v == "__dag__" else v)
+                  for k, v in self._const_kwargs.items()}
+        return self._fn(*args, **kwargs)
+
+    def push(self, seq: int, value) -> None:
+        result = self._invoke(value)
+        if self._next is not None:
+            # Direct stage-to-stage dataflow (the channel of
+            # shared_memory_channel.py:169, realized as an ordered
+            # actor-to-actor call whose large payloads ride the shm store).
+            self._next.push.remote(seq, result)
+        else:
+            self._out.put((seq, result))
+
+    def pop(self, timeout: float = 60.0):
+        import queue as q
+
+        try:
+            return self._out.get(timeout=timeout)
+        except q.Empty:
+            return None
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class CompiledDAG:
+    """Pre-instantiated stage actors + direct dataflow; ``execute`` returns
+    a Future resolved by a background collector (reference:
+    ``CompiledDAG._execute_until``, ``compiled_dag_node.py:1233``)."""
+
+    def __init__(self, dag: DAGNode, max_in_flight: int = 8):
+        from ray_tpu.core import serialization
+
+        chain = dag._linear_chain()
+        if not chain:
+            raise ValueError("empty DAG")
+        stage_cls = ray_tpu.remote(_PipeStage)
+        self._stages = []
+        for idx, node in enumerate(chain):
+            if node.kind != "task":
+                raise ValueError(
+                    "compiled DAGs currently support function stages "
+                    "(bind actor methods via a wrapper function)")
+            args = tuple("__dag__" if isinstance(a, DAGNode) else a
+                         for a in node.args)
+            kwargs = {k: ("__dag__" if isinstance(v, DAGNode) else v)
+                      for k, v in node.kwargs.items()}
+            if not any(a == "__dag__" for a in args) and \
+                    "__dag__" not in kwargs.values():
+                args = ("__dag__",) + args  # stage with no explicit input
+            options = dict(node.fn._options) if hasattr(node.fn, "_options") \
+                else {}
+            # Intermediate stages are single-threaded (ordered dataflow);
+            # the LAST stage needs one extra slot so the driver's blocking
+            # ``pop`` long-poll can't starve incoming pushes.
+            options.setdefault("max_concurrency",
+                               2 if idx == len(chain) - 1 else 1)
+            options["num_cpus"] = options.get("num_cpus", 1)
+            blob = serialization.dumps_function(node.fn._fn
+                                                if hasattr(node.fn, "_fn")
+                                                else node.fn)
+            self._stages.append(stage_cls.options(**options).remote(
+                blob, args, kwargs, []))
+        # Wire stage i -> i+1 (direct pushes).
+        wires = [self._stages[i].set_next.remote(self._stages[i + 1])
+                 for i in range(len(self._stages) - 1)]
+        ray_tpu.get(wires + [self._stages[-1].ping.remote()], timeout=120.0)
+
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._futures: Dict[int, Future] = {}
+        self._in_flight = threading.Semaphore(max_in_flight)
+        self._stop = threading.Event()
+        self._collector = threading.Thread(target=self._collect,
+                                           name="cdag-collect", daemon=True)
+        self._collector.start()
+
+    def execute(self, value) -> Future:
+        self._in_flight.acquire()
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            fut: Future = Future()
+            self._futures[seq] = fut
+        self._stages[0].push.remote(seq, value)
+        return fut
+
+    def _collect(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = ray_tpu.get(self._stages[-1].pop.remote(10.0),
+                                   timeout=30.0)
+            except Exception:
+                if self._stop.wait(0.5):
+                    return
+                continue
+            if item is None:
+                continue
+            seq, result = item
+            with self._lock:
+                fut = self._futures.pop(seq, None)
+            self._in_flight.release()
+            if fut is not None:
+                fut.set_result(result)
+
+    def teardown(self) -> None:
+        self._stop.set()
+        for stage in self._stages:
+            try:
+                ray_tpu.kill(stage)
+            except Exception:
+                pass
+
+
+# Patch .bind onto RemoteFunction (the reference exposes .bind on every
+# @ray.remote function/actor method).
+def _rf_bind(self, *args, **kwargs) -> DAGNode:
+    return _bind_function(self, *args, **kwargs)
+
+
+RemoteFunction.bind = _rf_bind

@@ -42,6 +42,54 @@ def _slice_block(block: Block, start: int, end: int) -> Block:
     return {k: v[start:end] for k, v in block.items()}
 
 
+# ----------------------------------------- shuffle/repartition exchanges
+
+@ray_tpu.remote
+def _count_block(block: Block) -> int:
+    return _block_len(block)
+
+
+@ray_tpu.remote
+def _slice_for_ranges(block: Block, offset: int, bounds: List[int]):
+    """Map half of the repartition exchange: this block covers global rows
+    [offset, offset+n); emit its intersection with each output range."""
+    n = _block_len(block)
+    out = []
+    for lo, hi in zip(bounds, bounds[1:]):
+        s = max(lo - offset, 0)
+        e = min(hi - offset, n)
+        out.append(_slice_block(block, s, max(s, e)))
+    return tuple(out) if len(out) != 1 else out[0]
+
+
+@ray_tpu.remote
+def _concat_parts(*parts: Block) -> Block:
+    live = [p for p in parts if _block_len(p)]
+    if not live:
+        return {k: v[:0] for k, v in parts[0].items()} if parts else {}
+    return _concat_blocks(live)
+
+
+@ray_tpu.remote
+def _shuffle_scatter(block: Block, num_parts: int, seed: int):
+    """Map half of the shuffle exchange: scatter rows to partitions."""
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, num_parts, _block_len(block))
+    out = [{k: v[assign == p] for k, v in block.items()}
+           for p in range(num_parts)]
+    return tuple(out) if num_parts != 1 else out[0]
+
+
+@ray_tpu.remote
+def _shuffle_combine(seed: int, *parts: Block) -> Block:
+    live = [p for p in parts if _block_len(p)]
+    if not live:
+        return {k: v[:0] for k, v in parts[0].items()} if parts else {}
+    block = _concat_blocks(live)
+    perm = np.random.default_rng(seed).permutation(_block_len(block))
+    return {k: v[perm] for k, v in block.items()}
+
+
 # ----------------------------------------------------------------- plan
 
 class _Op:
@@ -109,35 +157,59 @@ class Dataset:
         return Dataset(self._block_refs, self._ops + [_Filter(pred)])
 
     def repartition(self, num_blocks: int) -> "Dataset":
+        """Task-based repartition exchange: map tasks slice each block by
+        global row range, reduce tasks concatenate — the driver only touches
+        refs, never rows (reference: ``_internal/planner/exchange/``)."""
         mat = self.materialize()
-        blocks = [ray_tpu.get(r) for r in mat._block_refs]
-        if not blocks:
+        if not mat._block_refs:
             return mat
-        whole = _concat_blocks(blocks)
-        n = _block_len(whole)
-        per = math.ceil(n / num_blocks)
-        refs = [ray_tpu.put(_slice_block(whole, i * per,
-                                         min((i + 1) * per, n)))
-                for i in range(num_blocks) if i * per < n]
-        return Dataset(refs)
+        counts = ray_tpu.get([_count_block.remote(r)
+                              for r in mat._block_refs])
+        total = sum(counts)
+        if total == 0:
+            return mat
+        per = math.ceil(total / num_blocks)
+        bounds = [min(i * per, total) for i in range(num_blocks + 1)]
+        parts = []  # parts[b][p] = ref to the slice of block b for output p
+        offset = 0
+        for ref, count in zip(mat._block_refs, counts):
+            out = _slice_for_ranges.options(
+                num_returns=num_blocks).remote(ref, offset, bounds)
+            parts.append(out if isinstance(out, list) else [out])
+            offset += count
+        live = [p for p, (lo, hi) in enumerate(zip(bounds, bounds[1:]))
+                if hi > lo]
+        out_refs = [
+            _concat_parts.remote(*[parts[b][p]
+                                   for b in range(len(parts))])
+            for p in live]
+        return Dataset(out_refs)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle: permute rows across all blocks (the reference's
-        all-to-all shuffle exchange, simplified to a gather-permute —
-        sufficient below the multi-node scale)."""
+        """Distributed all-to-all shuffle: map tasks scatter each block's
+        rows to P partitions at random; reduce tasks concatenate and permute
+        within the partition. No rows ever land on the driver (reference:
+        the shuffle exchange, ``_internal/planner/exchange/
+        shuffle_task_scheduler.py``); O(dataset) memory total stays spread
+        over the cluster's stores."""
         mat = self.materialize()
-        blocks = [ray_tpu.get(r) for r in mat._block_refs]
-        if not blocks:
+        num_parts = len(mat._block_refs)
+        if num_parts == 0:
             return mat
-        whole = _concat_blocks(blocks)
-        n = _block_len(whole)
-        rng = np.random.default_rng(seed)
-        perm = rng.permutation(n)
-        shuffled = {k: v[perm] for k, v in whole.items()}
-        per = max(1, math.ceil(n / max(1, len(mat._block_refs))))
-        refs = [ray_tpu.put(_slice_block(shuffled, i, min(i + per, n)))
-                for i in range(0, n, per)]
-        return Dataset(refs)
+        if seed is None:  # unseeded shuffles must differ run to run
+            seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+        base_seed = seed
+        parts = []
+        for i, ref in enumerate(mat._block_refs):
+            out = _shuffle_scatter.options(num_returns=num_parts).remote(
+                ref, num_parts, base_seed + 7919 * i)
+            parts.append(out if isinstance(out, list) else [out])
+        out_refs = [
+            _shuffle_combine.remote(base_seed + 104729 * p,
+                                    *[parts[b][p]
+                                      for b in range(len(parts))])
+            for p in range(num_parts)]
+        return Dataset(out_refs)
 
     # --------------------------------------------------------- execution
 

@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import attention
 from ray_tpu.ops.norms import rms_norm
@@ -54,13 +55,26 @@ class LlamaConfig:
     attention_impl: str = "xla"
     remat: bool = True
     # Remat policy: "full" recomputes everything (min memory); "dots" saves
-    # matmul outputs and recomputes only elementwise ops (higher MFU when
-    # HBM allows — the standard knob on TPU).
+    # matmul outputs and recomputes only elementwise ops; "names" saves the
+    # two expensive per-layer intermediates (attention output, ffn hidden)
+    # so the backward recomputes only cheap projections/elementwise — the
+    # middle point that usually maximizes MFU within HBM on TPU.
     remat_policy: str = "full"
     # Cross-entropy in sequence chunks of this many tokens (0 = whole
     # sequence): avoids materializing the full fp32 (B,S,V) logits, the
     # single largest activation at small model sizes.
     loss_chunk: int = 0
+    # Fuse q/k/v into one (E, H+2KV, D) projection and gate/up into one
+    # (E, 2M): fewer, larger matmuls — higher MXU utilization on TPU
+    # (MaxText-style fused projections).
+    fused_qkv: bool = False
+    fused_mlp: bool = False
+    # Mixture-of-Experts: replace the dense MLP with moe_experts experts
+    # (top-k routing, expert-parallel over the mesh's ``expert`` axis).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -68,12 +82,16 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         p = self.vocab_size * self.dim  # embed
+        mlp_params = 3 * self.dim * self.mlp_dim
+        if self.moe_experts:
+            mlp_params = (self.moe_experts * 3 * self.dim * self.mlp_dim
+                          + self.dim * self.moe_experts)
         per_layer = (
             2 * self.dim  # norms
             + self.dim * self.n_heads * self.head_dim
             + 2 * self.dim * self.n_kv_heads * self.head_dim
             + self.n_heads * self.head_dim * self.dim
-            + 3 * self.dim * self.mlp_dim
+            + mlp_params
         )
         p += self.n_layers * per_layer
         p += self.dim  # final norm
@@ -106,21 +124,36 @@ def config_for(name_or_config) -> LlamaConfig:
 
 # ------------------------------------------------------------------ params
 
-def param_axes() -> Dict[str, Any]:
+def param_axes(config: Optional[LlamaConfig] = None) -> Dict[str, Any]:
     """Logical axis names, mirroring the params pytree structure."""
+    c = config
+    layers: Dict[str, Any] = {
+        "attn_norm": ("layers", "embed"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    if c is not None and c.fused_qkv:
+        layers["wqkv"] = ("layers", "embed", "heads", "head_dim")
+    else:
+        layers["wq"] = ("layers", "embed", "heads", "head_dim")
+        layers["wk"] = ("layers", "embed", "kv_heads", "head_dim")
+        layers["wv"] = ("layers", "embed", "kv_heads", "head_dim")
+    if c is not None and c.moe_experts:
+        layers["moe"] = {
+            "router": ("layers", "embed", "expert_dim"),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        }
+    elif c is not None and c.fused_mlp:
+        layers["w_gate_up"] = ("layers", "embed", "mlp")
+    else:
+        layers["w_gate"] = ("layers", "embed", "mlp")
+        layers["w_up"] = ("layers", "embed", "mlp")
     return {
         "tok_embed": ("vocab", "embed"),
-        "layers": {
-            "attn_norm": ("layers", "embed"),
-            "wq": ("layers", "embed", "heads", "head_dim"),
-            "wk": ("layers", "embed", "kv_heads", "head_dim"),
-            "wv": ("layers", "embed", "kv_heads", "head_dim"),
-            "wo": ("layers", "heads", "head_dim", "embed"),
-            "mlp_norm": ("layers", "embed"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
-        },
+        "layers": layers,
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
     }
@@ -141,19 +174,35 @@ def init_params(config: LlamaConfig, key: jax.Array,
     lk = jax.random.split(k_layers, 7)
     L, E, H, KV, D, M = (c.n_layers, c.dim, c.n_heads, c.n_kv_heads,
                          c.head_dim, c.mlp_dim)
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, E), dtype),
+        "wo": normal(lk[3], (L, H, D, E), fan_in=H * D),
+        "mlp_norm": jnp.ones((L, E), dtype),
+        "w_down": normal(lk[6], (L, M, E), fan_in=M),
+    }
+    if c.fused_qkv:
+        layers["wqkv"] = normal(lk[0], (L, E, H + 2 * KV, D), fan_in=E)
+    else:
+        layers["wq"] = normal(lk[0], (L, E, H, D), fan_in=E)
+        layers["wk"] = normal(lk[1], (L, E, KV, D), fan_in=E)
+        layers["wv"] = normal(lk[2], (L, E, KV, D), fan_in=E)
+    if c.moe_experts:
+        nk = jax.random.split(lk[4], 4)
+        X = c.moe_experts
+        layers["moe"] = {
+            "router": normal(nk[0], (L, E, X), fan_in=E),
+            "w_gate": normal(nk[1], (L, X, E, M), fan_in=E),
+            "w_up": normal(nk[2], (L, X, E, M), fan_in=E),
+            "w_down": normal(nk[3], (L, X, M, E), fan_in=M),
+        }
+    elif c.fused_mlp:
+        layers["w_gate_up"] = normal(lk[4], (L, E, 2 * M), fan_in=E)
+    else:
+        layers["w_gate"] = normal(lk[4], (L, E, M), fan_in=E)
+        layers["w_up"] = normal(lk[5], (L, E, M), fan_in=E)
     return {
         "tok_embed": normal(k_embed, (c.vocab_size, E)),
-        "layers": {
-            "attn_norm": jnp.ones((L, E), dtype),
-            "wq": normal(lk[0], (L, E, H, D), fan_in=E),
-            "wk": normal(lk[1], (L, E, KV, D), fan_in=E),
-            "wv": normal(lk[2], (L, E, KV, D), fan_in=E),
-            "wo": normal(lk[3], (L, H, D, E), fan_in=H * D),
-            "mlp_norm": jnp.ones((L, E), dtype),
-            "w_gate": normal(lk[4], (L, E, M), fan_in=E),
-            "w_up": normal(lk[5], (L, E, M), fan_in=E),
-            "w_down": normal(lk[6], (L, M, E), fan_in=M),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((E,), dtype),
         "lm_head": normal(k_head, (E, c.vocab_size), fan_in=E),
     }
@@ -167,9 +216,15 @@ def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
     h = constrain(h, ("batch", "length", "act_embed"))
 
-    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
-    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
-    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+    if "wqkv" in layer:
+        qkv = jnp.einsum("bse,ehd->bshd", h, layer["wqkv"].astype(h.dtype))
+        q = qkv[:, :, :c.n_heads]
+        k = qkv[:, :, c.n_heads:c.n_heads + c.n_kv_heads]
+        v = qkv[:, :, c.n_heads + c.n_kv_heads:]
+    else:
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "length", "heads", "head_dim"))
@@ -191,16 +246,32 @@ def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
     else:
         attn = attention(q, k, v, causal=True, q_offset=q_offset,
                          impl=c.attention_impl)
+    attn = checkpoint_name(attn, "attn_out")
     out = jnp.einsum("bshd,hde->bse", attn, layer["wo"].astype(h.dtype))
     x = x + constrain(out, ("batch", "length", "act_embed"))
 
     h2 = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-    gate = jnp.einsum("bse,em->bsm", h2, layer["w_gate"].astype(h2.dtype))
-    up = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+    if "moe" in layer:
+        from ray_tpu.ops.moe import moe_ffn
+
+        out, aux = moe_ffn(h2, layer["moe"], top_k=c.moe_top_k,
+                           capacity_factor=c.moe_capacity_factor)
+        out = constrain(out, ("batch", "length", "act_embed"))
+        return x + out, aux
+    if "w_gate_up" in layer:
+        gate_up = jnp.einsum("bse,em->bsm", h2,
+                             layer["w_gate_up"].astype(h2.dtype))
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+    else:
+        gate = jnp.einsum("bse,em->bsm", h2,
+                          layer["w_gate"].astype(h2.dtype))
+        up = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
     ffn = jax.nn.silu(gate) * up
+    ffn = checkpoint_name(ffn, "mlp_hidden")
     ffn = constrain(ffn, ("batch", "length", "mlp"))
     down = jnp.einsum("bsm,me->bse", ffn, layer["w_down"].astype(h2.dtype))
-    return x + constrain(down, ("batch", "length", "act_embed"))
+    return x + constrain(down, ("batch", "length", "act_embed")), jnp.zeros(
+        (), jnp.float32)
 
 
 def hidden_states(params: Dict[str, Any], tokens: jax.Array,
@@ -211,23 +282,29 @@ def hidden_states(params: Dict[str, Any], tokens: jax.Array,
     x = constrain(x, ("batch", "length", "act_embed"))
     cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
 
-    def body(x, layer):
-        return _decoder_layer(c, x, layer, cos, sin, 0), None
+    def body(carry, layer):
+        x, aux_sum = carry
+        x, aux = _decoder_layer(c, x, layer, cos, sin, 0)
+        return (x, aux_sum + aux), None
 
     if c.remat:
         policy = None
         if c.remat_policy == "dots":
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif c.remat_policy == "names":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_hidden")
         body = jax.checkpoint(body, prevent_cse=False, policy=policy)
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    return rms_norm(x, params["final_norm"], c.norm_eps)
+    (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                                   params["layers"])
+    return rms_norm(x, params["final_norm"], c.norm_eps), aux_sum
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array,
             config: LlamaConfig) -> jax.Array:
     """Token ids (B, S) -> logits (B, S, V) in fp32."""
     c = config
-    x = hidden_states(params, tokens, config)
+    x, _aux = hidden_states(params, tokens, config)
     logits = jnp.einsum("bse,ev->bsv", x,
                         params["lm_head"].astype(c.dtype),
                         preferred_element_type=jnp.float32)
@@ -255,7 +332,7 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    x = hidden_states(params, inputs, c)
+    x, aux = hidden_states(params, inputs, c)
     lm_head = params["lm_head"].astype(c.dtype)
     b, s, _ = x.shape
     chunk = c.loss_chunk
@@ -270,13 +347,19 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
 
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                                 (x_chunks, t_chunks))
-        return total / (b * s)
+        loss = total / (b * s)
+        if c.moe_experts:
+            loss = loss + c.moe_aux_coef * aux / c.n_layers
+        return loss
     logits = jnp.einsum("bse,ev->bsv", x, lm_head,
                         preferred_element_type=jnp.float32)
     logits = constrain(logits, ("batch", "length", "vocab"))
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    loss = jnp.mean(logz - gold)
+    if c.moe_experts:
+        loss = loss + c.moe_aux_coef * aux / c.n_layers
+    return loss
 
 
 def flops_per_token(config: LlamaConfig, seq_len: int) -> float:

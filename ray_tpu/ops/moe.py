@@ -1,0 +1,136 @@
+"""Mixture-of-Experts FFN with expert parallelism (GSPMD dispatch).
+
+SURVEY §2.4's EP row: the reference has no MoE (its role is placement);
+the TPU build makes expert parallelism first-class. This is the
+GShard/Switch dispatch formulation expressed as einsums so GSPMD lowers
+the token->expert exchange to an all-to-all over the ``expert`` mesh axis
+(SURVEY §5.8 plane 3 — declared, not hand-written):
+
+    router logits -> top-k gates -> capacity-bounded dispatch mask
+    expert_in  (E, C, D)  = dispatch^T tokens      [all-to-all]
+    expert_out (E, C, D)  = per-expert FFN (batched matmul, E sharded)
+    out        (T, D)     = combine expert_out     [all-to-all back]
+
+Dropped tokens (beyond expert capacity) pass through the residual stream —
+standard Switch behavior. Gates are renormalized over the selected top-k.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import constrain
+
+
+def router_topk(
+    logits: jax.Array,  # (T, E) fp32
+    k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routing with per-expert capacity. Returns
+    (dispatch (T, E, C) one-hot, combine (T, E, C) gate weights)."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (T, k)
+    if k > 1:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+    # k == 1 keeps the raw softmax prob (Switch Transformer): renormalizing
+    # to 1.0 would cut the router out of the gradient path entirely.
+
+    # Position of each (token, choice) in its expert's queue: cumulative
+    # count of prior assignments to that expert (priority = token order).
+    choice_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
+    # (T, k, E) -> flatten choices in (token-major, choice-minor) priority.
+    flat = choice_onehot.reshape(t * k, e)
+    positions = (jnp.cumsum(flat, axis=0) - flat).reshape(t, k, e)
+    pos_in_expert = (positions * choice_onehot).sum(-1)  # (T, k)
+    keep = pos_in_expert < capacity
+
+    dispatch = (
+        jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)[..., None]
+        * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[
+            :, :, None, :]
+        * keep[..., None, None]
+    ).sum(1)  # (T, E, C)
+    combine = dispatch * gate_vals.sum(1)[:, None, None] if k == 1 else (
+        jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)[..., None]
+        * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[
+            :, :, None, :]
+        * (keep * gate_vals)[..., None, None]
+    ).sum(1)
+    return dispatch, combine
+
+
+def moe_ffn(
+    x: jax.Array,               # (B, S, D)
+    params: Dict[str, Any],     # router (D,E); w_gate/w_up (E,D,M); w_down (E,M,D)
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE feed-forward; returns (output (B,S,D), aux load-balance loss).
+
+    Expert weights carry the ``expert`` logical axis so GSPMD shards the
+    per-expert batched matmuls over the expert mesh axis and inserts the
+    dispatch/combine all-to-alls.
+    """
+    b, s, d = x.shape
+    e = params["router"].shape[-1]
+    t = b * s
+    capacity = max(1, int(capacity_factor * top_k * t / e))
+    tokens = x.reshape(t, d)
+
+    logits = jnp.einsum("td,de->te", tokens.astype(jnp.float32),
+                        params["router"].astype(jnp.float32))
+    dispatch, combine = router_topk(logits, top_k, capacity)
+
+    # Switch-style load-balance auxiliary loss.
+    probs = jax.nn.softmax(logits, axis=-1)
+    frac_tokens = dispatch.sum((0, 2)) / jnp.maximum(dispatch.sum(), 1.0)
+    frac_probs = probs.mean(0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    compute = x.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(compute),
+                           tokens)  # all-to-all (token -> expert shards)
+    expert_in = constrain(expert_in, ("expert", None, None))
+    gate = jnp.einsum("ecd,edm->ecm", expert_in,
+                      params["w_gate"].astype(compute))
+    up = jnp.einsum("ecd,edm->ecm", expert_in,
+                    params["w_up"].astype(compute))
+    act = jax.nn.silu(gate) * up
+    expert_out = jnp.einsum("ecm,emd->ecd", act,
+                            params["w_down"].astype(compute))
+    expert_out = constrain(expert_out, ("expert", None, None))
+    out = jnp.einsum("tec,ecd->td", combine.astype(compute), expert_out)
+    return out.reshape(b, s, d), aux.astype(jnp.float32)
+
+
+def init_moe_params(key: jax.Array, dim: int, mlp_dim: int,
+                    num_experts: int, dtype=jnp.float32) -> Dict[str, Any]:
+    import math
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def normal(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": normal(k1, (dim, num_experts), dim),
+        "w_gate": normal(k2, (num_experts, dim, mlp_dim), dim),
+        "w_up": normal(k3, (num_experts, dim, mlp_dim), dim),
+        "w_down": normal(k4, (num_experts, mlp_dim, dim), mlp_dim),
+    }
+
+
+def moe_param_axes() -> Dict[str, Any]:
+    return {
+        "router": ("embed", "expert_dim"),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }

@@ -1,0 +1,73 @@
+"""TPU-fused optimizers.
+
+``optax.adamw`` builds its update from several chained transformations
+(scale_by_adam -> weight decay -> scale), each a separate tree pass; under
+donation-heavy scans XLA doesn't always collapse them, and on
+bandwidth-bound chips the optimizer becomes a measurable slice of the step
+(452 ms for 711M params on the round-2 bench chip vs ~90 ms of theoretical
+HBM traffic). ``adamw`` here emits ONE fused elementwise kernel per leaf —
+m, v, and the parameter update computed in a single pass — while keeping
+the optax ``GradientTransformation`` interface so it drops into the
+existing train-step builder unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedAdamWState(NamedTuple):
+    count: jax.Array
+    mu: optax.Params
+    nu: optax.Params
+
+
+def adamw(learning_rate, b1: float = 0.9, b2: float = 0.999,
+          eps: float = 1e-8, weight_decay: float = 0.0,
+          mu_dtype=None) -> optax.GradientTransformation:
+    """Drop-in fused AdamW (same math as ``optax.adamw``: decoupled weight
+    decay applied with the learning rate)."""
+
+    def init(params):
+        return FusedAdamWState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(
+                lambda p: jnp.zeros_like(
+                    p, dtype=mu_dtype or p.dtype), params),
+            nu=jax.tree.map(lambda p: jnp.zeros_like(p), params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused adamw requires params")
+        count = state.count + 1
+        lr = (learning_rate(count) if callable(learning_rate)
+              else learning_rate)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def leaf(g, m, v, p):
+            g = g.astype(v.dtype)
+            m2 = b1 * m.astype(v.dtype) + (1.0 - b1) * g
+            v2 = b2 * v + (1.0 - b2) * (g * g)
+            mhat = m2 / c1
+            vhat = v2 / c2
+            upd = mhat / (jnp.sqrt(vhat) + eps)
+            if weight_decay:
+                upd = upd + weight_decay * p.astype(v.dtype)
+            return (-lr * upd).astype(p.dtype), m2.astype(m.dtype), v2
+
+        flat = jax.tree.map(leaf, grads, state.mu, state.nu, params)
+        updates = jax.tree.map(lambda t: t[0], flat,
+                               is_leaf=lambda t: isinstance(t, tuple))
+        mu = jax.tree.map(lambda t: t[1], flat,
+                          is_leaf=lambda t: isinstance(t, tuple))
+        nu = jax.tree.map(lambda t: t[2], flat,
+                          is_leaf=lambda t: isinstance(t, tuple))
+        return updates, FusedAdamWState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)

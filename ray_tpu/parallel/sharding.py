@@ -42,6 +42,8 @@ DEFAULT_RULES: Rules = {
     "head_dim": None,
     "mlp": "tensor",
     "experts": "expert",
+    "expert": "expert",      # stacked per-expert weights (MoE)
+    "expert_dim": None,      # router output dim (E as a feature axis)
     "layers": None,  # scanned-layer leading axis
     "norm": None,
 }

@@ -1,0 +1,101 @@
+"""Runtime environments: per-task/actor env vars + working_dir packages.
+
+Analogue of the reference's runtime-env subsystem
+(``_private/runtime_env/agent/runtime_env_agent.py:162`` builds envs on
+each node; ``packaging.py`` ships working_dir zips through the GCS KV).
+The supported spec keys:
+
+* ``env_vars``: dict merged into the worker's environment at fork.
+* ``working_dir``: local path (same-host clusters) or ``kv://<key>`` from
+  :func:`upload_working_dir` — extracted once per node per env hash, set
+  as the worker's cwd and prepended to ``PYTHONPATH``.
+
+Workers are pooled per runtime-env hash (reference: worker_pool.h's
+runtime_env_hash matching), so repeated tasks with the same env reuse
+their workers.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+from typing import Any, Dict
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+def package_working_dir(path: str) -> bytes:
+    """Zip a working directory (reference: packaging.py's package zips)."""
+    buf = io.BytesIO()
+    root = os.path.abspath(path)
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in _EXCLUDE_DIRS]
+            for fname in filenames:
+                full = os.path.join(dirpath, fname)
+                total += os.path.getsize(full)
+                if total > _MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"working_dir {path} exceeds "
+                        f"{_MAX_PACKAGE_BYTES >> 20} MiB")
+                zf.write(full, os.path.relpath(full, root))
+    return buf.getvalue()
+
+
+def upload_working_dir(path: str) -> str:
+    """Package + upload a working dir to the cluster KV; returns the
+    ``kv://`` URI to put in ``runtime_env['working_dir']``."""
+    import hashlib
+
+    from ray_tpu.core.runtime import get_core_worker
+
+    blob = package_working_dir(path)
+    key = f"__pkg__/{hashlib.sha1(blob).hexdigest()[:20]}.zip"
+    get_core_worker().controller.call("kv_put", key, blob)
+    return f"kv://{key}"
+
+
+def materialize_working_dir(spec: str, controller_client) -> str:
+    """Resolve a working_dir spec to a local directory: plain paths pass
+    through; ``kv://`` packages are fetched from the controller KV and
+    extracted once per content hash (used by the worker pool AND job
+    supervisors)."""
+    if not str(spec).startswith("kv://"):
+        return str(spec)
+    import hashlib
+
+    key = str(spec)[len("kv://"):]
+    dest = os.path.join("/tmp/ray_tpu_envs",
+                        hashlib.sha1(key.encode()).hexdigest()[:16])
+    marker = os.path.join(dest, ".ready")
+    if not os.path.exists(marker):
+        blob = controller_client.call("kv_get", key)
+        if blob is None:
+            raise RuntimeError(f"working_dir package {key} not in KV")
+        os.makedirs(dest, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(dest)
+        with open(marker, "w") as f:
+            f.write("ok")
+    return dest
+
+
+def normalize(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate + normalize a runtime_env spec (uploads local working_dir
+    automatically when the cluster spans hosts is the caller's choice —
+    pass a kv:// URI for that)."""
+    out: Dict[str, Any] = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    wd = runtime_env.get("working_dir")
+    if wd:
+        out["working_dir"] = str(wd)
+    unknown = set(runtime_env) - {"env_vars", "working_dir"}
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
+                         "(supported: env_vars, working_dir)")
+    return out

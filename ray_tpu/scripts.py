@@ -1,0 +1,177 @@
+"""State CLI: ``python -m ray_tpu <command>``.
+
+Analogue of the reference's state observability surface
+(``python/ray/util/state/state_cli.py`` — ``ray list nodes/actors/tasks``,
+``ray status``, ``ray timeline``). Talks to the cluster controller over the
+same RPC the SDK uses; the controller's address comes from ``--address``,
+``RAY_TPU_ADDRESS``, or the discovery file the newest controller writes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DISCOVERY_PATH = "/tmp/ray_tpu/cluster_latest.json"
+
+
+def write_discovery(addr: Tuple[str, int]) -> None:
+    try:
+        os.makedirs(os.path.dirname(DISCOVERY_PATH), exist_ok=True)
+        with open(DISCOVERY_PATH, "w") as f:
+            json.dump({"address": list(addr), "pid": os.getpid()}, f)
+    except OSError:
+        pass
+
+
+def resolve_address(flag: Optional[str]) -> Tuple[str, int]:
+    spec = flag or os.environ.get("RAY_TPU_ADDRESS")
+    if spec:
+        host, _, port = spec.partition(":")
+        return (host, int(port))
+    try:
+        with open(DISCOVERY_PATH) as f:
+            return tuple(json.load(f)["address"])
+    except (OSError, KeyError, ValueError):
+        raise SystemExit(
+            "no cluster address: pass --address host:port, set "
+            "RAY_TPU_ADDRESS, or start a cluster on this machine first")
+
+
+def _client(args):
+    from ray_tpu.core.rpc import RpcClient
+
+    return RpcClient(resolve_address(args.address))
+
+
+def _table(rows: List[Dict[str, Any]], columns: List[str]) -> str:
+    if not rows:
+        return "(none)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    head = "  ".join(c.ljust(widths[c]) for c in columns)
+    sep = "  ".join("-" * widths[c] for c in columns)
+    body = "\n".join(
+        "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns)
+        for r in rows)
+    return f"{head}\n{sep}\n{body}"
+
+
+def cmd_status(args) -> None:
+    client = _client(args)
+    nodes = client.call("list_nodes")
+    total = client.call("cluster_resources")
+    alive = [n for n in nodes if n["alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    print(f"cluster resources: {total}")
+    avail: Dict[str, float] = {}
+    for n in alive:
+        for k, v in n["available"].items():
+            avail[k] = avail.get(k, 0.0) + v
+    print(f"available: {avail}")
+
+
+def cmd_list(args) -> None:
+    client = _client(args)
+    kind = args.kind
+    if kind == "nodes":
+        rows = client.call("list_nodes")
+        for r in rows:
+            r["addr"] = f"{r['addr'][0]}:{r['addr'][1]}"
+            r["node_id"] = r["node_id"][:16]
+        print(_table(rows, ["node_id", "addr", "alive", "resources",
+                            "available", "queue_len"]))
+    elif kind == "actors":
+        rows = client.call("list_actors")
+        out = []
+        for r in rows:
+            info = r.get("info", {})
+            out.append({
+                "actor_id": r["actor_id"].hex()[:16],
+                "class": info.get("class_name", ""),
+                "name": info.get("name") or "",
+                "state": r["state"],
+                "restarts": r["num_restarts"],
+            })
+        print(_table(out, ["actor_id", "class", "name", "state",
+                           "restarts"]))
+    elif kind == "jobs":
+        jobs = client.call("list_jobs")
+        rows = [{"job_id": j, **info} for j, info in jobs.items()]
+        print(_table(rows, ["job_id", "state"]))
+    elif kind == "tasks":
+        rows = client.call("list_task_events", args.limit)
+        out = []
+        for r in rows:
+            dur = ""
+            if r.get("end_ts") and r.get("lease_ts"):
+                dur = f"{(r['end_ts'] - r['lease_ts']) * 1000:.1f}ms"
+            out.append({
+                "task_id": r["task_id"][:16],
+                "desc": r.get("desc", "")[:40],
+                "state": r.get("state", ""),
+                "duration": dur,
+                "worker": (r.get("worker") or "")[:12],
+            })
+        print(_table(out, ["task_id", "desc", "state", "duration",
+                           "worker"]))
+    elif kind == "metrics":
+        print(client.call("metrics_text"), end="")
+    else:
+        raise SystemExit(f"unknown kind {kind!r}")
+
+
+def cmd_timeline(args) -> None:
+    """Dump task events as a Chrome trace (chrome://tracing /
+    ui.perfetto.dev) — reference: ``ray timeline``,
+    ``_private/state.py:942``."""
+    client = _client(args)
+    events = client.call("list_task_events", args.limit)
+    trace = []
+    for e in events:
+        if not e.get("lease_ts") or not e.get("end_ts"):
+            continue
+        trace.append({
+            "name": e.get("desc", e["task_id"][:8]),
+            "cat": "task",
+            "ph": "X",
+            "ts": e["lease_ts"] * 1e6,
+            "dur": (e["end_ts"] - e["lease_ts"]) * 1e6,
+            "pid": str(e.get("owner", "driver")),
+            "tid": e.get("worker") or "worker",
+            "args": {"state": e.get("state")},
+        })
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {args.output}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster state CLI")
+    parser.add_argument("--address", default=None,
+                        help="controller host:port")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("status")
+    p_list = sub.add_parser("list")
+    p_list.add_argument("kind", choices=["nodes", "actors", "jobs", "tasks",
+                                         "metrics"])
+    p_list.add_argument("--limit", type=int, default=1000)
+    p_tl = sub.add_parser("timeline")
+    p_tl.add_argument("--output", "-o", default="timeline.json")
+    p_tl.add_argument("--limit", type=int, default=10000)
+    args = parser.parse_args(argv)
+    if args.command == "status":
+        cmd_status(args)
+    elif args.command == "list":
+        cmd_list(args)
+    elif args.command == "timeline":
+        cmd_timeline(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

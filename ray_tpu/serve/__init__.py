@@ -15,3 +15,7 @@ from ray_tpu.serve.deployment import (  # noqa: F401
     DeploymentHandle,
     deployment,
 )
+from ray_tpu.serve.replica import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)

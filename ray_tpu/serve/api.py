@@ -1,104 +1,94 @@
-"""serve.run / HTTP proxy / lifecycle.
+"""serve.run / status / delete / HTTP proxy.
 
 Analogue of the reference's ``serve.run`` + proxy (``serve/api.py``,
-``serve/_private/proxy.py:761,1130``). The HTTP proxy is a stdlib threading
-HTTP server routing ``POST /<deployment>`` with a JSON body to the
-deployment handle — the uvicorn/gRPC surface of the reference condensed to
-the protocol that matters for parity tests; replicas and routing are the
-real stack underneath.
+``serve/_private/proxy.py:761,1130``). All control-plane state lives in the
+ServeController ACTOR (``controller.py``) — this module is a thin client, so
+deployments survive the driver that created them; a later driver resolves
+the controller by name and keeps operating the same apps.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from ray_tpu.serve.deployment import (
-    Deployment,
-    DeploymentHandle,
-    _DeploymentState,
-)
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.serve.controller import get_or_create_controller
+from ray_tpu.serve.deployment import Deployment, DeploymentHandle, _Router
 
-_deployments: Dict[str, _DeploymentState] = {}
-_reconciler: Optional[threading.Thread] = None
 _http_server: Optional[ThreadingHTTPServer] = None
-_stop = threading.Event()
 
 
 def run(app: Deployment, name: Optional[str] = None,
-        route_prefix: Optional[str] = None) -> DeploymentHandle:
+        route_prefix: Optional[str] = None,
+        ready_timeout_s: float = 60.0) -> DeploymentHandle:
     """Deploy (or redeploy) an application; returns its handle."""
-    global _reconciler
     name = name or app.name
-    if name in _deployments:
-        _deployments[name].shutdown()
-    state = _DeploymentState(app)
-    _deployments[name] = state
-    if _reconciler is None or not _reconciler.is_alive():
-        _stop.clear()
-        _reconciler = threading.Thread(target=_reconcile_loop,
-                                       name="serve-reconcile", daemon=True)
-        _reconciler.start()
-    return DeploymentHandle(state)
+    controller = get_or_create_controller()
+    version = ray_tpu.get(controller.deploy.remote(
+        name, serialization.dumps_function(app.cls), app._init_args,
+        app._init_kwargs, app.config_dict()), timeout=ready_timeout_s)
+    handle = DeploymentHandle(name)
+    router = _Router.get(name)
+    if version is not None:
+        router.wait_version(version, ready_timeout_s)
+    else:
+        router.wait_ready(ready_timeout_s)
+    return handle
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
-    return DeploymentHandle(_deployments[name])
+    return DeploymentHandle(name)
 
 
-def status() -> Dict[str, Any]:
-    return {name: {"replicas": s.num_replicas()}
-            for name, s in _deployments.items()}
+def status(timeout: float = 30.0) -> Dict[str, Any]:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=timeout)
 
 
-def delete(name: str) -> None:
-    state = _deployments.pop(name, None)
-    if state is not None:
-        state.shutdown()
+def delete(name: str, timeout: float = 30.0) -> None:
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete.remote(name), timeout=timeout)
 
 
 def shutdown() -> None:
+    """Tear down all deployments AND the controller actor."""
     global _http_server
-    _stop.set()
-    for name in list(_deployments):
-        delete(name)
+    try:
+        controller = get_or_create_controller()
+        ray_tpu.get(controller.shutdown.remote(), timeout=30.0)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    _Router.reset_all()
     if _http_server is not None:
         _http_server.shutdown()
         _http_server = None
 
 
-def _reconcile_loop() -> None:
-    """Controller reconcile: autoscaling + dead-replica replacement
-    (reference: ServeController loop)."""
-    while not _stop.wait(0.25):
-        for state in list(_deployments.values()):
-            try:
-                state.reconcile()
-            except Exception:
-                pass
-
-
 class _ProxyHandler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 (stdlib API)
-        name = self.path.strip("/").split("/")[0]
-        state = _deployments.get(name)
-        if state is None:
-            self.send_error(404, f"no deployment {name!r}")
-            return
+        parts = self.path.strip("/").split("/")
+        name = parts[0]
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b"null"
+        model_id = self.headers.get("serve_multiplexed_model_id", "")
         try:
             payload = json.loads(body)
-            result = state.submit("__call__", (payload,), {}).result(
-                timeout=60)
+            handle = DeploymentHandle(name, multiplexed_model_id=model_id)
+            result = handle.remote(payload).result(timeout=70)
             data = json.dumps(result).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+        except KeyError:
+            self.send_error(404, f"no deployment {name!r}")
         except Exception as e:  # noqa: BLE001
             self.send_error(500, str(e))
 

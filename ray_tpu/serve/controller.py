@@ -1,0 +1,325 @@
+"""ServeController: the serving control plane, as a named actor.
+
+Analogue of the reference's ``ServeController`` actor
+(``serve/_private/controller.py:86``; ``deploy_application`` :719,
+``deployment_state.py`` reconciliation): it owns deployment configs and
+replica actors, heals dead replicas, autoscales on replica-reported load
+(``autoscaling_policy.py:12``), and pushes routing snapshots to every
+handle via the cluster pubsub hub (the reference's ``LongPollHost``,
+``long_poll.py:173``). Because it is an actor — not driver state — the
+serving plane survives the deploying driver's exit; any process can pick
+up a ``DeploymentHandle`` by name.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "_ray_tpu_serve_controller"
+SNAPSHOT_CHANNEL = "serve_routes"
+
+
+class ReplicaRecord:
+    def __init__(self, handle, replica_id: str):
+        self.handle = handle
+        self.replica_id = replica_id
+        self.last_stats: Dict[str, Any] = {}
+        self.created = time.monotonic()
+
+
+class DeploymentRecord:
+    def __init__(self, name: str, cls_blob: bytes, init_args, init_kwargs,
+                 cfg: Dict[str, Any]):
+        self.name = name
+        self.cls_blob = cls_blob
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.cfg = cfg
+        self.replicas: List[ReplicaRecord] = []
+        self.next_replica_ord = 0
+        self.last_scale = time.monotonic()
+        self.deleting = False
+        # Serializes structural changes (deploy's settle vs reconcile) so
+        # two threads can't both observe len < target and double-add.
+        self.lock = threading.Lock()
+
+
+class ServeController:
+    """Runs as a named actor; all methods are invoked via actor calls."""
+
+    def __init__(self):
+        self._deployments: Dict[str, DeploymentRecord] = {}
+        self._last_models: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reconciler = threading.Thread(
+            target=self._reconcile_loop, name="serve-reconcile", daemon=True)
+        self._reconciler.start()
+
+    # ------------------------------------------------------------ deploy
+
+    def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
+               cfg: Dict[str, Any]) -> Optional[int]:
+        """Create or update a deployment (reference: deploy_application).
+        Config change redeploys replicas; scale-only change adjusts count.
+
+        The old record is marked ``deleting`` under the lock BEFORE its
+        replicas drain, and the reconcile loop re-validates record identity
+        under the same lock — otherwise a reconcile tick that snapshotted
+        the old record could resurrect old-class replicas and publish them
+        over the live name."""
+        with self._lock:
+            old = self._deployments.get(name)
+            rec = DeploymentRecord(name, cls_blob, init_args, init_kwargs,
+                                   cfg)
+            drain_old = False
+            if old is not None:
+                if (old.cls_blob == cls_blob
+                        and old.init_args == init_args
+                        and old.init_kwargs == init_kwargs):
+                    rec.replicas = old.replicas  # rolling config update
+                    rec.next_replica_ord = old.next_replica_ord
+                else:
+                    old.deleting = True
+                    drain_old = True
+            self._deployments[name] = rec
+        if drain_old:
+            self._drain(old)
+        with rec.lock:
+            return self._settle(rec)
+
+    def _target_replicas(self, rec: DeploymentRecord) -> int:
+        auto = rec.cfg.get("autoscaling")
+        if auto:
+            return max(auto["min_replicas"],
+                       min(auto["max_replicas"], len(rec.replicas) or
+                           auto["min_replicas"]))
+        return rec.cfg.get("num_replicas", 1)
+
+    def _settle(self, rec: DeploymentRecord) -> Optional[int]:
+        target = self._target_replicas(rec)
+        while len(rec.replicas) < target:
+            self._add_replica(rec)
+        while len(rec.replicas) > target:
+            self._remove_replica(rec)
+        return self._publish(rec)
+
+    def _add_replica(self, rec: DeploymentRecord) -> None:
+        from ray_tpu.serve.replica import ReplicaActor
+
+        actor_cls = ray_tpu.remote(ReplicaActor)
+        opts = dict(rec.cfg.get("actor_options") or {})
+        opts.setdefault("max_concurrency",
+                        rec.cfg.get("max_ongoing_requests", 8))
+        replica_id = f"{rec.name}#{rec.next_replica_ord}"
+        rec.next_replica_ord += 1
+        handle = actor_cls.options(**opts).remote(
+            rec.cls_blob, rec.init_args, rec.init_kwargs)
+        rec.replicas.append(ReplicaRecord(handle, replica_id))
+
+    def _remove_replica(self, rec: DeploymentRecord,
+                        index: int = -1) -> None:
+        replica = rec.replicas.pop(index)
+        try:
+            ray_tpu.kill(replica.handle)
+        except Exception:
+            pass
+
+    def _drain(self, rec: DeploymentRecord) -> None:
+        while rec.replicas:
+            self._remove_replica(rec)
+
+    def _publish(self, rec: DeploymentRecord) -> Optional[int]:
+        """Push the routing snapshot (replica actor ids + model residency)
+        to subscribers through the cluster pubsub (LongPollHost shape).
+        Returns the published version so deploy() callers can wait for
+        their own snapshot to reach their router."""
+        from ray_tpu.core.runtime import get_core_worker
+
+        snapshot = {
+            "replicas": [
+                {"actor_id": r.handle.actor_id.binary(),
+                 "replica_id": r.replica_id,
+                 "models": r.last_stats.get("models", [])}
+                for r in rec.replicas],
+            "max_ongoing_requests": rec.cfg.get("max_ongoing_requests", 8),
+            "deleted": rec.deleting,
+        }
+        try:
+            return get_core_worker().controller.call(
+                "psub_publish", SNAPSHOT_CHANNEL, rec.name, snapshot)
+        except Exception:
+            return None
+
+    # ----------------------------------------------------------- queries
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "replicas": len(rec.replicas),
+                    "replica_ids": [r.replica_id for r in rec.replicas],
+                    "ongoing": sum(
+                        r.last_stats.get("ongoing", 0)
+                        for r in rec.replicas),
+                }
+                for name, rec in self._deployments.items()
+            }
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            rec = self._deployments.pop(name, None)
+            if rec is not None:
+                rec.deleting = True  # under lock: reconcile must not heal it
+        if rec is not None:
+            self._drain(rec)
+            self._publish(rec)
+            self._last_models.pop(name, None)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            names = list(self._deployments)
+        for name in names:
+            self.delete(name)
+
+    # --------------------------------------------------------- reconcile
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.wait(0.25):
+            with self._lock:
+                recs = list(self._deployments.values())
+            for rec in recs:
+                try:
+                    self._reconcile_one(rec)
+                except Exception:
+                    pass
+
+    def _stale(self, rec: DeploymentRecord) -> bool:
+        with self._lock:
+            return (rec.deleting
+                    or self._deployments.get(rec.name) is not rec)
+
+    def _reconcile_one(self, rec: DeploymentRecord) -> None:
+        """Collect replica stats, replace dead replicas, autoscale
+        (reference: DeploymentState.update + autoscaling_policy.py:12).
+        Every mutation re-validates the record is still live (_stale) so a
+        concurrent redeploy/delete can't be resurrected; structural changes
+        hold rec.lock so deploy's settle can't race a double-add."""
+        if self._stale(rec):
+            return
+        changed = False
+        stats_refs = [(r, r.handle.stats.remote()) for r in rec.replicas]
+        suspect: List[ReplicaRecord] = []
+        for replica, ref in stats_refs:
+            try:
+                replica.last_stats = ray_tpu.get(ref, timeout=5.0)
+            except Exception:
+                suspect.append(replica)
+        # A slow stats reply is NOT death: a replica still initializing or
+        # saturated must not be dropped (and certainly not leaked). Only
+        # replicas whose ACTOR the cluster declares DEAD are replaced.
+        dead = []
+        for replica in suspect:
+            try:
+                from ray_tpu.core.runtime import get_core_worker
+
+                record = get_core_worker().controller.call(
+                    "get_actor", replica.handle.actor_id.binary())
+            except Exception:
+                continue
+            if record is None or record["state"] == "DEAD":
+                dead.append(replica)
+        if self._stale(rec):
+            return
+        with rec.lock:
+            if self._stale(rec):
+                return
+            for replica in dead:
+                try:
+                    rec.replicas.remove(replica)
+                except ValueError:
+                    continue
+                try:
+                    ray_tpu.kill(replica.handle)  # idempotent cleanup
+                except Exception:
+                    pass
+                changed = True
+            while (len(rec.replicas) < self._min_replicas(rec)
+                   and not self._stale(rec)):
+                self._add_replica(rec)
+                changed = True
+        if self._stale(rec):
+            self._drain(rec)  # raced a delete after adding: clean up
+            return
+
+        auto = rec.cfg.get("autoscaling")
+        if auto:
+            with rec.lock:
+                ongoing = sum(r.last_stats.get("ongoing", 0)
+                              for r in rec.replicas)
+                desired = max(auto["min_replicas"],
+                              min(auto["max_replicas"],
+                                  math.ceil(ongoing /
+                                            max(1e-9,
+                                                auto[
+                                                    "target_ongoing_requests"
+                                                ]))))
+                now = time.monotonic()
+                if (desired > len(rec.replicas)
+                        and now - rec.last_scale > auto["upscale_delay_s"]):
+                    self._add_replica(rec)
+                    rec.last_scale = now
+                    changed = True
+                elif (desired < len(rec.replicas)
+                        and now - rec.last_scale >
+                        auto["downscale_delay_s"]):
+                    self._remove_replica(rec)
+                    rec.last_scale = now
+                    changed = True
+        # Model residency changes also need a push (multiplex routing).
+        if changed or self._models_changed(rec):
+            self._publish(rec)
+
+    def _min_replicas(self, rec: DeploymentRecord) -> int:
+        auto = rec.cfg.get("autoscaling")
+        return (auto["min_replicas"] if auto
+                else rec.cfg.get("num_replicas", 1))
+
+    def _models_changed(self, rec: DeploymentRecord) -> bool:
+        cur = {r.replica_id: tuple(r.last_stats.get("models", []))
+               for r in rec.replicas}
+        if self._last_models.get(rec.name) != cur:
+            self._last_models[rec.name] = cur
+            return True
+        return False
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def get_or_create_controller():
+    """Resolve (or start) the cluster's serve controller actor."""
+    from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
+
+    try:
+        handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(handle.ping.remote(), timeout=30.0)
+        return handle
+    except (ValueError, ActorDiedError, ActorUnavailableError):
+        pass  # absent or dead: (re)create — name registration allows
+        # replacing a DEAD actor.
+    actor_cls = ray_tpu.remote(ServeController)
+    try:
+        handle = actor_cls.options(name=CONTROLLER_NAME, num_cpus=0,
+                                   max_restarts=-1).remote()
+        ray_tpu.get(handle.ping.remote(), timeout=60.0)
+        return handle
+    except Exception:
+        # Raced with another creator: the named actor exists now.
+        return ray_tpu.get_actor(CONTROLLER_NAME)

@@ -1,18 +1,19 @@
-"""Deployments, replicas, routing, autoscaling — the Serve stack.
+"""Deployments + handles + routing (data plane).
 
-Analogue of the reference's Serve architecture (SURVEY §3.5): control plane
-(``ServeController`` reconciling ``DeploymentState``,
-``serve/_private/controller.py:86`` + ``deployment_state.py``) and data plane
-(``DeploymentHandle`` -> ``Router.assign_request`` ->
-power-of-two-choices replica picking, ``replica_scheduler/pow_2_scheduler.py
-:49`` -> ``ReplicaActor.handle_request``, ``replica.py:231``), condensed:
-the controller runs in the driver process with a reconcile thread; replicas
-are actors; routing state (in-flight counts) lives client-side in the
-handle, which is what the reference's pow-2 scheduler samples anyway.
+Analogue of the reference's Serve data plane: ``DeploymentHandle``
+(``serve/handle.py:714``) -> ``Router.assign_request`` (``router.py:312``)
+-> power-of-two-choices replica picking
+(``replica_scheduler/pow_2_scheduler.py:49``) -> ``ReplicaActor``. Routing
+state is pushed, not polled: every handle watches the cluster pubsub for
+its deployment's replica snapshot (the reference's LongPollHost pattern,
+``long_poll.py:173``), so scale-ups, scale-downs, replica deaths and
+multiplexed-model residency changes propagate to all routers without any
+controller round-trip on the request path.
 
-Request autoscaling mirrors ``autoscaling_policy.py:12``: desired replicas =
-ceil(total in-flight / target_ongoing_requests), clamped to [min, max],
-applied by the reconcile loop.
+In-flight counts are client-side per handle (the sample the reference's
+pow-2 scheduler uses is its own probe of its own outstanding requests per
+replica); model-aware routing prefers replicas that already have the
+requested ``multiplexed_model_id`` loaded (``serve/multiplex.py``).
 """
 
 from __future__ import annotations
@@ -20,12 +21,15 @@ from __future__ import annotations
 import random
 import threading
 import time
-import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
+from ray_tpu.core.ids import ActorID
+from ray_tpu.serve.controller import SNAPSHOT_CHANNEL
 
 
 @dataclass
@@ -36,8 +40,19 @@ class AutoscalingConfig:
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 5.0
 
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "target_ongoing_requests": self.target_ongoing_requests,
+            "upscale_delay_s": self.upscale_delay_s,
+            "downscale_delay_s": self.downscale_delay_s,
+        }
+
 
 class Deployment:
+    """Declarative deployment config (``@serve.deployment``)."""
+
     def __init__(self, cls, name: Optional[str] = None,
                  num_replicas: int = 1,
                  ray_actor_options: Optional[Dict] = None,
@@ -56,14 +71,27 @@ class Deployment:
         dep = Deployment(self.cls, self.name, self.num_replicas,
                          dict(self.actor_options), self.autoscaling,
                          self.max_ongoing_requests)
+        dep._init_args = self._init_args
+        dep._init_kwargs = self._init_kwargs
         for k, v in overrides.items():
-            setattr(dep, k if k != "name" else "name", v)
+            setattr(dep, "autoscaling" if k == "autoscaling_config"
+                    else ("actor_options" if k == "ray_actor_options" else k),
+                    v)
         return dep
 
     def bind(self, *args, **kwargs) -> "Deployment":
         self._init_args = args
         self._init_kwargs = kwargs
         return self
+
+    def config_dict(self) -> Dict[str, Any]:
+        return {
+            "num_replicas": self.num_replicas,
+            "actor_options": dict(self.actor_options),
+            "autoscaling": (self.autoscaling.to_dict()
+                            if self.autoscaling else None),
+            "max_ongoing_requests": self.max_ongoing_requests,
+        }
 
 
 def deployment(_cls=None, **kwargs):
@@ -77,162 +105,230 @@ def deployment(_cls=None, **kwargs):
     return wrap
 
 
-class _ReplicaWrapper:
-    """Actor body hosting the user callable (reference: ReplicaActor +
-    UserCallableWrapper, ``replica.py:231,750``)."""
+class _Router:
+    """Per-process router for one deployment: pubsub-fed replica snapshot +
+    client-side pow-2 routing with model affinity."""
 
-    def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict):
-        from ray_tpu.core import serialization
+    _instances: Dict[str, "_Router"] = {}
+    _instances_lock = threading.Lock()
 
-        cls = serialization.loads_function(cls_blob)
-        self._instance = cls(*args, **kwargs)
+    @classmethod
+    def get(cls, name: str) -> "_Router":
+        with cls._instances_lock:
+            router = cls._instances.get(name)
+            if router is None:
+                router = cls(name)
+                cls._instances[name] = router
+            return router
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict):
-        target = (self._instance if method == "__call__"
-                  else getattr(self._instance, method))
-        if method == "__call__":
-            return target(*args, **kwargs)
-        return target(*args, **kwargs)
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._replicas: List[Dict[str, Any]] = []  # {handle, id, models}
+        self._inflight: Dict[str, int] = {}
+        self._version = 0
+        self._have_snapshot = threading.Event()
+        self._max_ongoing = 8
+        self._deleted = False
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=64,
+                                        thread_name_prefix="serve-router")
+        self._watcher = threading.Thread(target=self._watch_loop,
+                                         name=f"serve-watch-{name}",
+                                         daemon=True)
+        self._watcher.start()
 
-    def ping(self):
-        return "pong"
+    # -------------------------------------------------------- snapshots
+
+    def _apply(self, version: int, snapshot: Dict[str, Any]) -> None:
+        with self._lock:
+            self._version = version
+            self._deleted = snapshot.get("deleted", False)
+            self._max_ongoing = snapshot.get("max_ongoing_requests", 8)
+            self._replicas = [
+                {"handle": ActorHandle(ActorID(r["actor_id"])),
+                 "id": r["replica_id"],
+                 "models": set(r.get("models", []))}
+                for r in snapshot.get("replicas", [])]
+            live = {r["id"] for r in self._replicas}
+            self._inflight = {k: v for k, v in self._inflight.items()
+                              if k in live}
+        if self._replicas or self._deleted:
+            self._have_snapshot.set()
+
+    def _watch_loop(self) -> None:
+        from ray_tpu.core.runtime import get_core_worker
+
+        while not self._stop.is_set():
+            try:
+                core = get_core_worker()
+                update = core.controller.call(
+                    "psub_poll", SNAPSHOT_CHANNEL, self.name,
+                    self._version, 10.0, timeout=25.0)
+            except Exception:
+                if self._stop.wait(0.5):
+                    return
+                continue
+            if update is not None:
+                self._apply(*update)
+
+    def _known_to_controller(self) -> bool:
+        """One cheap existence probe so unknown names fail fast (404), not
+        after a 60s wait."""
+        from ray_tpu.core.runtime import get_core_worker
+
+        try:
+            snap = get_core_worker().controller.call(
+                "psub_snapshot", SNAPSHOT_CHANNEL)
+            return self.name in snap
+        except Exception:
+            return True  # can't tell: fall through to the normal wait
+
+    def _evict(self) -> None:
+        with _Router._instances_lock:
+            if _Router._instances.get(self.name) is self:
+                del _Router._instances[self.name]
+        self.stop()
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        if not self._have_snapshot.is_set() and not self._known_to_controller():
+            self._evict()
+            raise KeyError(f"no deployment {self.name!r}")
+        if not self._have_snapshot.wait(timeout):
+            # Unknown deployment (or controller gone): evict this router so
+            # a probe of a bad name doesn't leak a watcher + pool forever.
+            self._evict()
+            raise KeyError(
+                f"no routing snapshot for deployment {self.name!r} "
+                f"(does it exist?)")
+
+    def wait_version(self, version: int, timeout: float = 60.0) -> None:
+        """Block until this router has applied snapshot >= version (used by
+        serve.run so a redeploy's first request can't route on a stale —
+        possibly deleted — snapshot)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._version >= version:
+                    return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"router for {self.name!r} never saw snapshot v{version}")
+            time.sleep(0.02)
+
+    # ---------------------------------------------------------- routing
+
+    def _pick(self, model_id: str):
+        """Pow-2 choices on local in-flight counts; with a model id,
+        replicas that already hold the model win (multiplex affinity)."""
+        with self._lock:
+            replicas = self._replicas
+            if not replicas:
+                return None
+            pool = replicas
+            if model_id:
+                warm = [r for r in replicas if model_id in r["models"]]
+                # Warm replicas win unless saturated (then let a cold one
+                # load the model rather than queueing behind the hot set).
+                warm = [r for r in warm
+                        if self._inflight.get(r["id"], 0) < self._max_ongoing]
+                if warm:
+                    pool = warm
+            if len(pool) == 1:
+                chosen = pool[0]
+            else:
+                a, b = random.sample(range(len(pool)), 2)
+                ca = self._inflight.get(pool[a]["id"], 0)
+                cb = self._inflight.get(pool[b]["id"], 0)
+                chosen = pool[a if ca <= cb else b]
+            self._inflight[chosen["id"]] = (
+                self._inflight.get(chosen["id"], 0) + 1)
+            return chosen
+
+    def _release(self, replica) -> None:
+        with self._lock:
+            rid = replica["id"]
+            if rid in self._inflight:
+                self._inflight[rid] = max(0, self._inflight[rid] - 1)
+
+    def submit(self, method: str, args: tuple, kwargs: dict,
+               model_id: str = "") -> Future:
+        fut: Future = Future()
+        self._pool.submit(self._run_one, fut, method, args, kwargs, model_id)
+        return fut
+
+    def _run_one(self, fut: Future, method, args, kwargs, model_id) -> None:
+        try:
+            self.wait_ready()
+            last_err: Optional[BaseException] = None
+            for _attempt in range(3):
+                replica = self._pick(model_id)
+                if replica is None:
+                    if self._deleted:
+                        raise RuntimeError(
+                            f"deployment {self.name!r} was deleted")
+                    raise RuntimeError(
+                        f"deployment {self.name!r} has no replicas")
+                try:
+                    ref = replica["handle"].handle_request.remote(
+                        method, args, kwargs, model_id)
+                    fut.set_result(ray_tpu.get(ref))
+                    return
+                except (ActorDiedError, ActorUnavailableError) as e:
+                    # Replica died: forget it locally; the controller's
+                    # next snapshot heals the set. Retry elsewhere.
+                    last_err = e
+                    with self._lock:
+                        self._replicas = [r for r in self._replicas
+                                          if r["id"] != replica["id"]]
+                finally:
+                    self._release(replica)
+            raise last_err
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._instances_lock:
+            routers, cls._instances = dict(cls._instances), {}
+        for router in routers.values():
+            router.stop()
 
 
 class DeploymentHandle:
-    """Client-side router with power-of-two-choices replica selection."""
+    """Serializable handle: any process holding it (or just the deployment
+    name) can route requests (reference: ``serve/handle.py:714``)."""
 
-    def __init__(self, state: "_DeploymentState", method: str = "__call__"):
-        self._state = state
+    def __init__(self, name: str, method: str = "__call__",
+                 multiplexed_model_id: str = ""):
+        self._name = name
         self._method = method
+        self._model_id = multiplexed_model_id
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._state, method_name)
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._name,
+            method_name if method_name is not None else self._method,
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self._model_id))
 
-    def remote(self, *args, **kwargs):
-        """Async: returns an ObjectRef-like future."""
-        return self._state.submit(self._method, args, kwargs)
+    def remote(self, *args, **kwargs) -> Future:
+        return _Router.get(self._name).submit(
+            self._method, args, kwargs, self._model_id)
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._state, name)
+        return DeploymentHandle(self._name, name, self._model_id)
 
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._method, self._model_id))
 
-class _DeploymentState:
-    """Controller-side record + data-plane routing for one deployment."""
-
-    def __init__(self, deployment: Deployment):
-        from ray_tpu.core import serialization
-
-        self.deployment = deployment
-        self.cls_blob = serialization.dumps_function(deployment.cls)
-        self.replicas: List[Any] = []
-        self.inflight: Dict[int, int] = {}  # id(replica actor) -> count
-        self._lock = threading.Lock()
-        self._pool = ThreadPoolExecutor(max_workers=64,
-                                        thread_name_prefix="serve-router")
-        self._last_scale = time.monotonic()
-        target = (deployment.autoscaling.min_replicas
-                  if deployment.autoscaling else deployment.num_replicas)
-        for _ in range(target):
-            self._add_replica()
-
-    def _add_replica(self) -> None:
-        actor_cls = ray_tpu.remote(_ReplicaWrapper)
-        opts = dict(self.deployment.actor_options)
-        opts.setdefault("max_concurrency",
-                        self.deployment.max_ongoing_requests)
-        actor = actor_cls.options(**opts).remote(
-            self.cls_blob, self.deployment._init_args,
-            self.deployment._init_kwargs)
-        with self._lock:
-            self.replicas.append(actor)
-            self.inflight[id(actor)] = 0
-
-    def _remove_replica(self) -> None:
-        with self._lock:
-            if len(self.replicas) <= 1:
-                return
-            actor = self.replicas.pop()
-            self.inflight.pop(id(actor), None)
-        try:
-            ray_tpu.kill(actor)
-        except Exception:
-            pass
-
-    # ------------------------------------------------------------ routing
-
-    def _acquire_replica(self):
-        """Power-of-two-choices on client-side in-flight counts
-        (pow_2_scheduler.py:49). Pick + increment happen under one lock
-        acquisition, and inflight is keyed by replica identity, so a
-        concurrent scale-down can't shift indices underneath a request."""
-        with self._lock:
-            n = len(self.replicas)
-            if n == 1:
-                actor = self.replicas[0]
-            else:
-                a, b = random.sample(range(n), 2)
-                ca = self.inflight.get(id(self.replicas[a]), 0)
-                cb = self.inflight.get(id(self.replicas[b]), 0)
-                actor = self.replicas[a if ca <= cb else b]
-            self.inflight[id(actor)] = self.inflight.get(id(actor), 0) + 1
-            return actor
-
-    def _release_replica(self, actor) -> None:
-        with self._lock:
-            key = id(actor)
-            if key in self.inflight:
-                self.inflight[key] = max(0, self.inflight[key] - 1)
-
-    def submit(self, method: str, args: tuple, kwargs: dict) -> Future:
-        fut: Future = Future()
-
-        def run():
-            actor = self._acquire_replica()
-            try:
-                ref = actor.handle_request.remote(method, args, kwargs)
-                fut.set_result(ray_tpu.get(ref))
-            except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
-            finally:
-                self._release_replica(actor)
-
-        self._pool.submit(run)
-        return fut
-
-    # -------------------------------------------------------- autoscaling
-
-    def reconcile(self) -> None:
-        auto = self.deployment.autoscaling
-        if auto is None:
-            return
-        with self._lock:
-            total_inflight = sum(self.inflight.values())
-            current = len(self.replicas)
-        desired = max(auto.min_replicas,
-                      min(auto.max_replicas,
-                          -(-int(total_inflight) //
-                            max(1, int(auto.target_ongoing_requests)))))
-        now = time.monotonic()
-        if desired > current and now - self._last_scale > auto.upscale_delay_s:
-            self._add_replica()
-            self._last_scale = now
-        elif (desired < current
-              and now - self._last_scale > auto.downscale_delay_s):
-            self._remove_replica()
-            self._last_scale = now
-
-    def shutdown(self) -> None:
-        with self._lock:
-            replicas, self.replicas = list(self.replicas), []
-        for actor in replicas:
-            try:
-                ray_tpu.kill(actor)
-            except Exception:
-                pass
-        self._pool.shutdown(wait=False)
-
-    def num_replicas(self) -> int:
-        with self._lock:
-            return len(self.replicas)
+    def __repr__(self):
+        return f"DeploymentHandle({self._name!r}, {self._method!r})"

@@ -1,0 +1,121 @@
+"""ReplicaActor: hosts the user callable + multiplexed model cache.
+
+Analogue of the reference's ``ReplicaActor`` + ``UserCallableWrapper``
+(``serve/_private/replica.py:231,750``) and the replica half of model
+multiplexing (``serve/multiplex.py`` — per-replica LRU of loaded models,
+residency reported to the controller for model-aware routing).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+_current_model_id = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the in-flight request (reference:
+    ``serve.get_multiplexed_model_id``)."""
+    return getattr(_current_model_id, "value", "")
+
+
+class _MultiplexCache:
+    """Per-replica LRU of loaded models (multiplex.py's model cache)."""
+
+    def __init__(self, loader, capacity: int):
+        self._loader = loader
+        self._capacity = max(1, capacity)
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, instance, model_id: str):
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        model = self._loader(instance, model_id)
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            while len(self._models) > self._capacity:
+                old_id, old = self._models.popitem(last=False)
+                del old
+        return model
+
+    def loaded(self) -> List[str]:
+        with self._lock:
+            return list(self._models)
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """``@serve.multiplexed`` — wraps a ``get_model(self, model_id)`` loader
+    with a per-replica LRU cache (reference: ``serve/multiplex.py``). The
+    cache is created lazily on the instance (decoration-time state would
+    make the user class unpicklable — it ships to replicas by value)."""
+
+    def wrap(loader):
+        attr = f"__mux_cache_{loader.__name__}"
+
+        def cached(self, model_id: Optional[str] = None):
+            cache = getattr(self, attr, None)
+            if cache is None:
+                cache = _MultiplexCache(loader, max_num_models_per_replica)
+                setattr(self, attr, cache)
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            return cache.get(self, model_id)
+
+        cached._is_multiplexed = True
+        return cached
+
+    return wrap
+
+
+def loaded_model_ids(instance) -> List[str]:
+    """All model ids resident in ``instance``'s multiplex caches."""
+    out: List[str] = []
+    for name, value in vars(instance).items():
+        if name.startswith("__mux_cache_") and isinstance(
+                value, _MultiplexCache):
+            out.extend(value.loaded())
+    return out
+
+
+class ReplicaActor:
+    def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict):
+        from ray_tpu.core import serialization
+
+        cls = serialization.loads_function(cls_blob)
+        self._instance = cls(*args, **kwargs)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = ""):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        _current_model_id.value = multiplexed_model_id
+        try:
+            target = (self._instance if method == "__call__"
+                      else getattr(self._instance, method))
+            return target(*args, **kwargs)
+        finally:
+            _current_model_id.value = ""
+            with self._lock:
+                self._ongoing -= 1
+
+    def stats(self) -> Dict[str, Any]:
+        models = loaded_model_ids(self._instance)
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total,
+                    "models": models,
+                    "uptime_s": time.monotonic() - self._started}
+
+    def ping(self) -> str:
+        return "pong"

@@ -81,6 +81,31 @@ class _Trial:
     def __hash__(self):
         return hash(self.id)
 
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "config": self.config,
+            "state": self.state,
+            "iteration": self.iteration,
+            "latest_checkpoint": self.latest_checkpoint,
+            "error": self.result.error,
+            "metrics": self.result.metrics,
+            "metrics_history": self.result.metrics_history[-50:],
+        }
+
+    @classmethod
+    def from_snapshot(cls, data: Dict[str, Any]) -> "_Trial":
+        trial = cls(data["id"], data["config"])
+        trial.state = data["state"]
+        trial.iteration = data.get("iteration", 0)
+        trial.latest_checkpoint = data.get("latest_checkpoint")
+        trial.result.error = data.get("error")
+        trial.result.metrics = data.get("metrics")
+        trial.result.metrics_history = list(data.get("metrics_history", []))
+        if trial.latest_checkpoint:
+            trial.result.checkpoint = Checkpoint(trial.latest_checkpoint)
+        return trial
+
 
 class Tuner:
     def __init__(
@@ -99,33 +124,126 @@ class Tuner:
         self._resources = resources_per_trial or {"CPU": 1.0}
         self._storage = storage_path
         self._name = name or f"tune_{uuid.uuid4().hex[:8]}"
+        self._restored_trials: Optional[List[_Trial]] = None
+
+    # --------------------------------------------------- restore/snapshot
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                resume_errored: bool = False,
+                tune_config: Optional["TuneConfig"] = None) -> "Tuner":
+        """Rebuild a Tuner from an experiment-state snapshot so a crashed or
+        killed driver can resume its sweep (reference: ``Tuner.restore``,
+        ``tune/tuner.py:171`` + ``execution/experiment_state.py``). Finished
+        trials keep their results; in-flight trials restart from their
+        latest checkpoint; errored trials restart only with
+        ``resume_errored``. Schedulers are code, not snapshot state — pass
+        ``tune_config`` (with the scheduler) to keep ASHA/PBT decisions
+        after restore; otherwise the sweep resumes under FIFO."""
+        import json
+        import os
+
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        tuner = cls(
+            trainable,
+            param_space={},
+            tune_config=tune_config or TuneConfig(**state["tune_config"]),
+            resources_per_trial=state["resources"],
+            storage_path=state["storage"],
+            name=state["name"],
+        )
+        trials = [_Trial.from_snapshot(t) for t in state["trials"]]
+        for trial in trials:
+            if trial.state in ("RUNNING", "PENDING"):
+                trial.state = "PENDING"
+            elif trial.state == "ERROR" and resume_errored:
+                trial.state = "PENDING"
+                trial.result.error = None
+        tuner._restored_trials = trials
+        return tuner
+
+    def _experiment_dir(self) -> Optional[str]:
+        import os
+
+        if self._storage is None:
+            return None
+        path = os.path.join(self._storage, self._name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _save_state(self, trials: List[_Trial]) -> None:
+        import json
+        import os
+
+        path = self._experiment_dir()
+        if path is None:
+            return
+        tc = self.tune_config
+        state = {
+            "name": self._name,
+            "storage": self._storage,
+            "resources": self._resources,
+            "tune_config": {"metric": tc.metric, "mode": tc.mode,
+                            "num_samples": tc.num_samples,
+                            "max_concurrent_trials": tc.max_concurrent_trials,
+                            "seed": tc.seed},
+            "trials": [t.snapshot() for t in trials],
+        }
+        tmp = os.path.join(path, "experiment_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(path, "experiment_state.json"))
 
     # ------------------------------------------------------------- fit
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = generate_variants(self._param_space, tc.num_samples,
-                                     tc.seed)
-        trials = [_Trial(f"{self._name}_{i:05d}", cfg)
-                  for i, cfg in enumerate(variants)]
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            variants = generate_variants(self._param_space, tc.num_samples,
+                                         tc.seed)
+            trials = [_Trial(f"{self._name}_{i:05d}", cfg)
+                      for i, cfg in enumerate(variants)]
         fn_blob = serialization.dumps_function(self._trainable)
         max_conc = tc.max_concurrent_trials or len(trials)
 
-        pending = list(trials)
+        pending = [t for t in trials if t.state == "PENDING"]
         running: List[_Trial] = []
-        done: List[_Trial] = []
+        # Long-poll replies in flight: ref -> (trial, actor that produced
+        # it). A stale actor (trial was exploited/restarted) is ignored.
+        waiting: Dict[Any, tuple] = {}
+
+        def arm(trial: _Trial) -> None:
+            waiting[trial.actor.wait_status.remote(10.0)] = (
+                trial, trial.actor)
+
+        self._save_state(trials)
         while pending or running:
             while pending and len(running) < max_conc:
                 trial = pending.pop(0)
                 self._launch(trial, fn_blob)
                 running.append(trial)
-            time.sleep(0.05)
-            for trial in list(running):
-                alive = self._poll(trial, scheduler, fn_blob)
-                if not alive:
-                    running.remove(trial)
-                    done.append(trial)
+                arm(trial)
+            if not waiting:
+                time.sleep(0.05)
+                continue
+            ready, _ = ray_tpu.wait(list(waiting), num_returns=1,
+                                    timeout=60.0)
+            for ref in ready:
+                trial, actor = waiting.pop(ref)
+                if trial.actor is not actor:
+                    continue  # exploited/restarted since this poll
+                alive = self._consume(trial, ref, scheduler, fn_blob)
+                if alive:
+                    arm(trial)
+                else:
+                    if trial in running:
+                        running.remove(trial)
+                    self._save_state(trials)
+        self._save_state(trials)
         return ResultGrid([t.result for t in trials], tc.metric, tc.mode)
 
     # --------------------------------------------------------- internals
@@ -141,16 +259,17 @@ class Tuner:
         trial.actor.start.remote(fn_blob, trial.config)
         trial.state = "RUNNING"
 
-    def _poll(self, trial: _Trial, scheduler, fn_blob: bytes) -> bool:
-        """Returns True while the trial should keep running."""
+    def _consume(self, trial: _Trial, status_ref, scheduler,
+                 fn_blob: bytes) -> bool:
+        """Digest one wait_status long-poll reply (results + liveness).
+        Returns True while the trial should keep running."""
         try:
-            results = ray_tpu.get(trial.actor.next_results.remote(),
-                                  timeout=60)
-            status = ray_tpu.get(trial.actor.status.remote(), timeout=60)
+            status = ray_tpu.get(status_ref, timeout=60)
         except Exception as e:
             trial.state = "ERROR"
             trial.result.error = f"trial actor failed: {e}"
             return False
+        results = status["results"]
         for r in results:
             if "error" in r:
                 trial.state = "ERROR"

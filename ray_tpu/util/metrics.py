@@ -1,0 +1,160 @@
+"""Metrics: Counter / Gauge / Histogram with cluster export.
+
+Analogue of the reference's two-layer metrics pipeline: the user API
+(``python/ray/util/metrics.py`` Counter/Gauge/Histogram) and the C++
+registry exported to the node agent and on to Prometheus
+(``src/ray/stats/metric_defs.cc:44-183``, ``metric_exporter.cc``).
+Here: every process has a registry; a daemon flusher pushes snapshots to
+the cluster controller (tagged with node/worker identity), which aggregates
+them and serves them via the state API (``list_metrics``) and a
+Prometheus-text endpoint (``metrics_text``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class _Registry:
+    _instance: Optional["_Registry"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, tuple], Dict[str, Any]] = {}
+        self._flusher: Optional[threading.Thread] = None
+
+    @classmethod
+    def get(cls) -> "_Registry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def record(self, name: str, kind: str, tags: Dict[str, str],
+               value: float, buckets=None) -> None:
+        key = (name, tuple(sorted(tags.items())))
+        with self._lock:
+            entry = self._metrics.get(key)
+            if entry is None:
+                entry = {"name": name, "kind": kind, "tags": dict(tags),
+                         "value": 0.0}
+                if kind == "histogram":
+                    entry["buckets"] = list(buckets or _DEFAULT_BUCKETS)
+                    entry["counts"] = [0] * (len(entry["buckets"]) + 1)
+                    entry["sum"] = 0.0
+                    entry["count"] = 0
+                self._metrics[key] = entry
+            if kind == "counter":
+                entry["value"] += value
+            elif kind == "gauge":
+                entry["value"] = value
+            else:
+                idx = bisect.bisect_left(entry["buckets"], value)
+                entry["counts"][idx] += 1
+                entry["sum"] += value
+                entry["count"] += 1
+            self._ensure_flusher()
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or not self._flusher.is_alive():
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="metrics-flush", daemon=True)
+            self._flusher.start()
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._metrics.values()]
+
+    def _flush_loop(self) -> None:
+        from ray_tpu.core import runtime
+
+        while True:
+            time.sleep(5.0)
+            core = runtime._core_worker
+            if core is None:
+                continue
+            try:
+                core.controller.notify(
+                    "push_metrics",
+                    {"node_id": core.node_id.binary(),
+                     "worker_id": core.worker_id.binary(),
+                     "pid": __import__("os").getpid()},
+                    self.snapshot())
+            except Exception:
+                pass
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return merged
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        _Registry.get().record(self._name, "counter", self._tags(tags),
+                               value)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        _Registry.get().record(self._name, "gauge", self._tags(tags), value)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = tuple(boundaries or _DEFAULT_BUCKETS)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        _Registry.get().record(self._name, "histogram", self._tags(tags),
+                               value, self._boundaries)
+
+
+def prometheus_text(aggregated: Dict[str, Any]) -> str:
+    """Render the controller's aggregated metrics as Prometheus exposition
+    text (the shape the reference's node agent exposes)."""
+    lines: List[str] = []
+    for source, metrics in aggregated.items():
+        for m in metrics:
+            tags = dict(m.get("tags", {}))
+            tags["source"] = source
+            label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+            if m["kind"] == "histogram":
+                lines.append(f'{m["name"]}_sum{{{label}}} {m["sum"]}')
+                lines.append(f'{m["name"]}_count{{{label}}} {m["count"]}')
+            else:
+                lines.append(f'{m["name"]}{{{label}}} {m["value"]}')
+    return "\n".join(lines) + "\n"

@@ -116,3 +116,28 @@ def test_train_ingest_path(ray_start_regular):
     # Both workers together saw every row exactly once.
     # (rank-0 metrics only cover half; just check it's plausible)
     assert result.metrics["total"] > 0
+
+
+def test_distributed_shuffle_multinode(ray_start_cluster):
+    # The shuffle exchange runs as tasks across nodes; the driver holds only
+    # refs. Verify multiset preservation + actual reordering.
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    import numpy as np
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(5000).repartition(8)
+    shuffled = ds.random_shuffle(seed=3)
+    vals = np.array([r["id"] for r in shuffled.iter_rows()])
+    assert len(vals) == 5000
+    assert sorted(vals.tolist()) == list(range(5000))
+    assert not np.array_equal(vals, np.arange(5000)), "not shuffled"
+    # determinism with the same seed
+    vals2 = np.array([r["id"]
+                      for r in ds.random_shuffle(seed=3).iter_rows()])
+    assert np.array_equal(vals, vals2)

@@ -1,0 +1,129 @@
+"""Job submission + runtime envs + observability (reference:
+``job_manager.py:56``, ``runtime_env_agent.py:162``,
+``util/state/state_cli.py``, ``util/metrics.py``)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_runtime_env_vars(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "42"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote(), timeout=60) == "42"
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_runtime_env_working_dir(ray_start_regular, tmp_path):
+    pkg = tmp_path / "proj"
+    pkg.mkdir()
+    (pkg / "mymod.py").write_text("VALUE = 'from-working-dir'\n")
+    from ray_tpu.runtime_env import upload_working_dir
+
+    uri = upload_working_dir(str(pkg))
+    assert uri.startswith("kv://")
+
+    @ray_tpu.remote(runtime_env={"working_dir": uri})
+    def use_mod():
+        import mymod
+
+        return mymod.VALUE
+
+    assert ray_tpu.get(use_mod.remote(), timeout=120) == "from-working-dir"
+
+
+def test_actor_runtime_env(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "on"}})
+    class EnvActor:
+        def flag(self):
+            return os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.flag.remote(), timeout=60) == "on"
+
+
+def test_job_submission_lifecycle(ray_start_regular, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = tmp_path / "entry.py"
+    script.write_text("print('hello from job'); import sys; sys.exit(0)\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == "SUCCEEDED"
+    assert "hello from job" in client.get_job_logs(job_id)
+    assert client.list_jobs()[job_id]["state"] == "SUCCEEDED"
+
+
+def test_job_failure_reported(ray_start_regular, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = tmp_path / "bad.py"
+    script.write_text("raise SystemExit(3)\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    assert client.wait_until_finished(job_id, timeout=120) == "FAILED"
+
+
+def test_metrics_and_task_events(ray_start_regular):
+    from ray_tpu.util.metrics import Counter, Gauge
+
+    core = ray_start_regular
+    Counter("my_requests").inc(3)
+    Gauge("my_depth").set(7.0)
+
+    @ray_tpu.remote
+    def noop(x):
+        return x
+
+    ray_tpu.get([noop.remote(i) for i in range(5)], timeout=60)
+    deadline = time.monotonic() + 30
+    while True:
+        events = core.controller.call("list_task_events", 100)
+        metrics = core.controller.call("list_metrics")
+        have_metric = any(m["name"] == "my_requests" and m["value"] == 3
+                          for ms in metrics.values() for m in ms)
+        if len(events) >= 5 and have_metric:
+            break
+        assert time.monotonic() < deadline, (len(events), metrics)
+        time.sleep(0.5)
+    text = core.controller.call("metrics_text")
+    assert "my_requests" in text and "my_depth" in text
+
+
+def test_state_cli(ray_start_regular, tmp_path, capsys):
+    from ray_tpu import scripts
+
+    core = ray_start_regular
+    addr = f"{core.controller_addr[0]}:{core.controller_addr[1]}"
+
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return "pong"
+
+    a = Named.options(name="cli_probe").remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+
+    scripts.main(["--address", addr, "status"])
+    scripts.main(["--address", addr, "list", "nodes"])
+    scripts.main(["--address", addr, "list", "actors"])
+    out = capsys.readouterr().out
+    assert "cluster resources" in out
+    assert "cli_probe" in out
+
+    time.sleep(1.5)  # task events flush period
+    tl = tmp_path / "timeline.json"
+    scripts.main(["--address", addr, "timeline", "-o", str(tl)])
+    trace = json.loads(tl.read_text())
+    assert isinstance(trace, list)

@@ -125,3 +125,51 @@ def test_pbt_exploits_checkpoints(ray_start_regular, tmp_path):
         m.get("acc", 1e9) for m in r.metrics_history) < 0.2]
     if weak:  # exploitation happened mid-run
         assert max(m["acc"] for m in weak[0].metrics_history) > 0.5
+
+
+def test_tuner_restore_resumes_errored(ray_start_regular, tmp_path):
+    # Sweep 1: trials with flag>=2 crash after checkpointing step 0.
+    # Restore with resume_errored: they resume FROM THEIR CHECKPOINT and
+    # finish (reference: Tuner.restore, tune/tuner.py:171).
+    from ray_tpu import tune
+    from ray_tpu.train.session import get_checkpoint, report
+
+    def flaky(config):
+        import os
+
+        ckpt = get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 3):
+            import tempfile
+
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            from ray_tpu.train.checkpoint import Checkpoint
+            report({"loss": 10 - step, "step": step},
+                   checkpoint=Checkpoint(d))
+            if config["flag"] >= 2 and start == 0:
+                raise RuntimeError("boom")
+
+    storage = str(tmp_path)
+    tuner = tune.Tuner(
+        flaky,
+        param_space={"flag": tune.grid_search([0, 1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        storage_path=storage,
+        name="restore_exp",
+    )
+    grid = tuner.fit()
+    errored = [r for r in grid if r.error]
+    assert len(errored) == 2, [r.error for r in grid]
+
+    restored = tune.Tuner.restore(
+        f"{storage}/restore_exp", flaky, resume_errored=True)
+    grid2 = restored.fit()
+    assert all(r.error is None for r in grid2), [r.error for r in grid2]
+    # Resumed trials continued from their step-0 checkpoint (start=1), so
+    # they never hit the start==0 crash and reach step 2.
+    assert all(r.metrics["step"] == 2 for r in grid2)
