@@ -1,5 +1,10 @@
 """ray_tpu.rl: reinforcement learning at scale (reference: RLlib)."""
 
 from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
-from ray_tpu.rl.models import init_mlp_policy, mlp_forward  # noqa: F401
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig, vtrace  # noqa: F401
+from ray_tpu.rl.models import (  # noqa: F401
+    build_policy,
+    init_mlp_policy,
+    mlp_forward,
+)
 from ray_tpu.rl.ppo import PPO, PPOConfig, compute_gae  # noqa: F401

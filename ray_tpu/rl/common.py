@@ -1,0 +1,69 @@
+"""Shared algorithm plumbing (reference: ``AlgorithmConfig`` base +
+``Algorithm`` setup, ``rllib/algorithms/algorithm_config.py``): the fluent
+config builders, env-spec probing (with frame-stack shape adjustment and the
+``ray_tpu/`` test-env registry), and EnvRunner fleet construction used by
+every algorithm — one copy, so PPO and IMPALA can't drift."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import ray_tpu
+from ray_tpu.rl.env_runner import EnvRunner
+
+
+class ConfigBuilderMixin:
+    """Fluent setters shared by all algorithm configs."""
+
+    def environment(self, env: str, **env_config):
+        self.env = env
+        self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    num_envs_per_runner: int = 4):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        return self
+
+
+def probe_env_spec(env: str, env_config: Dict[str, Any],
+                   frame_stack: int = 1) -> Tuple[tuple, int]:
+    """Observation shape (after frame stacking) + action count."""
+    import gymnasium as gym
+
+    if env.startswith("ray_tpu/"):
+        from ray_tpu.rl import testing  # noqa: F401 (registers the ids)
+    probe = gym.make(env, **env_config)
+    obs_shape = probe.observation_space.shape
+    num_actions = int(probe.action_space.n)
+    probe.close()
+    if frame_stack > 1:
+        obs_shape = obs_shape[:-1] + (obs_shape[-1] * frame_stack,)
+    return tuple(obs_shape), num_actions
+
+
+def make_env_runners(config) -> List[Any]:
+    """Spawn the EnvRunner actor fleet from a config's common fields."""
+    runner_cls = ray_tpu.remote(EnvRunner)
+    return [
+        runner_cls.options(num_cpus=1).remote(
+            config.env, config.num_envs_per_runner,
+            config.rollout_length, seed=config.seed + i,
+            env_config=config.env_config,
+            frame_stack=getattr(config, "frame_stack", 1))
+        for i in range(config.num_env_runners)
+    ]
+
+
+def stop_runners(runners) -> None:
+    for runner in runners:
+        try:
+            ray_tpu.kill(runner)
+        except Exception:
+            pass

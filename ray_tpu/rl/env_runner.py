@@ -5,8 +5,15 @@ Analogue of the reference's ``SingleAgentEnvRunner``
 vector env with the current policy (jax-on-CPU inference — env runners are
 CPU hosts in the TPU topology; SURVEY §7 phase 9), returning fixed-length
 rollout batches plus episode stats. Weights arrive as a numpy pytree via the
-object store (the reference broadcasts torch state dicts the same way).
-"""
+object store.
+
+Correctness detail that matters on gymnasium >= 1.0: vector envs autoreset
+on the step AFTER an episode ends (``AutoresetMode.NEXT_STEP``) — that step
+ignores the action and returns the reset observation with reward 0. Those
+transitions are NOT real experience; each rollout carries a ``valids`` mask
+so GAE/V-trace treat them as boundaries and the learner drops them (without
+this, value targets leak across episode boundaries and CartPole learns
+erratically)."""
 
 from __future__ import annotations
 
@@ -15,80 +22,138 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
+def _make_vec_env(env_name: str, num_envs: int, env_config: Dict):
+    import gymnasium as gym
+
+    if env_name.startswith("ray_tpu/"):
+        from ray_tpu.rl import testing  # noqa: F401 (registers the ids)
+    return gym.make_vec(env_name, num_envs=num_envs, **env_config)
+
+
 class EnvRunner:
     def __init__(self, env_name: str, num_envs: int = 4,
                  rollout_length: int = 128, seed: int = 0,
-                 env_config: Optional[Dict] = None):
-        import gymnasium as gym
+                 env_config: Optional[Dict] = None,
+                 frame_stack: int = 1):
         import jax
 
         self._jax = jax
-        self.envs = gym.make_vec(env_name, num_envs=num_envs,
-                                 **(env_config or {}))
+        self.envs = _make_vec_env(env_name, num_envs, env_config or {})
         self.num_envs = num_envs
         self.rollout_length = rollout_length
+        self.frame_stack = frame_stack
         self._rng = np.random.default_rng(seed)
         self._key = jax.random.key(seed)
-        self.obs, _ = self.envs.reset(seed=seed)
+        obs, _ = self.envs.reset(seed=seed)
+        self._raw_shape = self.envs.single_observation_space.shape
+        self._stack = None
+        if frame_stack > 1:
+            if len(self._raw_shape) != 3:
+                raise ValueError("frame_stack needs (H, W, C) observations")
+            h, w, c = self._raw_shape
+            self._stack = np.zeros((num_envs, h, w, c * frame_stack),
+                                   self.envs.single_observation_space.dtype)
+            # Episode starts are [frame]*k everywhere (the same treatment
+            # resets get), not zero-padded history.
+            self._push_frames(obs, reset_mask=np.ones(num_envs, bool))
+            self.obs = self._stack.copy()
+        else:
+            self.obs = obs
+        self._prev_done = np.zeros(num_envs, dtype=bool)
         self._episode_returns = np.zeros(num_envs)
         self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
         self._completed: list = []
         self._params = None
         self._sample_fn = None
+        self._weights_version = -1
 
-    def set_weights(self, params) -> None:
+        from ray_tpu.rl.models import build_policy, make_sample_fn
+
+        n_actions = int(self.envs.single_action_space.n)
+        obs_shape = self.obs.shape[1:]
+        _init, forward = build_policy(obs_shape, n_actions)
+        self._sample_fn = jax.jit(make_sample_fn(forward))
+
+    @property
+    def obs_shape(self):
+        return self.obs.shape[1:]
+
+    def _push_frames(self, obs: np.ndarray,
+                     reset_mask: Optional[np.ndarray] = None) -> None:
+        c = self._raw_shape[-1]
+        if reset_mask is not None and reset_mask.any():
+            # Reset envs restart their stack from the fresh frame (tile, not
+            # repeat: repeat interleaves channels for c > 1).
+            self._stack[reset_mask] = np.tile(
+                obs[reset_mask], (1, 1, 1, self.frame_stack))
+        self._stack = np.roll(self._stack, -c, axis=-1)
+        self._stack[..., -c:] = obs
+
+    def set_weights(self, params, version: int = 0) -> None:
         import jax
 
-        from ray_tpu.rl.models import sample_action
-
         self._params = jax.device_put(params)
-        if self._sample_fn is None:
-            self._sample_fn = jax.jit(sample_action)
+        self._weights_version = version
+
+    def weights_version(self) -> int:
+        return self._weights_version
 
     def sample(self) -> Dict[str, np.ndarray]:
         """Collect one fixed-length rollout (T, N, ...) with bootstrap
-        values; fixed shapes keep the learner's XLA program static."""
+        values and an autoreset-aware ``valids`` mask; fixed shapes keep
+        the learner's XLA program static."""
         import jax
 
         assert self._params is not None, "set_weights first"
         T, N = self.rollout_length, self.num_envs
-        obs_buf = np.zeros((T, N) + self.envs.single_observation_space.shape,
-                           np.float32)
+        obs_dtype = self.obs.dtype
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], obs_dtype)
         act_buf = np.zeros((T, N), np.int64)
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
+        valid_buf = np.ones((T, N), np.float32)
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            action, logp, value = self._sample_fn(
-                self._params, self.obs.astype(np.float32), sub)
+            action, logp, value = self._sample_fn(self._params, self.obs, sub)
             action = np.asarray(action)
             obs_buf[t] = self.obs
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
-            self.obs, reward, terminated, truncated, _ = self.envs.step(action)
+            valid_buf[t] = 1.0 - self._prev_done.astype(np.float32)
+            obs, reward, terminated, truncated, _ = self.envs.step(action)
             done = np.logical_or(terminated, truncated)
-            rew_buf[t] = reward
+            if self._stack is not None:
+                self._push_frames(obs, reset_mask=self._prev_done)
+                self.obs = self._stack.copy()
+            else:
+                self.obs = obs
+            # The step following a done is the autoreset step: its recorded
+            # transition is synthetic (action ignored, reward 0).
+            rew_buf[t] = np.where(self._prev_done, 0.0, reward)
             done_buf[t] = done
-            self._episode_returns += reward
-            self._episode_lengths += 1
-            for i in np.nonzero(done)[0]:
+            live = ~self._prev_done
+            self._episode_returns[live] += reward[live]
+            self._episode_lengths[live] += 1
+            for i in np.nonzero(done & live)[0]:
                 self._completed.append(
                     (float(self._episode_returns[i]),
                      int(self._episode_lengths[i])))
                 self._episode_returns[i] = 0.0
                 self._episode_lengths[i] = 0
+            self._prev_done = done
 
         # Bootstrap value for the final observation.
-        _, _, last_value = self._sample_fn(
-            self._params, self.obs.astype(np.float32), self._key)
+        _, _, last_value = self._sample_fn(self._params, self.obs, self._key)
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "valids": valid_buf,
             "last_value": np.asarray(last_value, np.float32),
+            "weights_version": self._weights_version,
         }
 
     def episode_stats(self) -> Dict[str, Any]:

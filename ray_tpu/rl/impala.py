@@ -1,0 +1,239 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace correction.
+
+Analogue of the reference's IMPALA (``rllib/algorithms/impala/impala.py`` +
+``vtrace_torch.py``): EnvRunner actors sample CONTINUOUSLY with whatever
+weights they last received (no per-iteration barrier); the learner consumes
+rollouts as they arrive, corrects for the policy lag with V-trace
+importance weighting, updates, and pushes fresh weights back. Throughput
+scales with runner count because samplers never wait for the learner.
+
+TPU shape: the learner step is one jitted function; rollouts arrive as
+object-store refs and device_put straight from the shm store. The
+reference's aggregator-worker tier (batching rollouts before the learner)
+collapses into the learner's ``ray_tpu.wait``-driven intake loop at this
+scale — its role returns multi-host, where intake can run on separate
+aggregator actors per host.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.common import (
+    ConfigBuilderMixin,
+    make_env_runners,
+    probe_env_spec,
+    stop_runners,
+)
+from ray_tpu.rl.models import build_policy
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, dones, last_value,
+           valids, gamma: float, rho_clip: float = 1.0,
+           c_clip: float = 1.0):
+    """V-trace targets and policy-gradient advantages (Espeholt et al.
+    2018, eqs. 1-2), numpy reference semantics over (T, N) rollouts.
+
+    Synthetic autoreset rows (``valids`` == 0) break the recursion exactly
+    like episode boundaries."""
+    import jax.numpy as jnp
+
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_c = jnp.minimum(rho, rho_clip)
+    c = jnp.minimum(rho, c_clip)
+    T = rewards.shape[0]
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
+
+    nonterminal = (1.0 - dones) * valids
+    deltas = rho_c * (rewards + gamma * next_values * (1.0 - dones)
+                      - values) * valids
+
+    def body(carry, xs):
+        acc = carry
+        delta, c_t, nt = xs
+        acc = delta + gamma * c_t * nt * acc
+        return acc, acc
+
+    import jax
+
+    _, vs_minus_v = jax.lax.scan(
+        body, jnp.zeros_like(last_value),
+        (deltas[::-1], c[::-1], nonterminal[::-1]))
+    vs_minus_v = vs_minus_v[::-1]
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho_c * (rewards + gamma * next_vs * (1.0 - dones) - values)
+    return vs, pg_adv * valids
+
+
+@dataclass
+class IMPALAConfig(ConfigBuilderMixin):
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_length: int = 64
+    frame_stack: int = 1
+    lr: float = 5e-4
+    gamma: float = 0.99
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    broadcast_interval: int = 1  # learner updates between weight pushes
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA:
+    def __init__(self, config: IMPALAConfig):
+        import jax
+        import optax
+
+        self.config = config
+        self._iteration = 0
+        self._updates = 0
+        self._total_env_steps = 0
+
+        obs_shape, num_actions = probe_env_spec(
+            config.env, config.env_config, config.frame_stack)
+        init_fn, self._forward = build_policy(obs_shape, num_actions,
+                                              config.hidden)
+        self.params = init_fn(jax.random.key(config.seed))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+        self.runners = make_env_runners(config)
+        self._push_weights()
+        # Continuous sampling: one outstanding rollout per runner, refilled
+        # as the learner consumes (the async pipeline; no iteration barrier).
+        self._inflight: Dict[Any, int] = {
+            runner.sample.remote(): i
+            for i, runner in enumerate(self.runners)}
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        forward = self._forward
+
+        def loss_fn(params, batch):
+            T, N = batch["rewards"].shape
+            obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+            logits, values_flat = forward(params, obs)
+            logits = logits.reshape(T, N, -1)
+            values = values_flat.reshape(T, N)
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(
+                batch["logp"], target_logp, batch["rewards"],
+                jax.lax.stop_gradient(values), batch["dones"],
+                batch["last_value"], batch["valids"], cfg.gamma,
+                cfg.rho_clip, cfg.c_clip)
+            vs = jax.lax.stop_gradient(vs)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            valid_count = jnp.maximum(batch["valids"].sum(), 1.0)
+            pi_loss = -jnp.sum(target_logp * pg_adv) / valid_count
+            vf_loss = jnp.sum(
+                batch["valids"] * (values - vs) ** 2) / valid_count
+            entropy = -jnp.sum(
+                batch["valids"][..., None]
+                * jax.nn.softmax(logits) * logp_all) / valid_count
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        return update
+
+    def _push_weights(self) -> None:
+        import jax
+
+        ref = ray_tpu.put(jax.device_get(self.params))
+        for runner in self.runners:
+            runner.set_weights.remote(ref, self._updates)
+
+    def train(self, min_rollouts: int = 4) -> Dict[str, Any]:
+        """Consume >= min_rollouts as they arrive (no barrier), update per
+        rollout, push weights every broadcast_interval updates."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.monotonic()
+        consumed = 0
+        aux = {}
+        lag_sum = 0
+        while consumed < min_rollouts:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=120.0)
+            if not ready:
+                raise TimeoutError("no rollouts arriving")
+            for ref in ready:
+                idx = self._inflight.pop(ref)
+                rollout = ray_tpu.get(ref)
+                self._inflight[self.runners[idx].sample.remote()] = idx
+                batch = {
+                    "obs": jnp.asarray(rollout["obs"]),
+                    "actions": jnp.asarray(rollout["actions"]),
+                    "logp": jnp.asarray(rollout["logp"]),
+                    "rewards": jnp.asarray(rollout["rewards"]),
+                    "dones": jnp.asarray(rollout["dones"]),
+                    "valids": jnp.asarray(rollout["valids"]),
+                    "last_value": jnp.asarray(rollout["last_value"]),
+                }
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, batch)
+                self._updates += 1
+                lag_sum += self._updates - rollout["weights_version"] - 1
+                consumed += 1
+                valid_steps = int(rollout["valids"].sum())
+                self._total_env_steps += valid_steps
+                steps_this_iter = getattr(self, "_steps_iter", 0)
+                self._steps_iter = steps_this_iter + valid_steps
+                if self._updates % cfg.broadcast_interval == 0:
+                    self._push_weights()
+        elapsed = time.monotonic() - t0
+
+        stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners])
+        episode_returns = [s["episode_return_mean"] for s in stats
+                           if s.get("episodes")]
+        self._iteration += 1
+        steps = getattr(self, "_steps_iter", 0)
+        self._steps_iter = 0
+        metrics = {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._total_env_steps,
+            "env_steps_per_sec": steps / max(1e-9, elapsed),
+            "rollouts_consumed": consumed,
+            "mean_policy_lag": lag_sum / max(1, consumed),
+            **{k: float(v) for k, v in jax.device_get(aux).items()},
+        }
+        if episode_returns:
+            metrics["episode_return_mean"] = float(np.mean(episode_returns))
+        return metrics
+
+    def stop(self) -> None:
+        stop_runners(self.runners)

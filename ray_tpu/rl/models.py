@@ -1,48 +1,162 @@
 """RL policy/value networks in pure JAX.
 
 Analogue of the reference's ``RLModule`` (``rllib/core/rl_module/
-rl_module.py``): one functional module producing action logits and value
-estimates. Torch-free; the same params pytree runs on CPU env-runners
-(inference) and TPU learners (training) — weight sync is a device_put, not a
-framework conversion (the reference needs torch<->numpy plumbing).
+rl_module.py``) + model catalog (``rllib/models/catalog.py``): one
+functional module producing action logits and value estimates. Torch-free;
+the same params pytree runs on CPU env-runners (inference) and TPU learners
+(training) — weight sync is a device_put, not a framework conversion.
+
+``build_policy`` picks the architecture from the observation shape — a
+shared-torso MLP for vector observations, a Nature-DQN convolutional torso
+for (H, W, C) pixel observations (the PPO-Atari north-star path) — and
+returns ``(init_fn, forward_fn)`` with all static structure closed over, so
+the params pytree contains ONLY arrays (optimizers tree-map it freely).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence, Tuple
+import math
+from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+# Nature-DQN conv stack: (filters, kernel, stride) — for 84x84-class
+# inputs; small frames (tests, toy pixel envs) get a shallower stack.
+_CNN_SPEC = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+_CNN_SPEC_SMALL = ((16, 3, 2), (32, 3, 2))
+
+
+def _cnn_spec_for(h: int, w: int):
+    return _CNN_SPEC if min(h, w) >= 60 else _CNN_SPEC_SMALL
+
+PolicyFns = Tuple[Callable[[jax.Array], Dict[str, Any]],
+                  Callable[[Dict[str, Any], jax.Array],
+                           Tuple[jax.Array, jax.Array]]]
+
+
+def build_policy(obs_shape: Sequence[int], num_actions: int,
+                 hidden: Sequence[int] = (64, 64)) -> PolicyFns:
+    if len(obs_shape) == 3:
+        return _build_cnn(tuple(obs_shape), num_actions)
+    import numpy as np
+
+    return _build_mlp(int(np.prod(obs_shape)), num_actions, tuple(hidden))
+
+
+def _build_mlp(obs_dim: int, num_actions: int, hidden) -> PolicyFns:
+    def init(key: jax.Array) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"layers": []}
+        sizes = [obs_dim, *hidden]
+        keys = jax.random.split(key, len(hidden) + 2)
+        for i in range(len(hidden)):
+            scale = math.sqrt(2.0 / sizes[i])
+            params["layers"].append({
+                "w": jax.random.normal(
+                    keys[i], (sizes[i], sizes[i + 1])) * scale,
+                "b": jnp.zeros((sizes[i + 1],)),
+            })
+        params["pi"] = {
+            "w": jax.random.normal(keys[-2], (sizes[-1], num_actions)) * 0.01,
+            "b": jnp.zeros((num_actions,)),
+        }
+        params["vf"] = {
+            "w": jax.random.normal(keys[-1], (sizes[-1], 1)),
+            "b": jnp.zeros((1,)),
+        }
+        return params
+
+    def forward(params, obs):
+        x = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        for layer in params["layers"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    return init, forward
+
+
+def _build_cnn(obs_shape: Tuple[int, int, int], num_actions: int,
+               fc_dim: int = 512) -> PolicyFns:
+    h0, w0, c0 = obs_shape
+    spec = _cnn_spec_for(h0, w0)
+    # Static output-shape bookkeeping for the fc layer.
+    h, w, in_ch = h0, w0, c0
+    for out_ch, ksize, stride in spec:
+        h = (h - ksize) // stride + 1
+        w = (w - ksize) // stride + 1
+        in_ch = out_ch
+    flat = h * w * in_ch
+
+    def init(key: jax.Array) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"convs": []}
+        keys = jax.random.split(key, len(spec) + 3)
+        ch = c0
+        for i, (out_ch, ksize, _stride) in enumerate(spec):
+            fan_in = ksize * ksize * ch
+            params["convs"].append({
+                "w": jax.random.normal(
+                    keys[i],
+                    (ksize, ksize, ch, out_ch)) * math.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((out_ch,)),
+            })
+            ch = out_ch
+        params["fc"] = {
+            "w": jax.random.normal(keys[-3], (flat, fc_dim)) * math.sqrt(
+                2.0 / flat),
+            "b": jnp.zeros((fc_dim,)),
+        }
+        params["pi"] = {
+            "w": jax.random.normal(keys[-2], (fc_dim, num_actions)) * 0.01,
+            "b": jnp.zeros((num_actions,)),
+        }
+        params["vf"] = {
+            "w": jax.random.normal(keys[-1], (fc_dim, 1)),
+            "b": jnp.zeros((1,)),
+        }
+        return params
+
+    def forward(params, obs):
+        x = obs.astype(jnp.float32)
+        if obs.dtype == jnp.uint8:
+            x = x / 255.0
+        for conv, (_f, _k, stride) in zip(params["convs"], spec):
+            x = jax.lax.conv_general_dilated(
+                x, conv["w"], window_strides=(stride, stride),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + conv["b"])
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(x @ params["fc"]["w"] + params["fc"]["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    return init, forward
+
+
+def make_sample_fn(forward):
+    def sample_action(params, obs, key):
+        logits, value = forward(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, value
+
+    return sample_action
+
+
+# ------------------------------------------------- backward-compat surface
 
 def init_mlp_policy(key: jax.Array, obs_dim: int, num_actions: int,
                     hidden: Sequence[int] = (64, 64)) -> Dict[str, Any]:
-    """Shared-torso MLP with policy and value heads."""
-    params: Dict[str, Any] = {"layers": []}
-    sizes = [obs_dim, *hidden]
-    keys = jax.random.split(key, len(hidden) + 2)
-    for i in range(len(hidden)):
-        k = keys[i]
-        scale = jnp.sqrt(2.0 / sizes[i])
-        params["layers"].append({
-            "w": jax.random.normal(k, (sizes[i], sizes[i + 1])) * scale,
-            "b": jnp.zeros((sizes[i + 1],)),
-        })
-    params["pi"] = {
-        "w": jax.random.normal(keys[-2], (sizes[-1], num_actions)) * 0.01,
-        "b": jnp.zeros((num_actions,)),
-    }
-    params["vf"] = {
-        "w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
-        "b": jnp.zeros((1,)),
-    }
-    return params
+    init, _ = _build_mlp(obs_dim, num_actions, tuple(hidden))
+    return init(key)
 
 
-def mlp_forward(params: Dict[str, Any],
-                obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
-    x = obs
+def mlp_forward(params: Dict[str, Any], obs: jax.Array):
+    x = obs.astype(jnp.float32)
     for layer in params["layers"]:
         x = jnp.tanh(x @ layer["w"] + layer["b"])
     logits = x @ params["pi"]["w"] + params["pi"]["b"]

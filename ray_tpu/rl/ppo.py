@@ -18,17 +18,23 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.env_runner import EnvRunner
-from ray_tpu.rl.models import init_mlp_policy, mlp_forward
+from ray_tpu.rl.common import (
+    ConfigBuilderMixin,
+    make_env_runners,
+    probe_env_spec,
+    stop_runners,
+)
+from ray_tpu.rl.models import build_policy
 
 
 @dataclass
-class PPOConfig:
+class PPOConfig(ConfigBuilderMixin):
     env: str = "CartPole-v1"
     env_config: Dict[str, Any] = field(default_factory=dict)
     num_env_runners: int = 2
     num_envs_per_runner: int = 4
     rollout_length: int = 128
+    frame_stack: int = 1
     lr: float = 3e-4
     gamma: float = 0.99
     gae_lambda: float = 0.95
@@ -42,12 +48,6 @@ class PPOConfig:
 
     def build(self) -> "PPO":
         return PPO(self)
-
-    # Builder-style setters (reference: AlgorithmConfig fluent API).
-    def environment(self, env: str, **env_config) -> "PPOConfig":
-        self.env = env
-        self.env_config = env_config
-        return self
 
     def env_runners(self, num_env_runners: int,
                     num_envs_per_runner: int = 4) -> "PPOConfig":
@@ -64,19 +64,30 @@ class PPOConfig:
 def compute_gae(rollout: Dict[str, np.ndarray], gamma: float,
                 lam: float) -> Dict[str, np.ndarray]:
     """Generalized advantage estimation over a (T, N) rollout (reference:
-    ``rllib/evaluation/postprocessing.py`` compute_advantages)."""
+    ``rllib/evaluation/postprocessing.py`` compute_advantages).
+
+    ``valids`` (optional) marks synthetic autoreset transitions (gymnasium
+    >= 1.0 NEXT_STEP mode): they contribute nothing and break the GAE chain
+    so values never leak across episode boundaries."""
     rewards, values, dones = (rollout["rewards"], rollout["values"],
                               rollout["dones"])
+    valids = rollout.get("valids")
     T, N = rewards.shape
     adv = np.zeros((T, N), np.float32)
     last_adv = np.zeros(N, np.float32)
     next_value = rollout["last_value"]
     for t in reversed(range(T)):
+        if valids is not None:
+            invalid = valids[t] < 0.5
+        else:
+            invalid = np.zeros(N, bool)
         nonterminal = 1.0 - dones[t]
         delta = rewards[t] + gamma * next_value * nonterminal - values[t]
         last_adv = delta + gamma * lam * nonterminal * last_adv
+        # Synthetic step: no advantage, and the chain restarts above it.
+        last_adv = np.where(invalid, 0.0, last_adv)
         adv[t] = last_adv
-        next_value = values[t]
+        next_value = np.where(invalid, next_value, values[t])
     returns = adv + values
     return {"advantages": adv, "returns": returns}
 
@@ -90,28 +101,16 @@ class PPO:
         self._iteration = 0
         self._total_env_steps = 0
 
-        # Probe the env spec locally for model shapes.
-        import gymnasium as gym
-
-        probe = gym.make(config.env, **config.env_config)
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
-
-        self.params = init_mlp_policy(
-            jax.random.key(config.seed), obs_dim, num_actions, config.hidden)
+        obs_shape, num_actions = probe_env_spec(
+            config.env, config.env_config, config.frame_stack)
+        init_fn, self._forward = build_policy(obs_shape, num_actions,
+                                              config.hidden)
+        self.params = init_fn(jax.random.key(config.seed))
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
         self._update = jax.jit(self._make_update())
 
-        runner_cls = ray_tpu.remote(EnvRunner)
-        self.runners = [
-            runner_cls.options(num_cpus=1).remote(
-                config.env, config.num_envs_per_runner,
-                config.rollout_length, seed=config.seed + i,
-                env_config=config.env_config)
-            for i in range(config.num_env_runners)
-        ]
+        self.runners = make_env_runners(config)
         self._broadcast_weights()
 
     # ------------------------------------------------------------- losses
@@ -123,8 +122,10 @@ class PPO:
 
         cfg = self.config
 
+        forward = self._forward
+
         def loss_fn(params, batch):
-            logits, values = mlp_forward(params, batch["obs"])
+            logits, values = forward(params, batch["obs"])
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=-1)[:, 0]
@@ -161,7 +162,8 @@ class PPO:
 
         host_params = jax.device_get(self.params)
         ref = ray_tpu.put(host_params)  # one copy in the object store
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+        ray_tpu.get([r.set_weights.remote(ref, self._iteration)
+                     for r in self.runners])
 
     def train(self) -> Dict[str, Any]:
         """One training iteration (reference: ``Algorithm.step`` ->
@@ -180,15 +182,19 @@ class PPO:
             gae = compute_gae(ro, cfg.gamma, cfg.gae_lambda)
             T, N = ro["rewards"].shape
             flat = {
-                "obs": ro["obs"].reshape(T * N, -1),
+                "obs": ro["obs"].reshape((T * N,) + ro["obs"].shape[2:]),
                 "actions": ro["actions"].reshape(-1),
                 "logp": ro["logp"].reshape(-1),
                 "advantages": gae["advantages"].reshape(-1),
                 "returns": gae["returns"].reshape(-1),
+                "valids": ro["valids"].reshape(-1),
             }
             batches.append(flat)
         batch = {k: np.concatenate([b[k] for b in batches]) for k in
                  batches[0]}
+        # Synthetic autoreset rows are not experience.
+        keep = batch.pop("valids") > 0.5
+        batch = {k: v[keep] for k, v in batch.items()}
         n = len(batch["actions"])
         self._total_env_steps += n
 
@@ -225,8 +231,4 @@ class PPO:
         return metrics
 
     def stop(self) -> None:
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+        stop_runners(self.runners)

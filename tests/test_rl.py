@@ -39,25 +39,28 @@ def test_ppo_single_iteration(ray_start_regular):
         rollout_length=32, minibatch_size=64).build()
     try:
         metrics = algo.train()
-        assert metrics["env_steps_this_iter"] == 2 * 2 * 32
+        # Autoreset rows are filtered, so steps <= T * N * runners.
+        assert 0 < metrics["env_steps_this_iter"] <= 2 * 2 * 32
         assert "total_loss" in metrics
         metrics2 = algo.train()
-        assert metrics2["env_steps_total"] == 2 * metrics["env_steps_this_iter"]
+        assert metrics2["env_steps_total"] > metrics["env_steps_this_iter"]
     finally:
         algo.stop()
 
 
-@pytest.mark.slow
+@pytest.mark.timeout_s(420)
 def test_ppo_learns_cartpole(ray_start_regular):
     """Run-to-reward: PPO should clearly improve on CartPole within a small
-    budget (reference: learning-curve regression tests)."""
+    budget (reference: learning-curve regression tests). Seeded; the
+    autoreset valids mask (gymnasium >= 1.0) is what makes this reliable —
+    without it value targets leak across episode boundaries."""
     algo = PPOConfig().environment("CartPole-v1").env_runners(
         2, num_envs_per_runner=4).training(
-        rollout_length=128, minibatch_size=256, lr=3e-4).build()
+        rollout_length=128, minibatch_size=256, lr=3e-4, seed=7).build()
     try:
         first = None
         best = 0.0
-        for i in range(15):
+        for i in range(30):
             metrics = algo.train()
             ret = metrics.get("episode_return_mean")
             if ret is not None:
@@ -69,5 +72,74 @@ def test_ppo_learns_cartpole(ray_start_regular):
         assert first is not None
         assert best >= 100.0, (
             f"PPO failed to learn: first={first}, best={best}")
+    finally:
+        algo.stop()
+
+
+def test_vtrace_on_policy_matches_gae_lambda1():
+    # With target == behavior policy (rho = c = 1) V-trace targets reduce
+    # to n-step returns, i.e. GAE with lambda=1.
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.impala import vtrace
+
+    T, N = 5, 3
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, N)).astype(np.float32)
+    values = rng.normal(size=(T, N)).astype(np.float32)
+    dones = np.zeros((T, N), np.float32)
+    dones[3, 1] = 1.0
+    valids = np.ones((T, N), np.float32)
+    last_value = rng.normal(size=(N,)).astype(np.float32)
+    logp = rng.normal(size=(T, N)).astype(np.float32)
+
+    vs, pg_adv = vtrace(jnp.asarray(logp), jnp.asarray(logp),
+                        jnp.asarray(rewards), jnp.asarray(values),
+                        jnp.asarray(dones), jnp.asarray(last_value),
+                        jnp.asarray(valids), gamma=0.9)
+    gae = compute_gae({"rewards": rewards, "values": values,
+                       "dones": dones, "last_value": last_value},
+                      gamma=0.9, lam=1.0)
+    np.testing.assert_allclose(np.asarray(vs), gae["returns"], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_impala_single_iteration(ray_start_regular):
+    from ray_tpu.rl import IMPALAConfig
+
+    algo = IMPALAConfig().environment("CartPole-v1").env_runners(
+        2, num_envs_per_runner=2).training(rollout_length=32).build()
+    try:
+        metrics = algo.train(min_rollouts=3)
+        assert metrics["rollouts_consumed"] >= 3
+        assert "total_loss" in metrics
+        assert metrics["env_steps_per_sec"] > 0
+    finally:
+        algo.stop()
+
+
+@pytest.mark.timeout_s(420)
+def test_ppo_cnn_learns_minicatch(ray_start_regular):
+    """The pixel/CNN pipeline (Nature-DQN-style torso + frame stacking):
+    PPO on MiniCatch must clearly beat the random policy (return ~ -0.95
+    with shaping)."""
+    from ray_tpu.rl import PPOConfig
+
+    algo = PPOConfig().environment(
+        "ray_tpu/MiniCatch-v0", size=16).env_runners(
+        2, num_envs_per_runner=8).training(
+        rollout_length=64, minibatch_size=512, lr=7e-4,
+        frame_stack=2, num_sgd_epochs=6, entropy_coeff=0.01,
+        seed=3).build()
+    try:
+        best = -9.0
+        for _ in range(140):
+            metrics = algo.train()
+            ret = metrics.get("episode_return_mean")
+            if ret is not None:
+                best = max(best, ret)
+            if best >= -0.1:
+                break
+        assert best >= -0.3, f"CNN PPO failed to learn MiniCatch: {best}"
     finally:
         algo.stop()
