@@ -99,7 +99,16 @@ def _utilization(rec: NodeRecord) -> float:
 
 
 class Controller:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
+        """``persist_path`` enables control-plane fault tolerance: cluster
+        metadata (KV, jobs, named actors, actor/PG records) snapshots to
+        disk and a restarted controller rebuilds from it (reference: GCS
+        Redis persistence, ``redis_store_client.h:33`` + ``gcs_init_data.cc``
+        — the durable store here is a local file, this image has no Redis).
+        Node membership is NOT persisted: nodes re-register via their
+        heartbeats, exactly like raylets reconnecting to a restarted GCS."""
+        self._persist_path = persist_path
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, NodeRecord] = {}
         self._actors: Dict[ActorID, ActorRecord] = {}
@@ -109,6 +118,13 @@ class Controller:
         self._pgs: Dict[PlacementGroupID, PlacementGroupRecord] = {}
         self._metrics: Dict[str, List[Dict[str, Any]]] = {}
         self._task_events: List[Dict[str, Any]] = []
+        # Unmet-demand signal for the autoscaler (reference:
+        # GcsAutoscalerStateManager's pending resource requests): deduped
+        # by shape, expiring shortly after failures stop, cleared when a
+        # placement of that shape succeeds — waiting submitters retry, so
+        # live demand keeps itself fresh and satisfied demand evaporates
+        # (no scale-up/down oscillation from stale history).
+        self._pending_demand: Dict[tuple, Tuple[Dict[str, float], float]] = {}
         self._clients = ClientPool()
         self._stopped = threading.Event()
         # Long-poll notification hub (reference: src/ray/pubsub/publisher.h
@@ -139,6 +155,7 @@ class Controller:
                 "get_placement_group": self.get_placement_group,
                 "remove_placement_group": self.remove_placement_group,
                 "cluster_resources": self.cluster_resources,
+                "autoscaler_state": self.autoscaler_state,
                 "push_metrics": self.push_metrics,
                 "list_metrics": self.list_metrics,
                 "metrics_text": self.metrics_text,
@@ -154,6 +171,12 @@ class Controller:
             max_workers=256,  # long-polls park handler threads
             inline_methods={"heartbeat"},
         )
+        if persist_path:
+            self._restore_state()
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, name="controller-persist",
+                daemon=True)
+            self._persist_thread.start()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="controller-health", daemon=True)
         self._health_thread.start()
@@ -161,6 +184,107 @@ class Controller:
         from ray_tpu.scripts import write_discovery
 
         write_discovery(self.address)
+
+    # ------------------------------------------------------- persistence
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "kv": dict(self._kv),
+                "jobs": {j: dict(info) for j, info in self._jobs.items()},
+                "named_actors": {n: a.binary()
+                                 for n, a in self._named_actors.items()},
+                "actors": [
+                    {"actor_id": rec.actor_id.binary(), "state": rec.state,
+                     "addr": rec.addr,
+                     "node_id": (rec.node_id.binary()
+                                 if rec.node_id else None),
+                     "info": dict(rec.info), "spec": dict(rec.spec),
+                     "opts": dict(rec.opts),
+                     "num_restarts": rec.num_restarts,
+                     "incarnation": rec.incarnation,
+                     "death_cause": rec.death_cause}
+                    for rec in self._actors.values()],
+                "pgs": [
+                    {"pg_id": rec.pg_id.binary(), "bundles": rec.bundles,
+                     "strategy": rec.strategy, "state": rec.state}
+                    for rec in self._pgs.values()],
+            }
+
+    def save_state(self) -> None:
+        if not self._persist_path:
+            return
+        import os
+        import pickle
+
+        # _snapshot_state copies every mutable container under the lock
+        # (jobs/info/spec/opts are dict()-copied; remaining values are
+        # immutable), so pickling outside the lock sees a consistent view.
+        blob = pickle.dumps(self._snapshot_state())
+        tmp = self._persist_path + ".tmp"
+        os.makedirs(os.path.dirname(self._persist_path) or ".",
+                    exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._persist_path)
+
+    def _restore_state(self) -> None:
+        import os
+        import pickle
+
+        if not os.path.exists(self._persist_path):
+            return
+        with open(self._persist_path, "rb") as f:
+            state = pickle.load(f)
+        with self._lock:
+            self._kv = dict(state.get("kv", {}))
+            self._jobs = dict(state.get("jobs", {}))
+            self._named_actors = {
+                n: ActorID(b)
+                for n, b in state.get("named_actors", {}).items()}
+            reschedule = []
+            for a in state.get("actors", []):
+                rec = ActorRecord(ActorID(a["actor_id"]), a["info"],
+                                  a["spec"], a["opts"])
+                rec.state = a["state"]
+                rec.addr = a["addr"]
+                if a.get("node_id"):
+                    rec.node_id = NodeID(a["node_id"])
+                rec.num_restarts = a["num_restarts"]
+                rec.incarnation = a["incarnation"]
+                rec.death_cause = a["death_cause"]
+                self._actors[rec.actor_id] = rec
+                # In-flight creations/restarts lost their scheduler thread
+                # with the old process: respawn it. ALIVE records keep
+                # their address; if the worker died meanwhile, the first
+                # caller's failure report drives the normal restart path.
+                if rec.state in (PENDING_CREATION, RESTARTING):
+                    reschedule.append(rec.actor_id)
+        for actor_id in reschedule:
+            threading.Thread(target=self._schedule_actor, args=(actor_id,),
+                             name="actor-schedule", daemon=True).start()
+            for p in state.get("pgs", []):
+                rec = PlacementGroupRecord(PlacementGroupID(p["pg_id"]),
+                                           p["bundles"], p["strategy"])
+                # Bundle placements referenced dead nodes; PGs return to
+                # PENDING and re-reserve on the next create call (idempotent
+                # 2PC), as the reference re-schedules PGs after GCS restart.
+                rec.state = "PENDING"
+                self._pgs[rec.pg_id] = rec
+
+    def _persist_loop(self) -> None:
+        import sys
+
+        warned = False
+        while not self._stopped.wait(2.0):
+            try:
+                self.save_state()
+                warned = False
+            except Exception as e:  # noqa: BLE001
+                if not warned:  # fault tolerance degrading is not silent
+                    print(f"controller: state persistence failing: {e!r}",
+                          file=sys.stderr)
+                    warned = True
 
     @property
     def address(self) -> Addr:
@@ -275,8 +399,12 @@ class Controller:
             alive = [r for r in self._nodes.values()
                      if r.alive and r.node_id not in excluded_ids]
             feasible = [r for r in alive if resmath.fits(r.total, resources)]
+            shape_key = tuple(sorted(resources.items()))
             if not feasible:
+                self._pending_demand[shape_key] = (dict(resources),
+                                                   time.monotonic())
                 return None
+            self._pending_demand.pop(shape_key, None)
 
             kind = strategy.get("kind", "hybrid")
             if kind == "node_affinity":
@@ -712,6 +840,20 @@ class Controller:
                 if node_rec is not None:
                     resmath.credit(node_rec.available, rec.bundles[idx])
 
+    def autoscaler_state(self) -> Dict[str, Any]:
+        """Load view for the autoscaler (reference: autoscaler.proto
+        GetClusterResourceState): alive nodes + live unmet demand (entries expire 10s after failures stop)."""
+        cutoff = time.monotonic() - 10.0
+        with self._lock:
+            self._pending_demand = {
+                k: (s, ts) for k, (s, ts) in self._pending_demand.items()
+                if ts > cutoff}
+            return {
+                "nodes": [r.summary() for r in self._nodes.values()],
+                "pending_demand": [s for s, _ in
+                                   self._pending_demand.values()],
+            }
+
     # ------------------------------------------- metrics + task events
     #
     # Observability floor (reference: src/ray/stats/metric_defs.cc export
@@ -752,5 +894,9 @@ class Controller:
 
     def stop(self) -> None:
         self._stopped.set()
+        try:
+            self.save_state()
+        except Exception:
+            pass
         self._clients.close_all()
         self._server.stop()

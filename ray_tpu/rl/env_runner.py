@@ -64,14 +64,12 @@ class EnvRunner:
         self._episode_lengths = np.zeros(num_envs, dtype=np.int64)
         self._completed: list = []
         self._params = None
-        self._sample_fn = None
         self._weights_version = -1
 
         from ray_tpu.rl.models import build_policy, make_sample_fn
 
         n_actions = int(self.envs.single_action_space.n)
-        obs_shape = self.obs.shape[1:]
-        _init, forward = build_policy(obs_shape, n_actions)
+        _unused_init, forward = build_policy(self.obs.shape[1:], n_actions)
         self._sample_fn = jax.jit(make_sample_fn(forward))
 
     @property

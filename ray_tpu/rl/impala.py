@@ -102,6 +102,7 @@ class IMPALA:
         self._iteration = 0
         self._updates = 0
         self._total_env_steps = 0
+        self._steps_iter = 0
 
         obs_shape, num_actions = probe_env_spec(
             config.env, config.env_config, config.frame_stack)
@@ -210,8 +211,7 @@ class IMPALA:
                 consumed += 1
                 valid_steps = int(rollout["valids"].sum())
                 self._total_env_steps += valid_steps
-                steps_this_iter = getattr(self, "_steps_iter", 0)
-                self._steps_iter = steps_this_iter + valid_steps
+                self._steps_iter += valid_steps
                 if self._updates % cfg.broadcast_interval == 0:
                     self._push_weights()
         elapsed = time.monotonic() - t0
@@ -221,7 +221,7 @@ class IMPALA:
         episode_returns = [s["episode_return_mean"] for s in stats
                            if s.get("episodes")]
         self._iteration += 1
-        steps = getattr(self, "_steps_iter", 0)
+        steps = self._steps_iter
         self._steps_iter = 0
         metrics = {
             "training_iteration": self._iteration,

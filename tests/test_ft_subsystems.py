@@ -1,0 +1,187 @@
+"""Control-plane FT + autoscaler + durable workflows (reference:
+``redis_store_client.h:33`` GCS persistence, ``autoscaler.py:172``,
+``workflow_executor.py``)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.controller import Controller
+from ray_tpu.core.node import Node
+
+
+def test_controller_persistence_restores_state(tmp_path):
+    path = str(tmp_path / "gcs.snapshot")
+    c1 = Controller(persist_path=path)
+    c1.kv_put("key1", b"value1")
+    c1.register_job("jobA", {"entrypoint": "x"})
+    c1.save_state()
+    c1.stop()
+
+    c2 = Controller(persist_path=path)
+    try:
+        assert c2.kv_get("key1") == b"value1"
+        assert c2.list_jobs()["jobA"]["state"] == "RUNNING"
+        # Nodes re-register (not persisted): a fresh node joins cleanly.
+        node = Node(c2.address, {"CPU": 2.0})
+        deadline = time.monotonic() + 10
+        while not any(n["alive"] for n in c2.list_nodes()):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        node.stop()
+    finally:
+        c2.stop()
+
+
+def test_controller_persists_named_actor_records(tmp_path):
+    path = str(tmp_path / "gcs2.snapshot")
+    c1 = Controller(persist_path=path)
+    c1.register_actor(b"a" * 16, {"name": "keeper", "max_restarts": 0},
+                      {"cls_key": "k", "args_blob": b"", "desc": "keeper"},
+                      {"resources": {"CPU": 1.0}})
+    time.sleep(0.1)
+    c1.stop()
+    c2 = Controller(persist_path=path)
+    try:
+        assert c2.get_named_actor("keeper") == b"a" * 16
+        rec = c2.get_actor(b"a" * 16)
+        assert rec is not None and rec["info"]["name"] == "keeper"
+    finally:
+        c2.stop()
+
+
+@pytest.mark.timeout_s(240)
+def test_autoscaler_scales_up_and_down():
+    from ray_tpu.autoscaler import FakeMultiNodeProvider, StandardAutoscaler
+
+    controller = Controller()
+    provider = FakeMultiNodeProvider(controller.address)
+    autoscaler = StandardAutoscaler(
+        controller, provider, node_resources={"CPU": 2.0, "burst": 2.0},
+        min_nodes=0, max_nodes=3, idle_timeout_s=2.0,
+        update_interval_s=0.3)
+    try:
+        # Demand for a resource no node has -> failed picks -> scale up.
+        for _ in range(3):
+            controller.pick_node({"burst": 1.0})
+        autoscaler.update()
+        assert autoscaler.num_launches >= 1
+        deadline = time.monotonic() + 15
+        while not any(n["alive"] and "burst" in n["resources"]
+                      for n in controller.list_nodes()):
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        # Demand satisfied now.
+        assert controller.pick_node({"burst": 1.0}) is not None
+
+        # Idle past the timeout -> scale down to min_nodes.
+        autoscaler.start()
+        deadline = time.monotonic() + 30
+        while provider.non_terminated_nodes():
+            assert time.monotonic() < deadline, "never scaled down"
+            time.sleep(0.3)
+        assert autoscaler.num_terminations >= 1
+    finally:
+        autoscaler.stop()
+        for pid in provider.non_terminated_nodes():
+            provider.terminate_node(pid)
+        controller.stop()
+
+
+def test_tpu_vm_provider_transport_contract():
+    from ray_tpu.autoscaler import TPUVMNodeProvider
+
+    calls = []
+    nodes = {}
+
+    def transport(verb, path, body):
+        calls.append((verb, path))
+        if verb == "POST":
+            name = path.split("nodeId=")[1]
+            nodes[name] = {"name": path.split("?")[0] + "/" + name,
+                           "state": "READY"}
+            return {}
+        if verb == "DELETE":
+            for k, n in list(nodes.items()):
+                if n["name"] == path:
+                    del nodes[k]
+            return {}
+        return {"nodes": list(nodes.values())}
+
+    provider = TPUVMNodeProvider(transport, "proj", "us-central2-b",
+                                 accelerator_type="v5litepod-16")
+    pid = provider.create_node({"TPU": 16.0}, {"slice": "v5e-16"})
+    assert provider.non_terminated_nodes()
+    provider.terminate_node(pid)
+    assert not provider.non_terminated_nodes()
+    assert calls[0][0] == "POST" and "acceleratorType" not in calls[0][1]
+
+
+def test_workflow_run_and_resume(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    marker = str(tmp_path / "ran_flaky")
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def flaky_add(x):
+        # Fails the first time only (simulates a crash mid-workflow).
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("1")
+            raise RuntimeError("transient failure")
+        return x + 5
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky_add_noretry(x):
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("1")
+            raise RuntimeError("transient failure")
+        return x + 5
+
+    storage = str(tmp_path / "durable")
+    with InputNode() as inp:
+        dag = flaky_add_noretry.bind(double.bind(inp))
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf1", storage=storage, args=10)
+    assert workflow.get_status("wf1", storage=storage) == "FAILED"
+
+    result = workflow.resume("wf1", storage=storage)
+    assert result == 25  # 10*2 + 5
+    assert workflow.get_status("wf1", storage=storage) == "SUCCEEDED"
+    # Resume of a finished workflow returns the stored result instantly.
+    assert workflow.resume("wf1", storage=storage) == 25
+
+
+def test_workflow_steps_not_reexecuted(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    count_file = str(tmp_path / "count")
+
+    @ray_tpu.remote
+    def counted(x):
+        n = 0
+        if os.path.exists(count_file):
+            with open(count_file) as f:
+                n = int(f.read())
+        with open(count_file, "w") as f:
+            f.write(str(n + 1))
+        return x + 1
+
+    storage = str(tmp_path / "durable2")
+    with InputNode() as inp:
+        dag = counted.bind(inp)
+    assert workflow.run(dag, workflow_id="wf2", storage=storage,
+                        args=1) == 2
+    assert workflow.resume("wf2", storage=storage) == 2
+    with open(count_file) as f:
+        assert int(f.read()) == 1  # executed exactly once
