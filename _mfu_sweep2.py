@@ -92,18 +92,18 @@ d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
                           n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
 fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
 CONFIGS = [
-    ("d1152 fused s1024 b48 accum4",
+    ("d1152 fused names s1024 b16",
      fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True), 48 * 4, 1024, 4),
-    ("d1152 fused s1024 b56",
+        fused_qkv=True, fused_mlp=True, remat_policy="names"), 16, 1024, 1),
+    ("d1152 fused names s1024 b24",
      fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True), 56, 1024, 1),
-    ("d1152 fused s512 b96",
-     fl(dataclasses.replace(d1152, max_seq_len=512), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True), 96, 512, 1),
-    ("d1280 fused s1024 b40",
-     fl(dataclasses.replace(d1280, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True), 40, 1024, 1),
+        fused_qkv=True, fused_mlp=True, remat_policy="names"), 24, 1024, 1),
+    ("d1152 fused norem s1024 b8",
+     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
+        fused_qkv=True, fused_mlp=True, remat=False), 8, 1024, 1),
+    ("d1152 fused flash s1024 b44",
+     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
+        fused_qkv=True, fused_mlp=True), 44, 1024, 1),
 ]
 
 if __name__ == "__main__":

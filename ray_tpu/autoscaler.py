@@ -59,9 +59,10 @@ class FakeMultiNodeProvider(NodeProvider):
     def create_node(self, resources, labels) -> str:
         from ray_tpu.core.node import Node
 
-        node = Node(self._controller_addr, dict(resources), dict(labels))
         self._counter += 1
         pid = f"fake-{self._counter}"
+        node = Node(self._controller_addr, dict(resources),
+                    {**labels, "provider_node_id": pid})
         self._nodes[pid] = node
         return pid
 
@@ -98,13 +99,17 @@ class TPUVMNodeProvider(NodeProvider):
     def create_node(self, resources, labels) -> str:
         self._counter += 1
         name = f"ray-tpu-slice-{self._counter}"
+        node_path = f"{self._base}/nodes/{name}"
         self._transport("POST", f"{self._base}/nodes?nodeId={name}", {
             "acceleratorType": self._accelerator_type,
             "runtimeVersion": self._runtime_version,
-            "labels": dict(labels),
+            # The slice's nodes start ray with this label so the autoscaler
+            # can map cluster nodes back to provider instances (idle
+            # teardown keys on it).
+            "labels": {**labels, "provider_node_id": node_path},
             "metadata": {"ray_resources": str(dict(resources))},
         })
-        return f"{self._base}/nodes/{name}"
+        return node_path
 
     def terminate_node(self, provider_node_id: str) -> None:
         self._transport("DELETE", provider_node_id, None)
@@ -163,11 +168,18 @@ class StandardAutoscaler:
             self._thread.join(timeout=5.0)
 
     def _loop(self) -> None:
+        import sys
+
+        warned = False
         while not self._stop.wait(self._update_interval_s):
             try:
                 self.update()
-            except Exception:
-                pass
+                warned = False
+            except Exception as e:  # noqa: BLE001
+                if not warned:  # a dead autoscaler must not be silent
+                    print(f"autoscaler: update failing: {e!r}",
+                          file=sys.stderr)
+                    warned = True
 
     # ------------------------------------------------------------- update
 
@@ -177,10 +189,19 @@ class StandardAutoscaler:
         state = self._controller.autoscaler_state()
         nodes = [n for n in state["nodes"] if n["alive"]]
         demand = state["pending_demand"]  # list of resource dicts
+        provider_ids = set(self._provider.non_terminated_nodes())
+        registered = {n["labels"].get("provider_node_id")
+                      for n in nodes}
 
         # Plan scale-up: bin-pack unmet demand onto hypothetical new nodes.
+        # Launched-but-not-yet-registered nodes count as capacity so slow
+        # provisioning (minutes for a TPU slice) doesn't relaunch the same
+        # demand every tick.
+        provisioning = len(provider_ids - registered)
         unmet: List[Dict[str, float]] = []
-        capacity = [dict(n["available"]) for n in nodes]
+        capacity = ([dict(n["available"]) for n in nodes]
+                    + [dict(self._node_resources)
+                       for _ in range(provisioning)])
         for shape in demand:
             if not any(resmath.fits(c, shape) and resmath.take(c, shape)
                        for c in capacity):
@@ -208,24 +229,24 @@ class StandardAutoscaler:
             self._provider.create_node(self._node_resources, {})
             self.num_launches += 1
 
-        # Plan scale-down: terminate nodes idle past the timeout.
+        # Plan scale-down: terminate nodes idle past the timeout. Any
+        # provider works: nodes carry their provider instance id as the
+        # "provider_node_id" label.
         now = time.monotonic()
-        fake_ids = {}
-        if isinstance(self._provider, FakeMultiNodeProvider):
-            fake_ids = {self._provider.node_id_of(p): p
-                        for p in self._provider.non_terminated_nodes()}
-        for n in nodes:
+        remaining = len(nodes)
+        for n in list(nodes):
             busy = (n["queue_len"] > 0
                     or any(n["available"].get(k, 0) < v
                            for k, v in n["resources"].items()))
             if busy:
                 self._idle_since.pop(n["node_id"], None)
                 continue
+            pid = n["labels"].get("provider_node_id")
             first_idle = self._idle_since.setdefault(n["node_id"], now)
             if (now - first_idle > self._idle_timeout_s
-                    and len(nodes) > self._min_nodes
-                    and n["node_id"] in fake_ids):
-                self._provider.terminate_node(fake_ids[n["node_id"]])
+                    and remaining > self._min_nodes
+                    and pid in provider_ids):
+                self._provider.terminate_node(pid)
                 self._idle_since.pop(n["node_id"], None)
                 self.num_terminations += 1
-                nodes.remove(n)
+                remaining -= 1

@@ -109,6 +109,7 @@ class Controller:
         Node membership is NOT persisted: nodes re-register via their
         heartbeats, exactly like raylets reconnecting to a restarted GCS."""
         self._persist_path = persist_path
+        self._save_lock = threading.Lock()
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, NodeRecord] = {}
         self._actors: Dict[ActorID, ActorRecord] = {}
@@ -220,13 +221,16 @@ class Controller:
         # _snapshot_state copies every mutable container under the lock
         # (jobs/info/spec/opts are dict()-copied; remaining values are
         # immutable), so pickling outside the lock sees a consistent view.
-        blob = pickle.dumps(self._snapshot_state())
-        tmp = self._persist_path + ".tmp"
-        os.makedirs(os.path.dirname(self._persist_path) or ".",
-                    exist_ok=True)
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, self._persist_path)
+        # _save_lock serializes writers (stop() racing the persist loop on
+        # the shared .tmp path would corrupt the snapshot).
+        with self._save_lock:
+            blob = pickle.dumps(self._snapshot_state())
+            tmp = self._persist_path + ".tmp"
+            os.makedirs(os.path.dirname(self._persist_path) or ".",
+                        exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._persist_path)
 
     def _restore_state(self) -> None:
         import os
@@ -260,17 +264,18 @@ class Controller:
                 # caller's failure report drives the normal restart path.
                 if rec.state in (PENDING_CREATION, RESTARTING):
                     reschedule.append(rec.actor_id)
-        for actor_id in reschedule:
-            threading.Thread(target=self._schedule_actor, args=(actor_id,),
-                             name="actor-schedule", daemon=True).start()
             for p in state.get("pgs", []):
-                rec = PlacementGroupRecord(PlacementGroupID(p["pg_id"]),
-                                           p["bundles"], p["strategy"])
+                pg_rec = PlacementGroupRecord(
+                    PlacementGroupID(p["pg_id"]), p["bundles"],
+                    p["strategy"])
                 # Bundle placements referenced dead nodes; PGs return to
                 # PENDING and re-reserve on the next create call (idempotent
                 # 2PC), as the reference re-schedules PGs after GCS restart.
-                rec.state = "PENDING"
-                self._pgs[rec.pg_id] = rec
+                pg_rec.state = "PENDING"
+                self._pgs[pg_rec.pg_id] = pg_rec
+        for actor_id in reschedule:
+            threading.Thread(target=self._schedule_actor, args=(actor_id,),
+                             name="actor-schedule", daemon=True).start()
 
     def _persist_loop(self) -> None:
         import sys

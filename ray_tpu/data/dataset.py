@@ -100,11 +100,33 @@ class _Op:
 
 
 class _MapBatches(_Op):
-    def __init__(self, fn: Callable[[Block], Block]):
+    def __init__(self, fn: Callable[[Block], Block], compute: str = "tasks",
+                 concurrency: int = 2, fn_constructor_args: tuple = ()):
         self.fn = fn
+        self.compute = compute  # "tasks" | "actors"
+        self.concurrency = concurrency
+        self.fn_constructor_args = fn_constructor_args
 
     def apply_block(self, block):
         return self.fn(block)
+
+
+class _ActorMapWorker:
+    """Stateful map worker: a callable-class op instantiates ONCE per actor
+    (reference: ``actor_pool_map_operator.py`` — the pattern for expensive
+    per-worker setup like loading a model for batch inference)."""
+
+    def __init__(self, fn_blob: bytes, fn_constructor_args: tuple):
+        import inspect
+
+        from ray_tpu.core import serialization
+
+        fn = serialization.loads_function(fn_blob)
+        self._fn = (fn(*fn_constructor_args) if inspect.isclass(fn)
+                    else fn)
+
+    def apply(self, block: Block) -> Block:
+        return self._fn(block)
 
 
 class _Filter(_Op):
@@ -140,8 +162,15 @@ class Dataset:
     # ---------------------------------------------------- transformations
 
     def map_batches(self, fn: Callable[[Block], Block],
+                    compute: str = "tasks", concurrency: int = 2,
+                    fn_constructor_args: tuple = (),
                     **_compat) -> "Dataset":
-        return Dataset(self._block_refs, self._ops + [_MapBatches(fn)])
+        """``compute="actors"`` runs this op on a pool of ``concurrency``
+        stateful actors; ``fn`` may be a callable CLASS constructed once per
+        actor (reference: ``Dataset.map_batches`` compute=ActorPoolStrategy,
+        ``actor_pool_map_operator.py``)."""
+        return Dataset(self._block_refs, self._ops + [_MapBatches(
+            fn, compute, concurrency, fn_constructor_args)])
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
         def batch_fn(block: Block) -> Block:
@@ -213,9 +242,18 @@ class Dataset:
 
     # --------------------------------------------------------- execution
 
+    def _has_actor_ops(self) -> bool:
+        return any(isinstance(op, _MapBatches) and op.compute == "actors"
+                   for op in self._ops)
+
     def _streamed_blocks(self, max_in_flight: int = 8) -> Iterator[Block]:
         """Pull-based streaming execution with a bounded in-flight window
         (the backpressure half of the reference's StreamingExecutor)."""
+        if self._has_actor_ops():
+            # Actor segments materialize via the pool executor.
+            for ref in self.materialize()._block_refs:
+                yield ray_tpu.get(ref)
+            return
         if not self._ops:
             for ref in self._block_refs:
                 yield ray_tpu.get(ref)
@@ -235,11 +273,51 @@ class Dataset:
     def materialize(self) -> "Dataset":
         if not self._ops:
             return Dataset(self._block_refs)
-        fused = _fuse_ops(self._ops)
-        process = ray_tpu.remote(lambda block: fused(block))
-        out_refs = [process.remote(ref) for ref in self._block_refs]
-        ray_tpu.wait(out_refs, num_returns=len(out_refs), timeout=None)
-        return Dataset(out_refs)
+        refs = list(self._block_refs)
+        # Consecutive task ops fuse into one task per block; an actor op
+        # breaks fusion and runs on a stateful pool (operator grouping, as
+        # the reference's physical planner does).
+        segment: List[_Op] = []
+
+        def flush_tasks(refs):
+            if not segment:
+                return refs
+            fused = _fuse_ops(list(segment))
+            process = ray_tpu.remote(lambda block: fused(block))
+            segment.clear()
+            return [process.remote(r) for r in refs]
+
+        for op in self._ops:
+            if isinstance(op, _MapBatches) and op.compute == "actors":
+                refs = flush_tasks(refs)
+                refs = self._actor_map(op, refs)
+            else:
+                segment.append(op)
+        refs = flush_tasks(refs)
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
+        return Dataset(refs)
+
+    def _actor_map(self, op: "_MapBatches", refs: List[Any]) -> List[Any]:
+        from ray_tpu.core import serialization
+
+        worker_cls = ray_tpu.remote(_ActorMapWorker)
+        fn_blob = serialization.dumps_function(op.fn)
+        actors = [worker_cls.options(num_cpus=1).remote(
+            fn_blob, op.fn_constructor_args)
+            for _ in range(max(1, op.concurrency))]
+        try:
+            # Round-robin blocks over the pool; results stay as refs (the
+            # data plane never routes through the driver).
+            out_refs = [actors[i % len(actors)].apply.remote(ref)
+                        for i, ref in enumerate(refs)]
+            ray_tpu.wait(out_refs, num_returns=len(out_refs), timeout=None)
+            return out_refs
+        finally:
+            for actor in actors:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
 
     # -------------------------------------------------------- consumption
 
@@ -285,6 +363,26 @@ class Dataset:
 
     def num_blocks(self) -> int:
         return len(self._block_refs)
+
+    def write_parquet(self, path: str) -> List[str]:
+        """Write one parquet file per block via tasks (reference:
+        ``Dataset.write_parquet``); returns the written paths."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        mat = self.materialize()
+
+        @ray_tpu.remote
+        def write_one(block: Block, out_path: str) -> str:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(pa.table(dict(block)), out_path)
+            return out_path
+
+        refs = [write_one.remote(r, os.path.join(path, f"part-{i:05d}.parquet"))
+                for i, r in enumerate(mat._block_refs)]
+        return ray_tpu.get(refs)
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by whole blocks."""

@@ -60,10 +60,20 @@ def _load(storage: str, workflow_id: str, key: str):
 
 def run(dag: DAGNode, *, workflow_id: str, storage: str,
         args: Any = None) -> Any:
-    """Execute a DAG durably; persists the graph + every step result."""
+    """Execute a DAG durably; persists the graph + every step result.
+
+    Re-running an existing workflow_id with DIFFERENT args starts fresh
+    (old step results are invalidated — step keys don't encode args, so
+    reusing them would silently return the previous run's answers)."""
+    args_blob = pickle.dumps(args)
+    prior, ok = _load(storage, workflow_id, "__graph__")
+    if ok and prior.get("args") != args_blob:
+        import shutil
+
+        shutil.rmtree(_wf_dir(storage, workflow_id), ignore_errors=True)
     _store(storage, workflow_id, "__graph__",
            {"dag": pickle.dumps(_make_picklable(dag)),
-            "args": pickle.dumps(args)})
+            "args": args_blob})
     _store(storage, workflow_id, "__status__", "RUNNING")
     try:
         result = _execute(dag, workflow_id, storage, args)

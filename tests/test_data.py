@@ -141,3 +141,44 @@ def test_distributed_shuffle_multinode(ray_start_cluster):
     vals2 = np.array([r["id"]
                       for r in ds.random_shuffle(seed=3).iter_rows()])
     assert np.array_equal(vals, vals2)
+
+
+def test_actor_pool_map_batches(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    class AddBias:
+        """Stateful callable: constructed once per pool actor."""
+
+        def __init__(self, bias):
+            import os
+
+            self.bias = bias
+            self.pid = os.getpid()
+
+        def __call__(self, block):
+            import numpy as np
+
+            return {"id": block["id"] + self.bias,
+                    "pid": np.full(len(block["id"]), self.pid)}
+
+    ds = rdata.range(100, num_blocks=8).map_batches(
+        AddBias, compute="actors", concurrency=2,
+        fn_constructor_args=(1000,))
+    rows = sorted(r["id"] for r in ds.iter_rows())
+    assert rows == list(range(1000, 1100))
+    pids = {r["pid"] for r in ds.materialize().iter_rows()}
+    assert 1 <= len(pids) <= 2  # stateful workers reused across blocks
+
+
+def test_write_and_read_parquet_roundtrip(ray_start_regular, tmp_path):
+    import numpy as np
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_numpy({"x": np.arange(50), "y": np.arange(50) * 2.0},
+                          num_blocks=4)
+    paths = ds.write_parquet(str(tmp_path / "out"))
+    assert len(paths) == 4
+    back = rdata.read_parquet(str(tmp_path / "out" / "*.parquet"))
+    xs = sorted(r["x"] for r in back.iter_rows())
+    assert xs == list(range(50))

@@ -250,3 +250,32 @@ def test_unknown_deployment_fails_fast(serve_cluster):
     with pytest.raises(KeyError):
         serve.get_deployment_handle("nope").remote(1).result(timeout=30)
     assert time.time() - t0 < 10, "unknown deployment stalled"
+
+
+def test_deployment_composition(serve_cluster):
+    # Model composition: a deployment holding handles to other deployments
+    # (reference: deployment graphs / DeploymentHandle passing).
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, pre, model):
+            self.pre = pre
+            self.model = model
+
+        def __call__(self, x):
+            staged = self.pre.remote(x).result(timeout=30)
+            return self.model.remote(staged).result(timeout=30)
+
+    pre = serve.run(Preprocess.bind(), name="pre")
+    model = serve.run(Model.bind(), name="model")
+    app = serve.run(Ensemble.bind(pre, model), name="ensemble")
+    assert app.remote(5).result(timeout=60) == 11
