@@ -62,3 +62,48 @@ class Cluster:
                 pass
         self.nodes.clear()
         self.controller.stop()
+
+
+class WorkerKiller:
+    """Chaos utility: randomly SIGKILLs worker processes while a workload
+    runs (reference: ``_ray_start_chaos_cluster`` + ``WorkerKillerActor``,
+    ``python/ray/_private/test_utils.py:1562``). Tasks must still complete
+    through owner-side retries."""
+
+    def __init__(self, nodes, period_s: float = 0.5, seed: int = 0):
+        import random
+        import threading
+
+        self._nodes = list(nodes)
+        self._period = period_s
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="worker-killer")
+        self.kills = 0
+
+    def start(self) -> "WorkerKiller":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        import os
+        import signal
+
+        while not self._stop.wait(self._period):
+            node = self._rng.choice(self._nodes)
+            with node._lock:
+                victims = [h for h in node._workers.values()
+                           if not h.dedicated and h.proc.poll() is None]
+            if not victims:
+                continue
+            victim = self._rng.choice(victims)
+            try:
+                os.kill(victim.proc.pid, signal.SIGKILL)
+                self.kills += 1
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -372,11 +372,23 @@ class Node:
         handle.env_hash = _runtime_env_hash(runtime_env)
         with self._lock:
             self._workers[worker_id] = handle
-        if not handle.registered.wait(config.worker_start_timeout_s):
-            proc.kill()
-            with self._lock:
-                self._workers.pop(worker_id, None)
-            raise TimeoutError(f"worker {worker_id.hex()} failed to register")
+        # Fail FAST if the process dies before registering (chaos kill, bad
+        # env): waiting out the full timeout would eat the caller's whole
+        # lease deadline and turn one crash into a task failure.
+        deadline = time.monotonic() + config.worker_start_timeout_s
+        while not handle.registered.wait(0.2):
+            if proc.poll() is not None:
+                with self._lock:
+                    self._workers.pop(worker_id, None)
+                raise RuntimeError(
+                    f"worker {worker_id.hex()} died before registering "
+                    f"(exit {proc.returncode})")
+            if time.monotonic() > deadline:
+                proc.kill()
+                with self._lock:
+                    self._workers.pop(worker_id, None)
+                raise TimeoutError(
+                    f"worker {worker_id.hex()} failed to register")
         return handle
 
     def _materialize_working_dir(

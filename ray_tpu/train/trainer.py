@@ -101,9 +101,19 @@ class JaxTrainer:
         group = WorkerGroup(sc.num_workers, sc.worker_resources(),
                             sc.placement_strategy, jax_config=sc.jax_config)
         try:
-            group.start(self.run_config.storage_path, self._name,
-                        latest_checkpoint)
-            group.run(self._train_fn, self._config)
+            try:
+                group.start(self.run_config.storage_path, self._name,
+                            latest_checkpoint)
+                group.run(self._train_fn, self._config)
+            except _AttemptFailed:
+                raise
+            except Exception as e:
+                # A worker can die between starting its train thread and
+                # the start() reply flushing (e.g. the loop crashes
+                # immediately): that's an attempt failure, not a driver
+                # error — the retry budget owns it.
+                raise _AttemptFailed(
+                    f"worker group setup failed: {e}", latest_checkpoint)
             return self._poll_until_done(group, history, latest_checkpoint)
         finally:
             group.shutdown()

@@ -149,3 +149,33 @@ def test_node_death_detection(ray_start_cluster):
             break
         time.sleep(0.2)
     assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
+
+
+@pytest.mark.timeout_s(300)
+def test_chaos_worker_kills_tasks_still_complete(ray_start_cluster):
+    """Chaos: SIGKILL pooled workers mid-storm; owner-side retries must
+    land every task (reference: chaos cluster tests, conftest.py:900)."""
+    from ray_tpu.cluster_utils import WorkerKiller
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address,
+                 _system_config={"worker_lease_timeout_s": 60.0})
+
+    @ray_tpu.remote
+    def work(i):
+        import time as t
+
+        t.sleep(0.05)
+        return i * i
+
+    killer = WorkerKiller(cluster.nodes, period_s=0.4).start()
+    try:
+        refs = [work.remote(i) for i in range(120)]
+        results = ray_tpu.get(refs, timeout=240)
+    finally:
+        killer.stop()
+    assert results == [i * i for i in range(120)]
+    assert killer.kills > 0, "chaos never killed anything"

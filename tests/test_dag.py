@@ -37,28 +37,31 @@ def test_compiled_pipeline_results_in_order(ray_start_regular):
 
 
 def test_compiled_pipeline_overlaps_stages(ray_start_regular):
-    # Two stages each sleeping 0.2s: pipelined execution of 6 items must
-    # take ~(6+1)*0.2s, far less than the serial 6*0.4s.
+    # Two stages each sleeping 0.4s: pipelined execution of 8 items takes
+    # ~(8+1)*0.4s = 3.6s vs 6.4s serial; the 0.8x-serial threshold leaves
+    # wide margin for 1-core scheduler jitter under a loaded test host.
     @ray_tpu.remote
     def slow_a(x):
-        time.sleep(0.2)
+        time.sleep(0.4)
         return x
 
     @ray_tpu.remote
     def slow_b(x):
-        time.sleep(0.2)
+        time.sleep(0.4)
         return x
 
     with InputNode() as inp:
         dag = slow_b.bind(slow_a.bind(inp))
     cdag = dag.experimental_compile(max_in_flight=8)
     try:
+        futs = [cdag.execute(i) for i in range(2)]  # warm both stage actors
+        [f.result(timeout=60) for f in futs]
         t0 = time.monotonic()
-        futs = [cdag.execute(i) for i in range(6)]
-        out = [f.result(timeout=60) for f in futs]
+        futs = [cdag.execute(i) for i in range(8)]
+        out = [f.result(timeout=90) for f in futs]
         elapsed = time.monotonic() - t0
-        assert out == list(range(6))
-        assert elapsed < 6 * 0.4 * 0.8, (
+        assert out == list(range(8))
+        assert elapsed < 8 * 0.8 * 0.8, (
             f"no pipeline overlap: {elapsed:.2f}s")
     finally:
         cdag.teardown()
