@@ -94,11 +94,14 @@ def run_one(cfg, batch: int, seq: int, steps: int):
         NamedSharding(mesh, P(None, ("data", "fsdp"), None)))
     params, opt_state, losses = multi(params, opt_state, toks)
     _ = float(losses[-1])  # drain warmup
-    t0 = time.perf_counter()
-    params, opt_state, losses = multi(params, opt_state, toks)
-    loss = float(losses[-1])
-    dt = (time.perf_counter() - t0) / steps
-    return dt, loss
+    best_dt = None
+    for _rep in range(2):  # best-of-2: tunneled-chip throughput jitters
+        t0 = time.perf_counter()
+        params, opt_state, losses = multi(params, opt_state, toks)
+        loss = float(losses[-1])
+        dt = (time.perf_counter() - t0) / steps
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    return best_dt, loss
 
 
 def main() -> None:

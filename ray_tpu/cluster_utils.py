@@ -87,22 +87,10 @@ class WorkerKiller:
         return self
 
     def _loop(self) -> None:
-        import os
-        import signal
-
         while not self._stop.wait(self._period):
             node = self._rng.choice(self._nodes)
-            with node._lock:
-                victims = [h for h in node._workers.values()
-                           if not h.dedicated and h.proc.poll() is None]
-            if not victims:
-                continue
-            victim = self._rng.choice(victims)
-            try:
-                os.kill(victim.proc.pid, signal.SIGKILL)
+            if node.kill_random_pooled_worker(self._rng):
                 self.kills += 1
-            except OSError:
-                pass
 
     def stop(self) -> None:
         self._stop.set()

@@ -559,6 +559,24 @@ class Node:
         except OSError:
             pass
 
+    def kill_random_pooled_worker(self, rng) -> bool:
+        """Chaos/testing hook: SIGKILL one random pooled (non-actor) worker
+        process. Keeps worker-table invariants inside Node (the reaper
+        credits the lease and forgets the corpse)."""
+        import signal
+
+        with self._lock:
+            victims = [h for h in self._workers.values()
+                       if not h.dedicated and h.proc.poll() is None]
+        if not victims:
+            return False
+        victim = rng.choice(victims)
+        try:
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            return True
+        except OSError:
+            return False
+
     def get_info(self) -> Dict[str, Any]:
         with self._lock:
             return {

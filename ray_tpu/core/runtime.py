@@ -820,6 +820,7 @@ class TaskSubmitter:
                 core.wait_ready(ref, None)
             retries_left = options.get("max_retries", 3)
             excluded: List[bytes] = []
+            lease_attempts = 0
             deadline = time.monotonic() + config.worker_lease_timeout_s
             while True:
                 # 2. Cluster-level node selection.
@@ -852,11 +853,24 @@ class TaskSubmitter:
                 # 3. Worker lease from the chosen node. Transport errors
                 #    (node died between pick and lease) count as lease
                 #    failures: exclude the node and re-pick.
+                # Spillback (reference: hybrid_scheduling_policy.cc
+                # redirects): the first two lease attempts use a SHORT
+                # patience — if the picked node is busy, the quick "lease
+                # timeout" reply excludes it and re-picks another node
+                # instead of queueing behind a stale choice. Later attempts
+                # wait out the owner's remaining deadline (genuinely
+                # saturated cluster). Both are clamped to that deadline.
+                remaining = max(0.2, deadline - time.monotonic())
+                patience = (min(5.0, remaining)
+                            if lease_attempts < 2 and bundle is None
+                            else remaining)
+                lease_attempts += 1
                 try:
                     node_client = core.clients.get(node_addr)
                     lease = node_client.call(
                         "lease_worker", options.get("resources", {"CPU": 1.0}),
-                        bundle, None, False, options.get("runtime_env"),
+                        bundle, patience, False,
+                        options.get("runtime_env"),
                         timeout=config.worker_lease_timeout_s + 10.0)
                 except (RpcError, RemoteCallError, TimeoutError) as e:
                     core.clients.invalidate(tuple(node_addr))

@@ -97,14 +97,20 @@ class JaxTrainer:
 
     def _run_attempt(self, latest_checkpoint: Optional[str],
                      history: List[Dict[str, Any]]) -> Result:
+        from ray_tpu.core import serialization
+
         sc = self.scaling_config
+        # Deterministic driver-side failures (unpicklable train fn) raise
+        # HERE, outside the retry budget — only distributed failures below
+        # convert to attempt failures.
+        fn_blob = serialization.dumps_function(self._train_fn)
         group = WorkerGroup(sc.num_workers, sc.worker_resources(),
                             sc.placement_strategy, jax_config=sc.jax_config)
         try:
             try:
                 group.start(self.run_config.storage_path, self._name,
                             latest_checkpoint)
-                group.run(self._train_fn, self._config)
+                group.run(self._train_fn, self._config, fn_blob=fn_blob)
             except _AttemptFailed:
                 raise
             except Exception as e:

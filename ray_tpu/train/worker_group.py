@@ -179,8 +179,10 @@ class WorkerGroup:
             raise ray_tpu.RayTpuError(
                 f"inconsistent global device counts across workers: {counts}")
 
-    def run(self, train_fn: Callable, config: Optional[Dict]) -> None:
-        fn_blob = serialization.dumps_function(train_fn)
+    def run(self, train_fn: Callable, config: Optional[Dict],
+            fn_blob: Optional[bytes] = None) -> None:
+        if fn_blob is None:
+            fn_blob = serialization.dumps_function(train_fn)
         ray_tpu.get([w.start.remote(fn_blob, config) for w in self.workers])
 
     def shutdown(self) -> None:
