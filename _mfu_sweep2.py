@@ -92,18 +92,18 @@ d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
                           n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
 fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
 CONFIGS = [
-    ("d1152 fused names s1024 b16",
-     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True, remat_policy="names"), 16, 1024, 1),
-    ("d1152 fused names s1024 b24",
-     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True, remat_policy="names"), 24, 1024, 1),
-    ("d1152 fused norem s1024 b8",
-     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True, remat=False), 8, 1024, 1),
-    ("d1152 fused flash s1024 b44",
-     fl(dataclasses.replace(d1152, max_seq_len=1024), loss_chunk=512,
-        fused_qkv=True, fused_mlp=True), 44, 1024, 1),
+    ("d1152 embmm ce1024 b24 (repeat)",
+     fl(d1152, loss_chunk=1024, fused_qkv=True, fused_mlp=True,
+        embed_via_matmul=True), 24, 2048, 1),
+    ("d1152 embmm ce1024 b26",
+     fl(d1152, loss_chunk=1024, fused_qkv=True, fused_mlp=True,
+        embed_via_matmul=True), 26, 2048, 1),
+    ("d1152 embmm ce1024 b22",
+     fl(d1152, loss_chunk=1024, fused_qkv=True, fused_mlp=True,
+        embed_via_matmul=True), 22, 2048, 1),
+    ("d1152gqa3 embmm ce1024 b24",
+     fl(dataclasses.replace(d1152, n_kv_heads=3), loss_chunk=1024,
+        fused_qkv=True, fused_mlp=True, embed_via_matmul=True), 24, 2048, 1),
 ]
 
 if __name__ == "__main__":

@@ -69,6 +69,12 @@ class LlamaConfig:
     # (MaxText-style fused projections).
     fused_qkv: bool = False
     fused_mlp: bool = False
+    # Embedding lookup as chunked one-hot MATMULS instead of gather: the
+    # gather's backward is a scatter-add over the vocab table, which on a
+    # bandwidth-starved part costs ~18% of the whole train step; as matmuls
+    # both directions ride the MXU (one-hot chunks are rematerialized in
+    # the backward, never stored).
+    embed_via_matmul: bool = False
     # Mixture-of-Experts: replace the dense MLP with moe_experts experts
     # (top-k routing, expert-parallel over the mesh's ``expert`` axis).
     moe_experts: int = 0
@@ -274,11 +280,40 @@ def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
         (), jnp.float32)
 
 
+def _embed_matmul(table: jax.Array, tokens: jax.Array,
+                  chunk: int = 512) -> jax.Array:
+    """Embedding gather expressed as chunked one-hot matmuls (see
+    ``embed_via_matmul``). Each chunk's one-hot is built, multiplied, and
+    (via checkpoint) rebuilt in the backward — the vocab-table gradient
+    becomes ``one_hot^T @ dy`` matmuls instead of a scatter-add."""
+    b, s = tokens.shape
+    v, e = table.shape
+    flat = tokens.reshape(-1)
+    n = flat.shape[0]
+    chunk = min(chunk, n)
+    if n % chunk:
+        chunk = n  # fall back to one chunk for odd sizes (tests)
+
+    @jax.checkpoint
+    def one_chunk(tok_c):
+        onehot = jax.nn.one_hot(tok_c, v, dtype=table.dtype)
+        return onehot @ table
+
+    def body(_, tok_c):
+        return None, one_chunk(tok_c)
+
+    _, out = jax.lax.scan(body, None, flat.reshape(n // chunk, chunk))
+    return out.reshape(b, s, e)
+
+
 def hidden_states(params: Dict[str, Any], tokens: jax.Array,
                   config: LlamaConfig) -> jax.Array:
     """Token ids (B, S) -> final-norm hidden states (B, S, E)."""
     c = config
-    x = params["tok_embed"].astype(c.dtype)[tokens]
+    if c.embed_via_matmul:
+        x = _embed_matmul(params["tok_embed"].astype(c.dtype), tokens)
+    else:
+        x = params["tok_embed"].astype(c.dtype)[tokens]
     x = constrain(x, ("batch", "length", "act_embed"))
     cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
 

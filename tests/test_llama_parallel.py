@@ -141,3 +141,24 @@ def test_mesh_spec_inference():
     assert spec.sizes(8) == (2, 4, 1, 1, 1)
     with pytest.raises(ValueError):
         MeshSpec(data=3).sizes(8)
+
+
+def test_embed_via_matmul_matches_gather():
+    import dataclasses
+
+    import numpy as np
+
+    cfg = llama.PRESETS["debug"]
+    cfg2 = dataclasses.replace(cfg, embed_via_matmul=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 33), 0, cfg.vocab_size)
+    l1 = float(llama.loss_fn(params, {"tokens": toks}, cfg))
+    l2 = float(llama.loss_fn(params, {"tokens": toks}, cfg2))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    g1 = jax.grad(lambda p: llama.loss_fn(p, {"tokens": toks}, cfg))(params)
+    g2 = jax.grad(lambda p: llama.loss_fn(p, {"tokens": toks}, cfg2))(params)
+    # bf16 matmul accumulation vs gather: one-ulp-level differences are
+    # expected on a handful of elements.
+    np.testing.assert_allclose(np.asarray(g1["tok_embed"]),
+                               np.asarray(g2["tok_embed"]),
+                               rtol=5e-2, atol=5e-4)
