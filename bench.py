@@ -43,7 +43,7 @@ def candidate_configs(env_preset=None):
         vocab_size=32000, dim=1152, n_layers=24, n_heads=9, n_kv_heads=9,
         mlp_dim=4608, max_seq_len=2048, attention_impl="flash",
         loss_chunk=1024, fused_qkv=True, fused_mlp=True,
-        embed_via_matmul=True)
+        embed_via_matmul=True, embed_chunk=1024)
     return [
         ("bench583m_s2048_b24", d1152, 24, 2048),
         ("bench583m_s1024_b48",

