@@ -95,15 +95,10 @@ d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
                           n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
 fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
 CONFIGS = [
-    ("b24 embmm1024 ce1024",
-     fl(d1152, loss_chunk=1024, fused_qkv=True, fused_mlp=True,
-        embed_via_matmul=True, embed_chunk=1024), 24, 2048, 1),
-    ("b24 embmm2048 ce1024",
-     fl(d1152, loss_chunk=1024, fused_qkv=True, fused_mlp=True,
-        embed_via_matmul=True, embed_chunk=2048), 24, 2048, 1),
-    ("b24 embmm4096 ce1024",
-     fl(d1152, loss_chunk=1024, fused_qkv=True, fused_mlp=True,
-        embed_via_matmul=True, embed_chunk=4096), 24, 2048, 1),
+    ("b3x8 accum (repeat)", fl(d1152, loss_chunk=1024, fused_qkv=True,
+        fused_mlp=True, embed_via_matmul=True, embed_chunk=1024), 24, 2048, 8),
+    ("b2x12 accum (retry)", fl(d1152, loss_chunk=1024, fused_qkv=True,
+        fused_mlp=True, embed_via_matmul=True, embed_chunk=1024), 24, 2048, 12),
 ]
 
 if __name__ == "__main__":

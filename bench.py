@@ -34,33 +34,36 @@ import jax.numpy as jnp
 
 
 def candidate_configs(env_preset=None):
+    """(name, config, total_batch, seq, accum_steps) ladder."""
     from ray_tpu.models import llama
 
     if env_preset:
         cfg = llama.PRESETS[env_preset]
-        return [(env_preset, cfg, 8, min(2048, cfg.max_seq_len))]
+        return [(env_preset, cfg, 8, min(2048, cfg.max_seq_len), 1)]
     d1152 = llama.LlamaConfig(
         vocab_size=32000, dim=1152, n_layers=24, n_heads=9, n_kv_heads=9,
         mlp_dim=4608, max_seq_len=2048, attention_impl="flash",
         loss_chunk=1024, fused_qkv=True, fused_mlp=True,
         embed_via_matmul=True, embed_chunk=1024)
     return [
-        ("bench583m_s2048_b24", d1152, 24, 2048),
+        ("bench583m_s2048_b3x8", d1152, 24, 2048, 8),
+        ("bench583m_s2048_b6x4", d1152, 24, 2048, 4),
+        ("bench583m_s2048_b24", d1152, 24, 2048, 1),
         ("bench583m_s1024_b48",
          dataclasses.replace(d1152, max_seq_len=1024, loss_chunk=512),
-         48, 1024),
+         48, 1024, 1),
         ("bench583m_s2048_b16",
-         dataclasses.replace(d1152, loss_chunk=512), 16, 2048),
+         dataclasses.replace(d1152, loss_chunk=512), 16, 2048, 1),
         ("bench583m_xla_b8",
          dataclasses.replace(d1152, attention_impl="xla", fused_qkv=False,
                              fused_mlp=False, embed_via_matmul=False,
-                             loss_chunk=512), 8, 2048),
+                             loss_chunk=512), 8, 2048, 1),
         ("bench160m_b8", dataclasses.replace(
-            llama.PRESETS["160m"], loss_chunk=512), 8, 2048),
+            llama.PRESETS["160m"], loss_chunk=512), 8, 2048, 1),
     ]
 
 
-def run_one(cfg, batch: int, seq: int, steps: int):
+def run_one(cfg, batch: int, seq: int, steps: int, accum: int = 1):
     import optax
 
     from ray_tpu.models import llama
@@ -77,10 +80,34 @@ def run_one(cfg, batch: int, seq: int, steps: int):
     opt_state = ts.init_optimizer_state(opt, params)
 
     def body(carry, tokens):
+        # One optimizer step; with accum > 1 the framework's accumulation
+        # path (hoisted bf16 cast + fp32 grad scan) amortizes the
+        # bandwidth-bound optimizer/cast over accum microbatches
+        # (ray_tpu.parallel.train_step.build_train_step semantics).
         p, o = carry
         with axis_rules(mesh):
-            loss, grads = jax.value_and_grad(
-                lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg))(p)
+            if accum == 1:
+                loss, grads = jax.value_and_grad(
+                    lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg))(p)
+            else:
+                pbf = jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if x.dtype == jnp.float32 else x, p)
+
+                def micro(g_acc, mtoks):
+                    loss, g = jax.value_and_grad(
+                        lambda pp: llama.loss_fn(
+                            pp, {"tokens": mtoks}, cfg))(pbf)
+                    return jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), g_acc, g), loss
+
+                g0 = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                                  p)
+                mb = tokens.reshape(accum, tokens.shape[0] // accum,
+                                    tokens.shape[1])
+                grads, losses = jax.lax.scan(micro, g0, mb)
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss = losses.mean()
             updates, o2 = opt.update(grads, o, p)
             p2 = optax.apply_updates(p, updates)
         return (p2, o2), loss
@@ -119,16 +146,19 @@ def main() -> None:
     env_batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "0"))
 
     last_err = None
-    for name, cfg, batch, seq in candidate_configs(env_preset):
+    for name, cfg, batch, seq, accum in candidate_configs(env_preset):
         batch = env_batch or batch
         for attempt in range(2):
             try:
-                dt, loss = run_one(cfg, batch, seq, steps)
+                dt, loss = run_one(cfg, batch, seq, steps, accum)
                 last_err = None
                 break
             except Exception as e:  # noqa: BLE001
                 last_err = e
-                if "remote_compile" not in str(e):
+                transient = ("remote_compile" in str(e)
+                             or "worker process crashed" in str(e)
+                             or "UNAVAILABLE" in str(e))
+                if not transient:
                     break  # OOM etc: step down the ladder, don't retry
         if last_err is None:
             break

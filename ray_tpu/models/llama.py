@@ -293,7 +293,11 @@ def _embed_matmul(table: jax.Array, tokens: jax.Array,
     n = flat.shape[0]
     chunk = min(chunk, n)
     if n % chunk:
-        chunk = n  # fall back to one chunk for odd sizes (tests)
+        # Largest divisor of n that fits the requested chunk: keeps the
+        # one-hot buffer bounded for ANY (B, S) instead of silently
+        # collapsing to a single n-sized chunk (a 3 GB one-hot at bench
+        # scales).
+        chunk = next(c for c in range(chunk, 0, -1) if n % c == 0)
 
     @jax.checkpoint
     def one_chunk(tok_c):

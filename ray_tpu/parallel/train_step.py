@@ -57,13 +57,43 @@ def build_train_step(
     mesh: Mesh,
     rules: Optional[Rules] = None,
     extra_metrics: Optional[Callable] = None,
+    accum_steps: int = 1,
 ):
     """Returns ``step(params, opt_state, batch) -> (params, opt_state,
-    metrics)``, jitted with donated state."""
+    metrics)``, jitted with donated state.
+
+    ``accum_steps > 1`` splits the batch's leading axis into that many
+    microbatches and accumulates fp32 gradients over a ``lax.scan`` before
+    ONE optimizer update. The fp32->bf16 parameter cast is hoisted out of
+    the microbatch loop, so both the cast and the (bandwidth-bound on TPU)
+    optimizer pass amortize over ``accum_steps`` times more tokens — worth
+    several MFU points on memory-limited parts (see BENCH_NOTES.md)."""
+
+    def _grads_accum(params, batch):
+        pbf = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+
+        def micro(g_acc, mb):
+            loss, g = jax.value_and_grad(loss_fn)(pbf, mb)
+            g_acc = jax.tree.map(lambda a, b: a + b.astype(a.dtype),
+                                 g_acc, g)
+            return g_acc, loss
+
+        mbs = jax.tree.map(
+            lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps)
+                                + x.shape[1:]), batch)
+        g0 = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        grads, losses = jax.lax.scan(micro, g0, mbs)
+        grads = jax.tree.map(lambda g: g / accum_steps, grads)
+        return losses.mean(), grads
 
     def step(params, opt_state, batch):
         with axis_rules(mesh, rules):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if accum_steps > 1:
+                loss, grads = _grads_accum(params, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             metrics = {"loss": loss,

@@ -162,3 +162,32 @@ def test_embed_via_matmul_matches_gather():
     np.testing.assert_allclose(np.asarray(g1["tok_embed"]),
                                np.asarray(g2["tok_embed"]),
                                rtol=5e-2, atol=5e-4)
+
+
+def test_train_step_gradient_accumulation():
+    import dataclasses
+
+    import numpy as np
+    import optax
+
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    cfg = llama.PRESETS["debug"]
+    mesh = MeshSpec(data=2, fsdp=-1).build()
+    params = ts.init_sharded_params(
+        lambda k: llama.init_params(cfg, k), llama.param_axes(cfg), mesh,
+        jax.random.key(0))
+    opt = optax.adamw(1e-3)
+    opt_state = ts.init_optimizer_state(opt, params)
+    step = ts.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt,
+                               mesh, accum_steps=4)
+    batch = ts.shard_batch(
+        {"tokens": jax.random.randint(jax.random.key(1), (8, 65), 0,
+                                      cfg.vocab_size)}, mesh)
+    losses = []
+    for _ in range(4):
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses  # accumulated grads still learn
