@@ -1,0 +1,140 @@
+"""Minimal cluster dashboard: HTTP JSON API + one-page HTML view.
+
+Analogue of the reference's dashboard head (``dashboard/head.py:81``)
+reduced to the load-bearing surface: live nodes/actors/jobs/deployments
+over a JSON API (the same controller RPCs the state CLI uses), a
+Prometheus metrics endpoint, and a self-refreshing HTML overview — no
+frontend build, one stdlib process.
+
+    python -m ray_tpu.dashboard [--address host:port] [--port 8265]
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body{font-family:monospace;margin:2em;background:#fafafa}
+ table{border-collapse:collapse;margin:1em 0}
+ td,th{border:1px solid #ccc;padding:4px 10px;text-align:left}
+ th{background:#eee} h2{margin-top:1.5em}
+</style></head><body>
+<h1>ray_tpu cluster</h1><div id="content">%s</div>
+<p><a href="/api/nodes">/api/nodes</a> <a href="/api/actors">/api/actors</a>
+<a href="/api/jobs">/api/jobs</a> <a href="/api/tasks">/api/tasks</a>
+<a href="/metrics">/metrics</a></p></body></html>"""
+
+
+def _table(rows, columns) -> str:
+    if not rows:
+        return "<p>(none)</p>"
+    head = "".join(f"<th>{c}</th>" for c in columns)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{r.get(c, '')}</td>" for c in columns)
+        + "</tr>" for r in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    client = None  # RpcClient to the controller (set by start())
+
+    def _send(self, payload: bytes, ctype: str = "application/json",
+              code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        try:
+            if self.path == "/api/nodes":
+                self._send(json.dumps(self.client.call("list_nodes")).encode())
+            elif self.path == "/api/actors":
+                actors = self.client.call("list_actors")
+                for a in actors:
+                    a["actor_id"] = a["actor_id"].hex()
+                    a["node_id"] = (a["node_id"].hex()
+                                    if a.get("node_id") else None)
+                    a.pop("addr", None)
+                self._send(json.dumps(actors).encode())
+            elif self.path == "/api/jobs":
+                self._send(json.dumps(self.client.call("list_jobs")).encode())
+            elif self.path == "/api/tasks":
+                self._send(json.dumps(
+                    self.client.call("list_task_events", 500)).encode())
+            elif self.path == "/metrics":
+                self._send(self.client.call("metrics_text").encode(),
+                           "text/plain")
+            elif self.path in ("/", "/index.html"):
+                self._send(self._render().encode(), "text/html")
+            else:
+                self._send(b'{"error": "not found"}', code=404)
+        except Exception as e:  # noqa: BLE001
+            self._send(json.dumps({"error": str(e)}).encode(), code=500)
+
+    def _render(self) -> str:
+        nodes = self.client.call("list_nodes")
+        for n in nodes:
+            n["addr"] = f"{n['addr'][0]}:{n['addr'][1]}"
+            n["node_id"] = n["node_id"][:16]
+        actors = self.client.call("list_actors")
+        arows = [{"actor_id": a["actor_id"].hex()[:16],
+                  "class": a["info"].get("class_name", ""),
+                  "name": a["info"].get("name") or "",
+                  "state": a["state"],
+                  "restarts": a["num_restarts"]} for a in actors]
+        jobs = [{"job_id": j, **info}
+                for j, info in self.client.call("list_jobs").items()]
+        html = ("<h2>nodes</h2>"
+                + _table(nodes, ["node_id", "addr", "alive", "resources",
+                                 "available", "queue_len"])
+                + "<h2>actors</h2>"
+                + _table(arows, ["actor_id", "class", "name", "state",
+                                 "restarts"])
+                + "<h2>jobs</h2>" + _table(jobs, ["job_id", "state"]))
+        return _PAGE % html
+
+    def log_message(self, *args):  # silence
+        pass
+
+
+def start(controller_addr: Tuple[str, int], host: str = "127.0.0.1",
+          port: int = 0) -> Tuple[ThreadingHTTPServer, Tuple[str, int]]:
+    """Start the dashboard server (non-blocking); returns (server, addr)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    handler = type("BoundHandler", (_Handler,),
+                   {"client": RpcClient(tuple(controller_addr))})
+    server = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=server.serve_forever, name="dashboard",
+                     daemon=True).start()
+    return server, server.server_address
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ray_tpu.scripts import resolve_address
+
+    parser = argparse.ArgumentParser(prog="ray_tpu.dashboard")
+    parser.add_argument("--address", default=None)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8265)
+    args = parser.parse_args(argv)
+    _server, addr = start(resolve_address(args.address), args.host,
+                          args.port)
+    print(f"dashboard at http://{addr[0]}:{addr[1]}/")
+    threading.Event().wait()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

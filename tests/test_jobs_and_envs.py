@@ -127,3 +127,36 @@ def test_state_cli(ray_start_regular, tmp_path, capsys):
     scripts.main(["--address", addr, "timeline", "-o", str(tl)])
     trace = json.loads(tl.read_text())
     assert isinstance(trace, list)
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    core = ray_start_regular
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return "pong"
+
+    a = Probe.options(name="dash_probe").remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+
+    server, (host, port) = dashboard.start(core.controller_addr)
+    try:
+        base = f"http://{host}:{port}"
+        nodes = json.loads(urllib.request.urlopen(
+            f"{base}/api/nodes", timeout=10).read())
+        assert any(n["alive"] for n in nodes)
+        actors = json.loads(urllib.request.urlopen(
+            f"{base}/api/actors", timeout=10).read())
+        assert any(x["info"].get("name") == "dash_probe" for x in actors)
+        html = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert "dash_probe" in html and "nodes" in html
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10).read().decode()
+        assert isinstance(metrics, str)
+    finally:
+        server.shutdown()
