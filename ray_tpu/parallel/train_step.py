@@ -67,7 +67,11 @@ def build_train_step(
     ONE optimizer update. The fp32->bf16 parameter cast is hoisted out of
     the microbatch loop, so both the cast and the (bandwidth-bound on TPU)
     optimizer pass amortize over ``accum_steps`` times more tokens — worth
-    several MFU points on memory-limited parts (see BENCH_NOTES.md)."""
+    several MFU points on memory-limited parts (see BENCH_NOTES.md).
+
+    On a multi-chip mesh keep ``batch_size / accum_steps`` a multiple of
+    the batch-sharding mesh extent (data x fsdp), or XLA resorts to
+    replicate-then-reshard on every microbatch slice."""
 
     def _grads_accum(params, batch):
         pbf = jax.tree.map(
