@@ -95,10 +95,14 @@ d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
                           n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
 fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
 CONFIGS = [
-    ("b3x8 accum (repeat)", fl(d1152, loss_chunk=1024, fused_qkv=True,
+    ("d1280 b3x8 accum", fl(d1280, loss_chunk=1024, fused_qkv=True,
         fused_mlp=True, embed_via_matmul=True, embed_chunk=1024), 24, 2048, 8),
-    ("b2x12 accum (retry)", fl(d1152, loss_chunk=1024, fused_qkv=True,
-        fused_mlp=True, embed_via_matmul=True, embed_chunk=1024), 24, 2048, 12),
+    ("d1408 b2x8 accum",
+     fl(llama.LlamaConfig(vocab_size=32000, dim=1408, n_layers=24,
+                          n_heads=11, n_kv_heads=11, mlp_dim=5632,
+                          max_seq_len=2048),
+        loss_chunk=1024, fused_qkv=True, fused_mlp=True,
+        embed_via_matmul=True, embed_chunk=1024), 16, 2048, 8),
 ]
 
 if __name__ == "__main__":

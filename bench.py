@@ -45,7 +45,10 @@ def candidate_configs(env_preset=None):
         mlp_dim=4608, max_seq_len=2048, attention_impl="flash",
         loss_chunk=1024, fused_qkv=True, fused_mlp=True,
         embed_via_matmul=True, embed_chunk=1024)
+    d1280 = dataclasses.replace(d1152, dim=1280, n_heads=10, n_kv_heads=10,
+                                mlp_dim=5120)
     return [
+        ("bench711m_s2048_b3x8", d1280, 24, 2048, 8),
         ("bench583m_s2048_b3x8", d1152, 24, 2048, 8),
         ("bench583m_s2048_b6x4", d1152, 24, 2048, 4),
         ("bench583m_s2048_b24", d1152, 24, 2048, 1),
