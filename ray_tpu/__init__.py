@@ -31,6 +31,7 @@ from ray_tpu.core.errors import (  # noqa: F401
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     RayTpuError,
     TaskError,
     WorkerCrashedError,

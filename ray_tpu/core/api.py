@@ -31,6 +31,7 @@ from ray_tpu.core.runtime import (
 
 _local_cluster = None  # (controller, node) started by init()
 _config_snapshot = None  # config state to restore on shutdown
+_log_streamer = None  # driver-side worker-log echo (log_monitor.LogStreamer)
 
 
 def init(
@@ -84,6 +85,11 @@ def init(
     set_core_worker(core)
     core.controller.call("register_job", uuid.uuid4().hex[:8],
                          {"driver_pid": os.getpid()})
+    global _log_streamer
+    if config.log_to_driver:
+        from ray_tpu.core.log_monitor import LogStreamer
+
+        _log_streamer = LogStreamer(core.controller)
     atexit.register(shutdown)
     return core
 
@@ -107,9 +113,21 @@ def _autodetect_tpu(resources: Dict[str, float], labels: Dict[str, str]) -> None
 
 
 def shutdown() -> None:
-    global _local_cluster, _config_snapshot
+    global _local_cluster, _config_snapshot, _log_streamer
     if not is_initialized():
         return
+    if _log_streamer is not None:
+        # Final drain so prints from the last scan window reach the driver
+        # before the cluster goes away.
+        try:
+            if _local_cluster is not None and \
+                    _local_cluster[1].log_monitor is not None:
+                _local_cluster[1].log_monitor.scan_once()
+            _log_streamer.poll_once(timeout=0.2)
+        except Exception:
+            pass
+        _log_streamer.stop()
+        _log_streamer = None
     if _config_snapshot is not None:
         # _system_config overrides are scoped to the init()..shutdown() span;
         # restore so a later init() in the same process starts clean.

@@ -85,6 +85,31 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "site hooks keyed on these attach accelerators (and import jax) "
         "into every python process, a startup tax pure-CPU task workers "
         "skip. Leases holding a TPU resource keep them."),
+    "worker_log_dir": (str, f"/tmp/ray_tpu_logs_{os.getuid()}",
+        "Per-node worker stdout/stderr log files live under "
+        "<dir>/<node_hex>/ (reference: session_latest/logs); per-uid "
+        "default so multi-user hosts don't collide."),
+    "log_monitor_scan_s": (float, 0.5,
+        "Log monitor tail period (reference: log_monitor.py scan loop)."),
+    "log_rotation_max_bytes": (int, 64 * 1024 * 1024,
+        "A worker log file past this size is truncated after its tail is "
+        "consumed (reference: log_rotation_max_bytes)."),
+    "log_window_lines": (int, 500,
+        "Published log window size per node; drivers diff end counters so "
+        "bursts up to this size are never lost between polls."),
+    "memory_usage_threshold": (float, 0.95,
+        "Node memory fraction above which the memory monitor starts killing "
+        "workers (reference: memory_usage_threshold, ray_config_def.h:65)."),
+    "memory_monitor_refresh_s": (float, 1.0,
+        "Memory monitor check period; 0 disables the monitor (reference: "
+        "memory_monitor_refresh_ms)."),
+    "memory_kill_interval_s": (float, 2.0,
+        "Minimum spacing between memory-monitor worker kills (reference: "
+        "memory_monitor_min_wait_between_kills)."),
+    "worker_killing_policy": (str, "retriable_fifo",
+        "OOM victim selection: 'retriable_fifo' (newest retriable task "
+        "first) or 'group_by_owner' (largest owner's newest task first) "
+        "(reference: worker_killing_policy*.cc)."),
     "dead_actor_cache_count": (int, 1000,
         "Dead actor records (and their pubsub entries) retained for late "
         "callers before being reaped (reference: "

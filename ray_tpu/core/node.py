@@ -109,6 +109,9 @@ class WorkerHandle:
         # (on lease return, worker kill, or death-reap — whichever first).
         self.lease_resources: Optional[Dict[str, float]] = None
         self.lease_bundle = None
+        # Lease-time task metadata ({"retriable": bool, "owner": str}) used
+        # by the memory monitor's worker-killing policies.
+        self.task_meta: Optional[Dict[str, Any]] = None
 
 
 class Node:
@@ -147,6 +150,7 @@ class Node:
         self._idle: List[WorkerHandle] = []
         self._waiters: List[_LeaseWaiter] = []  # FIFO lease queue
         self._queue_len = 0
+        self._death_causes: Dict[bytes, str] = {}
         self._stopped = threading.Event()
 
         self._server = RpcServer(
@@ -161,6 +165,7 @@ class Node:
                 "read_shm_object": self.read_shm_object,
                 "read_shm_chunk": self.read_shm_chunk,
                 "free_shm_object": self.free_shm_object,
+                "worker_death_cause": self.worker_death_cause,
                 "get_info": self.get_info,
                 "ping": lambda: "pong",
             },
@@ -168,7 +173,8 @@ class Node:
             name="node",
             max_workers=128,
             inline_methods={"return_worker", "register_worker",
-                            "reserve_bundle", "release_bundle", "kill_worker"},
+                            "reserve_bundle", "release_bundle", "kill_worker",
+                            "worker_death_cause"},
         )
         self.address: Addr = self._server.addr
 
@@ -182,6 +188,16 @@ class Node:
         self._reaper_thread = threading.Thread(
             target=self._reaper_loop, name="node-reaper", daemon=True)
         self._reaper_thread.start()
+        self.memory_monitor = None
+        if config.memory_monitor_refresh_s > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(self)
+        self.log_monitor = None
+        if config.log_to_driver:
+            from ray_tpu.core.log_monitor import LogMonitor
+
+            self.log_monitor = LogMonitor(self)
 
     # ----------------------------------------------------------- leasing
 
@@ -198,6 +214,7 @@ class Node:
         timeout: Optional[float] = None,
         dedicated: bool = False,
         runtime_env: Optional[Dict[str, Any]] = None,
+        task_meta: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Block until resources are free, then hand out a pooled or freshly
         forked worker. Returns {worker_id, addr} or {error}. ``dedicated``
@@ -245,6 +262,8 @@ class Node:
         with self._lock:
             handle.lease_resources = dict(resources)
             handle.lease_bundle = bundle
+            handle.task_meta = dict(task_meta) if task_meta else None
+            handle.last_used = time.monotonic()
         return {"worker_id": handle.worker_id.binary(), "addr": handle.addr}
 
     def _credit(self, resources: Dict[str, float], bundle) -> None:
@@ -293,6 +312,7 @@ class Node:
             handle = self._workers.get(worker_id)
             if handle is not None:
                 self._credit_lease_locked(handle)
+                handle.task_meta = None
                 if dead or handle.proc.poll() is not None:
                     self._remove_worker_locked(handle)
                 elif not handle.dedicated:
@@ -355,17 +375,40 @@ class Node:
             env["PYTHONPATH"] = os.pathsep.join(
                 [workdir] + [p for p in env.get("PYTHONPATH", "").split(
                     os.pathsep) if p])
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main",
-             "--node-host", self.address[0],
-             "--node-port", str(self.address[1]),
-             "--controller-host", self.controller_addr[0],
-             "--controller-port", str(self.controller_addr[1]),
-             "--node-id", self.node_id.hex(),
-             "--worker-id", worker_id.hex()],
-            env=env,
-            cwd=workdir or None,
-        )
+        stdout = stderr = None
+        if config.log_to_driver:
+            # Unbuffered so task prints reach the log files (and thus the
+            # driver) promptly rather than on process exit.
+            env["PYTHONUNBUFFERED"] = "1"
+            # Redirect worker output to per-worker session log files; the
+            # log monitor tails them and streams lines to drivers
+            # (reference: default_worker.py stdout/stderr files under
+            # session_latest/logs + log_monitor.py).
+            from ray_tpu.core.log_monitor import worker_log_paths
+
+            out_path, err_path = worker_log_paths(self.node_id.hex(),
+                                                  worker_id.hex())
+            stdout = open(out_path, "ab", buffering=0)
+            stderr = open(err_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main",
+                 "--node-host", self.address[0],
+                 "--node-port", str(self.address[1]),
+                 "--controller-host", self.controller_addr[0],
+                 "--controller-port", str(self.controller_addr[1]),
+                 "--node-id", self.node_id.hex(),
+                 "--worker-id", worker_id.hex()],
+                env=env,
+                cwd=workdir or None,
+                stdout=stdout,
+                stderr=stderr,
+            )
+        finally:
+            # The child holds its own copies of the fds.
+            for f in (stdout, stderr):
+                if f is not None:
+                    f.close()
         handle = WorkerHandle(worker_id, proc)
         handle.dedicated = dedicated
         handle.tpu = needs_tpu
@@ -425,10 +468,15 @@ class Node:
         return self.lease_worker(resources, bundle=bundle, timeout=timeout,
                                  dedicated=True, runtime_env=runtime_env)
 
-    def kill_worker(self, worker_id_bytes: bytes, force: bool = True) -> None:
+    def kill_worker(self, worker_id_bytes: bytes, force: bool = True,
+                    reason: Optional[str] = None) -> None:
         worker_id = WorkerID(worker_id_bytes)
         with self._lock:
             handle = self._workers.get(worker_id)
+            if reason is not None:
+                self._death_causes[worker_id_bytes] = reason
+                while len(self._death_causes) > 256:
+                    self._death_causes.pop(next(iter(self._death_causes)))
         if handle is None:
             return
         _kill_and_reap(handle.proc, force)
@@ -436,6 +484,14 @@ class Node:
             self._credit_lease_locked(handle)
             self._remove_worker_locked(handle)
             self._drain_waiters_locked()
+
+    def worker_death_cause(self, worker_id_bytes: bytes) -> Optional[str]:
+        """Why a worker was killed by the node itself (e.g. the memory
+        monitor) — lets a task owner turn a generic worker-crash into
+        :class:`OutOfMemoryError` (reference: the raylet attaches a death
+        cause to disconnect replies)."""
+        with self._lock:
+            return self._death_causes.get(worker_id_bytes)
 
     def _remove_worker_locked(self, handle: WorkerHandle) -> None:
         self._workers.pop(handle.worker_id, None)
@@ -587,10 +643,16 @@ class Node:
                 "labels": dict(self.labels),
                 "num_workers": len(self._workers),
                 "num_idle": len(self._idle),
+                "num_oom_kills": (self.memory_monitor.total_kills
+                                  if self.memory_monitor else 0),
             }
 
     def stop(self) -> None:
         self._stopped.set()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
+        if self.log_monitor is not None:
+            self.log_monitor.stop()
         with self._lock:
             workers = list(self._workers.values())
         for handle in workers:
@@ -610,3 +672,5 @@ class Node:
         import shutil
 
         shutil.rmtree(spill_dir(self.node_id), ignore_errors=True)
+        shutil.rmtree(os.path.join(config.worker_log_dir,
+                                   self.node_id.hex()), ignore_errors=True)

@@ -39,6 +39,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.errors import (
     ActorDiedError,
     ObjectLostError,
+    OutOfMemoryError,
     RayTpuError,
     TaskError,
     WorkerCrashedError,
@@ -871,6 +872,9 @@ class TaskSubmitter:
                         "lease_worker", options.get("resources", {"CPU": 1.0}),
                         bundle, patience, False,
                         options.get("runtime_env"),
+                        {"retriable": retries_left > 0
+                            and options.get("retry_on_crash", True),
+                         "owner": core.node_id.hex()},
                         timeout=config.worker_lease_timeout_s + 10.0)
                 except (RpcError, RemoteCallError, TimeoutError) as e:
                     core.clients.invalidate(tuple(node_addr))
@@ -901,6 +905,18 @@ class TaskSubmitter:
                         time.sleep(config.task_retry_delay_ms / 1000.0)
                         deadline = time.monotonic() + config.worker_lease_timeout_s
                         continue
+                    # Terminal attempt: was this a node-initiated kill
+                    # (memory monitor)? Surface the recorded cause instead
+                    # of a generic crash.
+                    try:
+                        cause = node_client.call("worker_death_cause",
+                                                 worker_id, timeout=2.0)
+                    except Exception:
+                        cause = None
+                    if cause and "memory" in cause:
+                        raise OutOfMemoryError(
+                            f"task {spec['desc']} was killed by the node "
+                            f"memory monitor: {cause}") from e
                     raise WorkerCrashedError(
                         f"worker died executing {spec['desc']}: {e}") from e
                 node_client.call("return_worker", worker_id,
