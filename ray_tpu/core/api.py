@@ -16,7 +16,8 @@ import os
 import uuid
 from typing import Any, Dict, Optional, Sequence
 
-from ray_tpu.core.actor import ActorClass, get_actor  # noqa: F401
+from ray_tpu.core.actor import ActorClass
+from ray_tpu.core.actor import get_actor as _get_actor_direct
 from ray_tpu.core.config import config
 from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.ids import NodeID
@@ -35,15 +36,27 @@ _log_streamer = None  # driver-side worker-log echo (log_monitor.LogStreamer)
 
 
 def init(
-    address: Optional[tuple] = None,
+    address: Optional[Any] = None,
     num_cpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
     labels: Optional[Dict[str, str]] = None,
     _system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
 ):
-    """Start (or connect to) a cluster and attach this process as a driver."""
+    """Start (or connect to) a cluster and attach this process as a driver.
+
+    ``address="ray-tpu://host:port"`` instead connects as a THIN CLIENT to a
+    :class:`ray_tpu.client.ClientServer` running inside the cluster — this
+    process never joins the cluster and needs one outbound connection only
+    (reference: Ray Client, ``util/client/``)."""
     global _local_cluster
+    if isinstance(address, str) and address.startswith("ray-tpu://"):
+        from ray_tpu import client as client_mod
+
+        client = client_mod.connect(address,
+                                    ignore_reinit_error=ignore_reinit_error)
+        atexit.register(shutdown)
+        return client
     if is_initialized():
         if ignore_reinit_error:
             return get_core_worker()
@@ -114,6 +127,10 @@ def _autodetect_tpu(resources: Dict[str, float], labels: Dict[str, str]) -> None
 
 def shutdown() -> None:
     global _local_cluster, _config_snapshot, _log_streamer
+    client = _client()
+    if client is not None:
+        client.disconnect()
+        return
     if not is_initialized():
         return
     if _log_streamer is not None:
@@ -155,11 +172,24 @@ def shutdown() -> None:
     _actor._inflight.clear()
 
 
+def _client():
+    """Active thin-client connection, if this process is in client mode."""
+    from ray_tpu import client as client_mod
+
+    return client_mod.current_client()
+
+
 def remote(*args, **options):
     """``@remote`` decorator for functions and classes (reference:
     ``worker.py:3146``)."""
 
     def decorate(target):
+        if _client() is not None:
+            from ray_tpu import client as client_mod
+
+            if inspect.isclass(target):
+                return client_mod.ClientActorClass(target, options)
+            return client_mod.ClientRemoteFunction(target, options)
         if inspect.isclass(target):
             return ActorClass(target, options)
         return RemoteFunction(target, options)
@@ -172,24 +202,51 @@ def remote(*args, **options):
 
 
 def get(refs, timeout: Optional[float] = None):
+    client = _client()
+    if client is not None:
+        return client.get(refs, timeout)
     return get_core_worker().get(refs, timeout)
 
 
 def put(value: Any) -> ObjectRef:
+    client = _client()
+    if client is not None:
+        return client.put(value)
     return get_core_worker().put(value)
 
 
 def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
          timeout: Optional[float] = None):
+    client = _client()
+    if client is not None:
+        return client.wait(refs, num_returns, timeout)
     return get_core_worker().wait(refs, num_returns, timeout)
 
 
 def kill(actor_handle, no_restart: bool = True) -> None:
+    client = _client()
+    if client is not None:
+        from ray_tpu.client import ClientActorHandle
+
+        if isinstance(actor_handle, ClientActorHandle):
+            client.kill(actor_handle, no_restart=no_restart)
+            return
     actor_handle.kill(no_restart=no_restart)
 
 
 def cluster_resources() -> Dict[str, float]:
+    client = _client()
+    if client is not None:
+        return client.cluster_resources()
     return get_core_worker().controller.call("cluster_resources")
+
+
+def get_actor(name: str):
+    """Look up a named actor (reference: ``ray.get_actor``)."""
+    client = _client()
+    if client is not None:
+        return client.get_actor(name)
+    return _get_actor_direct(name)
 
 
 def nodes():

@@ -110,6 +110,11 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "OOM victim selection: 'retriable_fifo' (newest retriable task "
         "first) or 'group_by_owner' (largest owner's newest task first) "
         "(reference: worker_killing_policy*.cc)."),
+    "client_session_timeout_s": (float, 60.0,
+        "Thin-client sessions with no RPC (incl. keepalive pings) for this "
+        "long are reaped server-side — their refs released and unnamed "
+        "actors killed, as if the client driver exited (reference: Ray "
+        "Client proxied-driver lifetime)."),
     "dead_actor_cache_count": (int, 1000,
         "Dead actor records (and their pubsub entries) retained for late "
         "callers before being reaped (reference: "

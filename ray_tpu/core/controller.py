@@ -166,6 +166,7 @@ class Controller:
                 "psub_poll_many": self.pubsub.poll_many,
                 "psub_publish": self.pubsub.publish,
                 "psub_snapshot": self.pubsub.snapshot,
+                "psub_keys": self.pubsub.keys,
                 "ping": lambda: "pong",
             },
             name="controller",

@@ -43,6 +43,7 @@ class LogMonitor:
         self._offsets: Dict[str, int] = {}
         self._window: List[Tuple[str, str]] = []  # (tag, line)
         self._end = 0  # lines ever published
+        self._scan_lock = threading.Lock()  # scan_once callable off-thread
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="log-monitor", daemon=True)
@@ -59,7 +60,13 @@ class LogMonitor:
                 pass
 
     def scan_once(self) -> int:
-        """Read appended bytes from every log file; publish if new lines."""
+        """Read appended bytes from every log file; publish if new lines.
+        Serialized by a lock: the shutdown drain calls this concurrently
+        with the scan thread."""
+        with self._scan_lock:
+            return self._scan_once_locked()
+
+    def _scan_once_locked(self) -> int:
         new: List[Tuple[str, str]] = []
         try:
             names = sorted(os.listdir(self._dir))
@@ -123,6 +130,7 @@ class LogStreamer:
         self._controller = controller_client
         self._out = out  # defaults to sys.stdout at print time
         self._seen: Dict[str, int] = {}  # node hex -> last end counter
+        self._versions: Dict[str, int] = {}  # node hex -> pubsub version
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="log-streamer", daemon=True)
@@ -140,26 +148,36 @@ class LogStreamer:
                     return
 
     def poll_once(self, timeout: float = 5.0) -> int:
-        """One long-poll round; returns number of lines printed."""
-        snap = self._controller.call("psub_snapshot", LOG_CHANNEL)
-        # Known keys come from the snapshot itself; diff immediately, then
-        # long-poll for the next update on all of them.
+        """One long-poll round; returns number of lines printed. Key
+        discovery is version-only (psub_keys) — window payloads transfer
+        only for keys that actually advanced."""
+        keymap = self._controller.call("psub_keys", LOG_CHANNEL)
         printed = 0
-        for key, (version, value) in snap.items():
-            printed += self._emit(key, value)
-            self._seen.setdefault(key, 0)
-        watches = {key: (LOG_CHANNEL, key, version)
-                   for key, (version, _v) in snap.items()}
-        if not watches:
+        behind = {key: ver for key, ver in keymap.items()
+                  if ver > self._versions.get(key, 0)}
+        if behind:
+            # Fetch just the advanced keys (version-1 so poll returns the
+            # current value immediately).
+            updates = self._controller.call(
+                "psub_poll_many",
+                {k: (LOG_CHANNEL, k, v - 1) for k, v in behind.items()},
+                0.5, timeout=10.0)
+            for key, (version, value) in (updates or {}).items():
+                printed += self._emit(key, value)
+                self._versions[key] = version
+        if not keymap:
             # No node has published logs yet; re-check soon rather than
             # sleeping a full long-poll period (first-line latency).
             self._stopped.wait(min(timeout, 1.0))
             return printed
+        watches = {key: (LOG_CHANNEL, key, self._versions.get(key, 0))
+                   for key in keymap}
         updates = self._controller.call(
             "psub_poll_many", watches, timeout,
             timeout=timeout + 10.0)
-        for key, (_version, value) in (updates or {}).items():
+        for key, (version, value) in (updates or {}).items():
             printed += self._emit(key, value)
+            self._versions[key] = version
         return printed
 
     def _emit(self, node_hex: str, value: dict) -> int:

@@ -376,21 +376,21 @@ class Node:
                 [workdir] + [p for p in env.get("PYTHONPATH", "").split(
                     os.pathsep) if p])
         stdout = stderr = None
-        if config.log_to_driver:
-            # Unbuffered so task prints reach the log files (and thus the
-            # driver) promptly rather than on process exit.
-            env["PYTHONUNBUFFERED"] = "1"
-            # Redirect worker output to per-worker session log files; the
-            # log monitor tails them and streams lines to drivers
-            # (reference: default_worker.py stdout/stderr files under
-            # session_latest/logs + log_monitor.py).
-            from ray_tpu.core.log_monitor import worker_log_paths
-
-            out_path, err_path = worker_log_paths(self.node_id.hex(),
-                                                  worker_id.hex())
-            stdout = open(out_path, "ab", buffering=0)
-            stderr = open(err_path, "ab", buffering=0)
         try:
+            if config.log_to_driver:
+                # Unbuffered so task prints reach the log files (and thus
+                # the driver) promptly rather than on process exit.
+                env["PYTHONUNBUFFERED"] = "1"
+                # Redirect worker output to per-worker session log files;
+                # the log monitor tails them and streams lines to drivers
+                # (reference: default_worker.py stdout/stderr files under
+                # session_latest/logs + log_monitor.py).
+                from ray_tpu.core.log_monitor import worker_log_paths
+
+                out_path, err_path = worker_log_paths(self.node_id.hex(),
+                                                      worker_id.hex())
+                stdout = open(out_path, "ab", buffering=0)
+                stderr = open(err_path, "ab", buffering=0)
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker_main",
                  "--node-host", self.address[0],

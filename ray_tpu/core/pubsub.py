@@ -80,6 +80,13 @@ class Pubsub:
             return {k: v for (ch, k), v in self._state.items()
                     if ch == channel}
 
+    def keys(self, channel: str) -> Dict[str, int]:
+        """Key -> current version for a channel, without the values (cheap
+        discovery for subscribers that fetch lazily, e.g. log streaming)."""
+        with self._cond:
+            return {k: v[0] for (ch, k), v in self._state.items()
+                    if ch == channel}
+
 
 class Subscriber:
     """Client-side helper: blocking waits and background watch threads over a

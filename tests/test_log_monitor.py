@@ -48,6 +48,7 @@ def test_task_print_streams_to_driver(ray_start_regular):
     streamer._controller = core.controller
     streamer._out = buf
     streamer._seen = {}
+    streamer._versions = {}
     import threading
 
     streamer._stopped = threading.Event()
@@ -78,6 +79,7 @@ def test_streamer_diffs_no_duplicates(ray_start_regular):
     streamer._controller = core.controller
     streamer._out = buf
     streamer._seen = {}
+    streamer._versions = {}
     streamer._stopped = threading.Event()
     deadline = time.monotonic() + 30
     while "line-1" not in buf.getvalue() and time.monotonic() < deadline:

@@ -68,6 +68,7 @@ def test_oom_kill_retries_then_raises(ray_start_regular):
 
     node = api_mod._local_cluster[1]
     assert node.memory_monitor is not None
+    node.memory_monitor.stop()  # drive check_once manually, race-free
 
     @ray_tpu.remote(max_retries=0)
     def hog():
@@ -101,6 +102,7 @@ def test_oom_killed_retriable_task_succeeds_on_retry(ray_start_regular):
     from ray_tpu.core import api as api_mod
 
     node = api_mod._local_cluster[1]
+    node.memory_monitor.stop()  # drive check_once manually, race-free
 
     @ray_tpu.remote(max_retries=2)
     def quick(x):
