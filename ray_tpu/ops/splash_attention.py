@@ -1,0 +1,47 @@
+"""Splash attention: block-sparse flash attention (TPU Pallas).
+
+SURVEY §5.7 calls for splash-style sparse attention kernels as first-class
+citizens of the TPU build. "Splash" = SParse fLASH: the same fused
+online-softmax kernel as :mod:`ray_tpu.ops.flash_attention`, but with a
+sparsity structure that *skips whole tiles*:
+
+* ``causal`` — lower-triangular band; upper tiles never compute.
+* ``window`` — sliding-window/local attention; tiles outside the last
+  ``window`` positions per query are skipped, so cost is O(S * window)
+  rather than O(S^2). This is the long-context workhorse (Mistral-style
+  local layers, chunked prefill).
+* ``segment_ids`` — packed-sequence masking: queries only attend within
+  their own segment (data-dependent, masked in-register).
+
+All three compose, and the fused backward applies the identical structure,
+so the speedup carries to training. Implemented on the shared kernel in
+``flash_attention.py`` (tile-skip arithmetic: ``_tile_live``); this module
+is the named public surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+def splash_attention(
+    q: jax.Array,                # (B, S, Hq, D)
+    k: jax.Array,                # (B, S, Hkv, D)
+    v: jax.Array,                # (B, S, Hkv, D)
+    causal: bool = True,
+    window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Block-sparse attention; see module docstring for the mask algebra."""
+    return flash_attention(
+        q, k, v, causal=causal, window=window, segment_ids=segment_ids,
+        kv_segment_ids=kv_segment_ids, block_q=block_q, block_k=block_k,
+        scale=scale)

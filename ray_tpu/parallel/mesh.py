@@ -34,15 +34,33 @@ AXES = ("data", "fsdp", "tensor", "seq", "expert")
 @dataclass(frozen=True)
 class MeshSpec:
     """A named parallelism layout. Sizes must multiply to the device count
-    (a -1 entry is inferred, like a reshape)."""
+    (a -1 entry is inferred, like a reshape).
+
+    ``dcn_data > 1`` declares a MULTI-SLICE layout: that many data-parallel
+    replicas across pod slices connected by DCN (the standard multislice
+    recipe — gradient all-reduce is the only cross-slice collective, so it
+    alone rides the slow network while fsdp/tensor/seq/expert stay on
+    intra-slice ICI). The DCN factor folds into the ``data`` mesh axis, so
+    sharding rules are unchanged: ``batch`` over ("data", "fsdp") is
+    automatically slice-count x per-slice-data parallel."""
 
     data: int = 1
     fsdp: int = -1   # default: soak up remaining devices as sharded-DP
     tensor: int = 1
     seq: int = 1
     expert: int = 1
+    dcn_data: int = 1  # data-parallel replicas across slices (over DCN)
 
     def sizes(self, n_devices: int) -> Tuple[int, ...]:
+        """Final per-axis sizes (dcn folded into data)."""
+        if n_devices % self.dcn_data:
+            raise ValueError(
+                f"{n_devices} devices not divisible across "
+                f"{self.dcn_data} slices")
+        per_slice = self._ici_sizes(n_devices // self.dcn_data)
+        return (per_slice[0] * self.dcn_data,) + per_slice[1:]
+
+    def _ici_sizes(self, n_devices: int) -> Tuple[int, ...]:
         sizes = [self.data, self.fsdp, self.tensor, self.seq, self.expert]
         if sizes.count(-1) > 1:
             raise ValueError("at most one mesh axis may be -1")
@@ -62,12 +80,37 @@ class MeshSpec:
         """Build the mesh over ``devices`` (default: all addressable).
 
         Device order: ``jax.experimental.mesh_utils`` places neighbors on ICI
-        where possible; we fall back to a plain reshape on CPU/virtual
-        devices (tests use an 8-device virtual CPU mesh)."""
+        where possible; multi-slice layouts use
+        ``create_hybrid_device_mesh`` so the dcn factor maps to the
+        slice boundary (slowest varying). We fall back to a plain reshape on
+        CPU/virtual devices (tests use an 8-device virtual CPU mesh, where
+        the fallback emulates the slice split)."""
         if devices is None:
             devices = jax.devices()
         devices = np.asarray(devices)
         sizes = self.sizes(devices.size)
+        if self.dcn_data > 1:
+            ici = self._ici_sizes(devices.size // self.dcn_data)
+            dcn = (self.dcn_data, 1, 1, 1, 1)
+            on_tpu = any(getattr(d, "platform", "") == "tpu"
+                         for d in devices.flat)
+            try:
+                from jax.experimental import mesh_utils
+
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    ici, dcn, devices=list(devices.flat))
+            except Exception:
+                if on_tpu:
+                    # On real hardware a hybrid-mesh failure means the spec
+                    # does not match the slice topology; a silent reshape
+                    # would put fsdp/tensor collectives on DCN.
+                    raise
+                # Virtual/CPU devices carry no slice topology: emulate the
+                # slice split with dcn as the slowest-varying factor.
+                dev_array = devices.reshape((self.dcn_data,) + ici).reshape(
+                    sizes)
+            dev_array = dev_array.reshape(sizes)
+            return Mesh(dev_array, AXES)
         try:
             from jax.experimental import mesh_utils
 

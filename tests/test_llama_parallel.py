@@ -191,3 +191,36 @@ def test_train_step_gradient_accumulation():
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses  # accumulated grads still learn
+
+
+def test_multislice_dcn_mesh_loss_matches():
+    """MeshSpec(dcn_data=2): multi-slice layout (data replicas across
+    slices over DCN, FSDP/TP inside each slice). On the virtual CPU mesh
+    the slice split is emulated; loss must match the single-device value."""
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    spec = MeshSpec(dcn_data=2, tensor=2, fsdp=-1)
+    assert spec.sizes(8) == (2, 2, 2, 1, 1)  # dcn folded into data axis
+    mesh = spec.build()
+    assert mesh.shape["data"] == 2 and mesh.shape["fsdp"] == 2
+
+    cfg = llama.PRESETS["debug"]
+    params = ts.init_sharded_params(
+        lambda k: llama.init_params(cfg, k), llama.param_axes(), mesh,
+        jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (8, 33), 0, cfg.vocab_size)
+    batch = ts.shard_batch({"tokens": toks}, mesh)
+
+    import optax
+
+    opt = optax.adamw(1e-3)
+    opt_state = ts.init_optimizer_state(opt, params)
+    step_fn = ts.build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh)
+    _, _, metrics = step_fn(params, opt_state, batch)
+    sharded_loss = float(metrics["loss"])
+
+    dense_params = llama.init_params(cfg, jax.random.key(0))
+    dense_loss = float(llama.loss_fn(dense_params, {"tokens": toks}, cfg))
+    np.testing.assert_allclose(sharded_loss, dense_loss, rtol=2e-4)
