@@ -1,6 +1,9 @@
 """ray_tpu.rl: reinforcement learning at scale (reference: RLlib)."""
 
+from ray_tpu.rl.bc import BC, BCConfig, collect_dataset  # noqa: F401
+from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
+from ray_tpu.rl.replay import ReplayBuffer, SumTree  # noqa: F401
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig, vtrace  # noqa: F401
 from ray_tpu.rl.models import (  # noqa: F401
     build_policy,

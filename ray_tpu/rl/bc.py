@@ -1,0 +1,177 @@
+"""Offline RL: behavior cloning from logged experience.
+
+Analogue of the reference's offline-data algorithms (``rllib/algorithms/
+bc/bc.py`` + ``rllib/offline/``: train from logged episodes via ray.data,
+no environment interaction). Experience lives in a
+:class:`ray_tpu.data.Dataset` (however produced — ``collect_dataset``
+records it from a trained policy's runners, or read_parquet loads logged
+data); the learner does cross-entropy on (obs, action) with the same
+policy network the online algorithms use, so a cloned policy can be
+handed straight back to EnvRunners for evaluation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.common import ConfigBuilderMixin, probe_env_spec
+from ray_tpu.rl.models import build_policy
+
+
+def collect_dataset(algo, num_rollouts: int = 4):
+    """Record rollouts from a (trained) algorithm's runners into a Dataset
+    of (obs, action) rows — the shape offline pipelines consume
+    (reference: ``rllib/offline/output writers``)."""
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    obs_all, act_all = [], []
+    for _ in range(num_rollouts):
+        for ro in ray_tpu.get([r.sample.remote() for r in algo.runners]):
+            keep = ro["valids"].reshape(-1) > 0.5
+            obs = ro["obs"].reshape((-1,) + ro["obs"].shape[2:])[keep]
+            act = ro["actions"].reshape(-1)[keep]
+            obs_all.append(obs)
+            act_all.append(act)
+    return rdata.from_numpy({
+        "obs": np.concatenate(obs_all),
+        "actions": np.concatenate(act_all).astype(np.int64),
+    })
+
+
+@dataclass
+class BCConfig(ConfigBuilderMixin):
+    env: str = "CartPole-v1"            # for obs/action spec + evaluation
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    frame_stack: int = 1
+    lr: float = 1e-3
+    epochs: int = 4
+    batch_size: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self, dataset=None) -> "BC":
+        return BC(self, dataset)
+
+    def training(self, **kwargs) -> "BCConfig":
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        return self
+
+
+class BC:
+    """Behavior cloning learner over a Dataset of {"obs", "actions"}."""
+
+    def __init__(self, config: BCConfig, dataset=None):
+        import jax
+        import optax
+
+        self.config = config
+        self.dataset = dataset
+        self._iteration = 0
+
+        obs_shape, num_actions = probe_env_spec(
+            config.env, config.env_config, config.frame_stack)
+        init_fn, self._forward = build_policy(obs_shape, num_actions,
+                                              config.hidden)
+        self.params = init_fn(jax.random.key(config.seed))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        forward = self._forward
+
+        def loss_fn(params, batch):
+            logits, _ = forward(params, batch["obs"])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["actions"]).astype(
+                    jnp.float32))
+            return jnp.mean(nll), acc
+
+        def update(params, opt_state, batch):
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, acc
+
+        return update
+
+    def train(self, dataset=None) -> Dict[str, Any]:
+        """One pass of ``epochs`` over the dataset via streamed batches."""
+        ds = dataset or self.dataset
+        if ds is None:
+            raise ValueError("BC needs a dataset (BCConfig.build(dataset))")
+        losses, accs, rows = [], [], 0
+        for _ in range(self.config.epochs):
+            for batch in ds.iter_batches(batch_size=self.config.batch_size):
+                if len(batch["actions"]) < 2:
+                    continue
+                self.params, self.opt_state, loss, acc = self._update(
+                    self.params, self.opt_state, batch)
+                losses.append(float(loss))
+                accs.append(float(acc))
+                rows += len(batch["actions"])
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "rows_trained": rows,
+            "loss": float(np.mean(losses)) if losses else None,
+            "action_accuracy": float(np.mean(accs)) if accs else None,
+        }
+
+    def evaluate(self, num_episodes: int = 8,
+                 seed: Optional[int] = None) -> Dict[str, Any]:
+        """Greedy-policy evaluation in a real environment."""
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        if self.config.env.startswith("ray_tpu/"):
+            from ray_tpu.rl import testing  # noqa: F401
+
+        env = gym.make(self.config.env, **self.config.env_config)
+        forward = jax.jit(self._forward)
+        base_seed = self.config.seed if seed is None else seed
+        fs = self.config.frame_stack
+
+        def stacked(obs, stack):
+            if fs <= 1:
+                return obs, None
+            if stack is None:  # episode start: [frame]*k history
+                stack = np.tile(obs, (1, 1, fs))
+            else:
+                c = obs.shape[-1]
+                stack = np.roll(stack, -c, axis=-1)
+                stack[..., -c:] = obs
+            return stack, stack
+
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=base_seed + ep)
+            view, stack = stacked(obs, None)
+            done, total = False, 0.0
+            while not done:
+                logits, _v = forward(self.params, jnp.asarray(view)[None])
+                action = int(jnp.argmax(logits[0]))
+                obs, reward, term, trunc, _ = env.step(action)
+                view, stack = stacked(obs, stack)
+                total += float(reward)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "episodes": num_episodes}

@@ -56,7 +56,8 @@ def make_env_runners(config) -> List[Any]:
             config.env, config.num_envs_per_runner,
             config.rollout_length, seed=config.seed + i,
             env_config=config.env_config,
-            frame_stack=getattr(config, "frame_stack", 1))
+            frame_stack=getattr(config, "frame_stack", 1),
+            policy_mode=getattr(config, "policy_mode", "categorical"))
         for i in range(config.num_env_runners)
     ]
 

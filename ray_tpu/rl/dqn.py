@@ -1,0 +1,239 @@
+"""DQN: off-policy value learning with replay.
+
+Analogue of the reference's DQN family (``rllib/algorithms/dqn/dqn.py`` —
+new API stack with ``EpisodeReplayBuffer``/``PrioritizedEpisodeReplayBuffer``
+and a target network). Double-DQN targets by default; prioritized replay is
+proportional with importance-sampling weights. EnvRunner actors collect
+epsilon-greedy transitions (the policy head doubles as the Q head); the
+learner is one jitted step — replay sampling is numpy host-side, the
+TD update is XLA.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.common import (
+    ConfigBuilderMixin,
+    make_env_runners,
+    probe_env_spec,
+    stop_runners,
+)
+from ray_tpu.rl.models import build_policy
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+@dataclass
+class DQNConfig(ConfigBuilderMixin):
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_length: int = 32
+    frame_stack: int = 1
+    policy_mode: str = "epsilon_greedy"  # consumed by EnvRunner
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    batch_size: int = 128
+    learning_starts: int = 1_000
+    train_batches_per_iter: int = 32
+    target_update_interval: int = 200    # learner steps between hard syncs
+    double_q: bool = True
+    prioritized_replay: bool = False
+    priority_alpha: float = 0.6
+    priority_beta: float = 0.4
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 10_000    # env steps to anneal over
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+    def env_runners(self, num_env_runners: int,
+                    num_envs_per_runner: int = 4) -> "DQNConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def training(self, **kwargs) -> "DQNConfig":
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        return self
+
+
+def rollout_to_transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """(T, N) rollout -> flat transition batch (obs, action, reward,
+    next_obs, done). Row t pairs with row t+1's observation; the last row
+    has no successor and synthetic autoreset rows (valids==0) are not
+    experience — both are dropped."""
+    T = ro["rewards"].shape[0]
+    next_obs = ro["obs"][1:]
+    keep = ro["valids"][:T - 1] > 0.5
+    # Bootstrap cutoff is TERMINATION only — a time-limit truncation must
+    # keep gamma * maxQ(next_obs) in the target (rllib's terminateds vs
+    # truncateds distinction). Older rollouts without the split fall back
+    # to dones.
+    term = ro.get("terminateds", ro["dones"])
+    return {
+        "obs": ro["obs"][:T - 1][keep],
+        "actions": ro["actions"][:T - 1][keep].astype(np.int32),
+        "rewards": ro["rewards"][:T - 1][keep].astype(np.float32),
+        "next_obs": next_obs[keep],
+        "dones": term[:T - 1][keep].astype(np.float32),
+    }
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        import jax
+        import optax
+
+        self.config = config
+        self._iteration = 0
+        self._total_env_steps = 0
+        self._learner_steps = 0
+
+        obs_shape, num_actions = probe_env_spec(
+            config.env, config.env_config, config.frame_stack)
+        init_fn, self._forward = build_policy(obs_shape, num_actions,
+                                              config.hidden)
+        self.params = init_fn(jax.random.key(config.seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, prioritized=config.prioritized_replay,
+            alpha=config.priority_alpha, beta=config.priority_beta,
+            seed=config.seed)
+        self.runners = make_env_runners(config)
+        self._broadcast_weights()
+
+    # ------------------------------------------------------------- learner
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        forward = self._forward
+
+        def loss_fn(params, target_params, batch):
+            q_all, _ = forward(params, batch["obs"])
+            q = jnp.take_along_axis(
+                q_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            q_next_target, _ = forward(target_params, batch["next_obs"])
+            if cfg.double_q:
+                # Double DQN: online net picks the argmax, target net rates.
+                q_next_online, _ = forward(params, batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=-1)
+                next_q = jnp.take_along_axis(
+                    q_next_target, best[:, None], axis=-1)[:, 0]
+            else:
+                next_q = jnp.max(q_next_target, axis=-1)
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]) * jax.lax.stop_gradient(next_q)
+            td = q - target
+            # Huber loss, importance-weighted for prioritized replay.
+            loss = jnp.mean(batch["weights"] * optax.huber_loss(q, target))
+            return loss, {"td_abs": jnp.abs(td),
+                          "q_mean": jnp.mean(q)}
+
+        def update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        return update
+
+    # ------------------------------------------------------------- driver
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _broadcast_weights(self) -> None:
+        import jax
+
+        eps = self._epsilon()
+        host = jax.device_get(self.params)
+        ref = ray_tpu.put(host)
+        waits = []
+        for r in self.runners:
+            waits.append(r.set_weights.remote(ref, self._iteration))
+            waits.append(r.set_epsilon.remote(eps))
+        ray_tpu.get(waits)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.monotonic()
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.runners])
+        steps = 0
+        for ro in rollouts:
+            trans = rollout_to_transitions(ro)
+            steps += len(trans["rewards"])
+            self.buffer.add(trans)
+        self._total_env_steps += steps
+        sample_time = time.monotonic() - t0
+
+        t1 = time.monotonic()
+        losses, q_means = [], []
+        if len(self.buffer) >= max(cfg.learning_starts, cfg.batch_size):
+            for _ in range(cfg.train_batches_per_iter):
+                batch, idx, weights = self.buffer.sample(cfg.batch_size)
+                batch = {**batch, "weights": weights}
+                self.params, self.opt_state, loss, aux = self._update(
+                    self.params, self.target_params, self.opt_state, batch)
+                self.buffer.update_priorities(
+                    idx, np.asarray(aux["td_abs"]))
+                losses.append(float(loss))
+                q_means.append(float(aux["q_mean"]))
+                self._learner_steps += 1
+                if self._learner_steps % cfg.target_update_interval == 0:
+                    self.target_params = jax.tree.map(
+                        lambda x: jnp.array(x), self.params)
+        learn_time = time.monotonic() - t1
+
+        self._iteration += 1
+        self._broadcast_weights()
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self.runners])
+        episode_returns = [s["episode_return_mean"] for s in stats
+                           if s.get("episodes")]
+        metrics = {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._total_env_steps,
+            "env_steps_this_iter": steps,
+            "buffer_size": len(self.buffer),
+            "learner_steps": self._learner_steps,
+            "epsilon": round(self._epsilon(), 4),
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(learn_time, 3),
+        }
+        if losses:
+            metrics["loss"] = float(np.mean(losses))
+            metrics["q_mean"] = float(np.mean(q_means))
+        if episode_returns:
+            metrics["episode_return_mean"] = float(np.mean(episode_returns))
+        return metrics
+
+    def stop(self) -> None:
+        stop_runners(self.runners)

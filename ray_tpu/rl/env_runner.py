@@ -34,7 +34,8 @@ class EnvRunner:
     def __init__(self, env_name: str, num_envs: int = 4,
                  rollout_length: int = 128, seed: int = 0,
                  env_config: Optional[Dict] = None,
-                 frame_stack: int = 1):
+                 frame_stack: int = 1,
+                 policy_mode: str = "categorical"):
         import jax
 
         self._jax = jax
@@ -66,11 +67,24 @@ class EnvRunner:
         self._params = None
         self._weights_version = -1
 
-        from ray_tpu.rl.models import build_policy, make_sample_fn
+        from ray_tpu.rl.models import (
+            build_policy,
+            make_egreedy_sample_fn,
+            make_sample_fn,
+        )
 
         n_actions = int(self.envs.single_action_space.n)
         _unused_init, forward = build_policy(self.obs.shape[1:], n_actions)
-        self._sample_fn = jax.jit(make_sample_fn(forward))
+        self._policy_mode = policy_mode
+        self._epsilon = 1.0
+        if policy_mode == "epsilon_greedy":
+            self._sample_fn = jax.jit(make_egreedy_sample_fn(forward))
+        else:
+            self._sample_fn = jax.jit(make_sample_fn(forward))
+
+    def set_epsilon(self, eps: float) -> None:
+        """Exploration rate for epsilon_greedy mode (DQN)."""
+        self._epsilon = float(eps)
 
     @property
     def obs_shape(self):
@@ -111,11 +125,17 @@ class EnvRunner:
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), np.float32)  # terminated only, no trunc
         valid_buf = np.ones((T, N), np.float32)
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            action, logp, value = self._sample_fn(self._params, self.obs, sub)
+            if self._policy_mode == "epsilon_greedy":
+                action, logp, value = self._sample_fn(
+                    self._params, self.obs, sub, self._epsilon)
+            else:
+                action, logp, value = self._sample_fn(
+                    self._params, self.obs, sub)
             action = np.asarray(action)
             obs_buf[t] = self.obs
             act_buf[t] = action
@@ -133,6 +153,10 @@ class EnvRunner:
             # transition is synthetic (action ignored, reward 0).
             rew_buf[t] = np.where(self._prev_done, 0.0, reward)
             done_buf[t] = done
+            # Truncation is not termination: off-policy targets must keep
+            # bootstrapping through time-limit cuts (reference: rllib's
+            # terminateds vs truncateds split).
+            term_buf[t] = terminated
             live = ~self._prev_done
             self._episode_returns[live] += reward[live]
             self._episode_lengths[live] += 1
@@ -145,11 +169,16 @@ class EnvRunner:
             self._prev_done = done
 
         # Bootstrap value for the final observation.
-        _, _, last_value = self._sample_fn(self._params, self.obs, self._key)
+        if self._policy_mode == "epsilon_greedy":
+            _, _, last_value = self._sample_fn(
+                self._params, self.obs, self._key, self._epsilon)
+        else:
+            _, _, last_value = self._sample_fn(
+                self._params, self.obs, self._key)
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
-            "valids": valid_buf,
+            "terminateds": term_buf, "valids": valid_buf,
             "last_value": np.asarray(last_value, np.float32),
             "weights_version": self._weights_version,
         }

@@ -147,6 +147,24 @@ def make_sample_fn(forward):
     return sample_action
 
 
+def make_egreedy_sample_fn(forward):
+    """Epsilon-greedy over the network's action scores (Q-values for DQN;
+    the policy head doubles as the Q head). ``eps`` is a traced scalar so
+    decay schedules never retrigger compilation."""
+
+    def sample_action(params, obs, key, eps):
+        q, _value = forward(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(key)
+        rand = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+        explore = jax.random.uniform(k2, greedy.shape) < eps
+        action = jnp.where(explore, rand, greedy)
+        value = jnp.max(q, axis=-1)  # greedy value, for stats/bootstraps
+        return action, jnp.zeros_like(value), value
+
+    return sample_action
+
+
 # ------------------------------------------------- backward-compat surface
 
 def init_mlp_policy(key: jax.Array, obs_dim: int, num_actions: int,

@@ -1,0 +1,145 @@
+"""Off-policy RL tests: replay buffers, DQN (run-to-reward), offline BC.
+
+Reference model: rllib per-algorithm test dirs + replay-buffer unit tests
++ offline BC from logged data.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import BCConfig, DQNConfig, ReplayBuffer, SumTree
+from ray_tpu.rl.dqn import rollout_to_transitions
+
+
+def test_sum_tree_proportional():
+    tree = SumTree(8)
+    tree.set([0, 1, 2], [1.0, 3.0, 6.0])
+    assert tree.total == pytest.approx(10.0)
+    rng = np.random.default_rng(0)
+    counts = np.zeros(8)
+    draws = 4000
+    idx = np.concatenate([tree.sample(8, rng) for _ in range(draws // 8)])
+    for i in idx:
+        counts[i] += 1
+    freq = counts / draws
+    assert freq[2] > freq[1] > freq[0] > 0
+    assert freq[2] == pytest.approx(0.6, abs=0.05)
+    assert counts[3:].sum() == 0  # zero-priority slots never sampled
+
+
+def test_replay_buffer_wraparound_and_sampling():
+    buf = ReplayBuffer(capacity=10)
+    for start in range(0, 25, 5):
+        buf.add({"x": np.arange(start, start + 5, dtype=np.int64)})
+    assert len(buf) == 10
+    batch, idx, w = buf.sample(32)
+    # Only the newest 10 values survive the ring.
+    assert batch["x"].min() >= 15
+    assert np.all(w == 1.0)
+
+
+def test_prioritized_replay_prefers_high_td():
+    buf = ReplayBuffer(capacity=16, prioritized=True, seed=1)
+    buf.add({"x": np.arange(16, dtype=np.int64)})
+    # Slot 5 gets a huge TD error, everything else tiny.
+    buf.update_priorities(np.arange(16), np.full(16, 1e-3))
+    buf.update_priorities(np.array([5]), np.array([10.0]))
+    batch, idx, w = buf.sample(256)
+    frac5 = np.mean(batch["x"] == 5)
+    assert frac5 > 0.5  # dominates sampling
+    assert w.min() > 0 and w.max() == pytest.approx(1.0)
+    # IS weight of the over-sampled slot is the smallest.
+    assert w[batch["x"] == 5].mean() < w[batch["x"] != 5].mean()
+
+
+def test_rollout_to_transitions_drops_synthetic_rows():
+    T, N = 4, 1
+    obs = np.arange(T * N).reshape(T, N).astype(np.float32)[..., None]
+    ro = {
+        "obs": obs,
+        "actions": np.zeros((T, N), np.int64),
+        "rewards": np.ones((T, N), np.float32),
+        "dones": np.array([[0], [1], [0], [0]], np.float32),
+        "valids": np.array([[1], [1], [0], [1]], np.float32),
+    }
+    out = rollout_to_transitions(ro)
+    # Row 2 is the autoreset step -> dropped; row 3 has no successor.
+    assert len(out["rewards"]) == 2
+    np.testing.assert_allclose(out["dones"], [0, 1])
+    np.testing.assert_allclose(out["next_obs"][:, 0], [1, 2])
+
+
+def test_dqn_single_iteration(ray_start_regular):
+    algo = DQNConfig().environment("CartPole-v1").env_runners(
+        2, num_envs_per_runner=2).training(
+        rollout_length=16, learning_starts=32, batch_size=32,
+        train_batches_per_iter=4).build()
+    try:
+        m1 = algo.train()
+        assert m1["env_steps_this_iter"] > 0
+        assert m1["buffer_size"] > 0
+        for _ in range(3):
+            m = algo.train()
+        assert m["learner_steps"] > 0 and "loss" in m
+        assert m["epsilon"] < algo.config.epsilon_initial
+    finally:
+        algo.stop()
+
+
+@pytest.mark.timeout_s(420)
+def test_dqn_learns_cartpole(ray_start_regular):
+    """Run-to-reward: DQN with double-Q + prioritized replay improves
+    clearly on CartPole within a small budget (seeded)."""
+    algo = DQNConfig().environment("CartPole-v1").env_runners(
+        2, num_envs_per_runner=4).training(
+        rollout_length=64, lr=1e-3, batch_size=128,
+        learning_starts=500, train_batches_per_iter=48,
+        target_update_interval=100, epsilon_decay_steps=6000,
+        prioritized_replay=True, seed=3).build()
+    try:
+        best, first = 0.0, None
+        for i in range(40):
+            metrics = algo.train()
+            ret = metrics.get("episode_return_mean")
+            if ret is not None:
+                if first is None:
+                    first = ret
+                best = max(best, ret)
+            if best >= 120.0:
+                break
+        assert first is not None
+        assert best >= 100.0, f"DQN failed to learn: first={first}, best={best}"
+    finally:
+        algo.stop()
+
+
+@pytest.mark.timeout_s(420)
+def test_bc_clones_policy_offline(ray_start_regular):
+    """Offline pipeline: train PPO briefly, record its experience into a
+    Dataset, clone with BC, and check the clone acts like the teacher
+    (action accuracy high, eval return >= random baseline)."""
+    from ray_tpu.rl import PPOConfig, collect_dataset
+
+    teacher = PPOConfig().environment("CartPole-v1").env_runners(
+        2, num_envs_per_runner=4).training(
+        rollout_length=128, minibatch_size=256, seed=11).build()
+    try:
+        for _ in range(8):
+            teacher.train()
+        ds = collect_dataset(teacher, num_rollouts=2)
+        assert ds.count() > 500
+    finally:
+        teacher.stop()
+
+    bc = BCConfig().environment("CartPole-v1").training(
+        epochs=6, batch_size=256, seed=11).build(ds)
+    metrics = bc.train()
+    assert metrics["rows_trained"] > 0
+    assert metrics["action_accuracy"] is not None
+    # The teacher is stochastic: a deterministic clone's accuracy on
+    # SAMPLED teacher actions is capped by teacher entropy — well above
+    # chance (0.5) is the meaningful bar.
+    assert metrics["action_accuracy"] > 0.55
+    ev = bc.evaluate(num_episodes=5)
+    assert ev["episode_return_mean"] > 40.0
