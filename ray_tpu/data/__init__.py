@@ -1,6 +1,10 @@
 """ray_tpu.data: streaming distributed datasets (reference: Ray Data)."""
 
-from ray_tpu.data.dataset import DataIterator, Dataset  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    GroupedData,
+)
 from ray_tpu.data.read_api import (  # noqa: F401
     from_items,
     from_numpy,

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import itertools
 import math
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -88,6 +88,131 @@ def _shuffle_combine(seed: int, *parts: Block) -> Block:
     block = _concat_blocks(live)
     perm = np.random.default_rng(seed).permutation(_block_len(block))
     return {k: v[perm] for k, v in block.items()}
+
+
+@ray_tpu.remote
+def _sample_keys(block: Block, key: str, cap: int = 128):
+    v = block[key]
+    if len(v) <= cap:
+        return np.asarray(v)
+    idx = np.random.default_rng(0).choice(len(v), cap, replace=False)
+    return np.asarray(v)[idx]
+
+
+@ray_tpu.remote
+def _range_scatter(block: Block, key: str, boundaries):
+    """Map half of the sort exchange: route rows to range partitions by
+    searchsorted against the sampled quantile boundaries."""
+    assign = np.searchsorted(boundaries, block[key], side="right")
+    out = [{k: v[assign == p] for k, v in block.items()}
+           for p in range(len(boundaries) + 1)]
+    return tuple(out) if len(out) != 1 else out[0]
+
+
+@ray_tpu.remote
+def _sorted_combine(key: str, descending: bool, *parts: Block) -> Block:
+    live = [p for p in parts if _block_len(p)]
+    if not live:
+        return {k: v[:0] for k, v in parts[0].items()} if parts else {}
+    block = _concat_blocks(live)
+    order = np.argsort(block[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return {k: v[order] for k, v in block.items()}
+
+
+@ray_tpu.remote
+def _hash_scatter(block: Block, key: str, num_parts: int):
+    """Map half of the groupby exchange: hash-partition rows on the key so
+    equal keys land in the same reduce partition."""
+    keys = block[key]
+    if keys.dtype.kind in "US":
+        # Deterministic across processes (Python hash() is seed-randomized
+        # per interpreter; scatter tasks run in different workers).
+        import zlib
+
+        hashes = np.array([zlib.crc32(str(x).encode()) for x in
+                           keys.tolist()], np.int64)
+    else:
+        hashes = keys.astype(np.int64, copy=False)
+    assign = np.abs(hashes) % num_parts
+    out = [{k: v[assign == p] for k, v in block.items()}
+           for p in range(num_parts)]
+    return tuple(out) if num_parts != 1 else out[0]
+
+
+_AGG_FNS = {
+    "count": lambda v: len(v),
+    "sum": np.sum,
+    "min": np.min,
+    "max": np.max,
+    "mean": np.mean,
+    "std": lambda v: np.std(v, ddof=1) if len(v) > 1 else 0.0,
+}
+
+
+@ray_tpu.remote
+def _group_combine(key: str, aggs, *parts: Block) -> Block:
+    """Reduce half of the groupby exchange: group this partition's rows by
+    key and compute the aggregate columns. ``aggs``: [(kind, col, out)]."""
+    live = [p for p in parts if _block_len(p)]
+    if not live:
+        empty = {key: np.empty(0)}
+        empty.update({out: np.empty(0) for _kind, _c, out in aggs})
+        return empty
+    block = _concat_blocks(live)
+    keys = block[key]
+    order = np.argsort(keys, kind="stable")
+    keys_sorted = keys[order]
+    uniq, starts = np.unique(keys_sorted, return_index=True)
+    bounds = list(starts) + [len(keys_sorted)]
+    out_cols: Dict[str, list] = {out: [] for _kind, _c, out in aggs}
+    for gi in range(len(uniq)):
+        rows = order[bounds[gi]:bounds[gi + 1]]
+        for kind, col, out in aggs:
+            vals = block[col][rows] if col is not None else rows
+            out_cols[out].append(_AGG_FNS[kind](vals))
+    result = {key: uniq}
+    result.update({out: np.asarray(v) for out, v in out_cols.items()})
+    return result
+
+
+@ray_tpu.remote
+def _map_groups_part(key: str, fn_blob: bytes, *parts: Block) -> Block:
+    from ray_tpu.core import serialization
+
+    fn = serialization.loads_function(fn_blob)
+    live = [p for p in parts if _block_len(p)]
+    if not live:
+        return parts[0] if parts else {}
+    block = _concat_blocks(live)
+    keys = block[key]
+    order = np.argsort(keys, kind="stable")
+    keys_sorted = keys[order]
+    uniq, starts = np.unique(keys_sorted, return_index=True)
+    bounds = list(starts) + [len(keys_sorted)]
+    outs = []
+    for gi in range(len(uniq)):
+        rows = order[bounds[gi]:bounds[gi + 1]]
+        outs.append(fn({k: v[rows] for k, v in block.items()}))
+    return _concat_blocks(outs) if outs else block
+
+
+@ray_tpu.remote
+def _zip_blocks(left: Block, right: Block) -> Block:
+    merged = dict(left)
+    for k, v in right.items():
+        name, i = k, 1
+        while name in merged:
+            name = f"{k}_{i}"
+            i += 1
+        merged[name] = v
+    return merged
+
+
+@ray_tpu.remote
+def _head_block(block: Block, n: int) -> Block:
+    return _slice_block(block, 0, n)
 
 
 # ----------------------------------------------------------------- plan
@@ -239,6 +364,165 @@ class Dataset:
                                       for b in range(len(parts))])
             for p in range(num_parts)]
         return Dataset(out_refs)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed sort via a range-partition exchange: sample keys,
+        pick quantile boundaries, scatter rows to range partitions, sort
+        each partition locally — globally ordered by block index, no rows
+        on the driver (reference: ``_internal/planner/exchange/
+        sort_task_spec.py`` SortTaskSpec sample->boundaries->exchange)."""
+        mat = self.materialize()
+        num_parts = len(mat._block_refs)
+        if num_parts <= 1:
+            if not mat._block_refs:
+                return mat
+            out = _sorted_combine.remote(key, descending, mat._block_refs[0])
+            return Dataset([out])
+        samples = np.concatenate(ray_tpu.get(
+            [_sample_keys.remote(r, key) for r in mat._block_refs]))
+        if len(samples) == 0:
+            return mat
+        qs = np.linspace(0, 1, num_parts + 1)[1:-1]
+        boundaries = np.quantile(np.sort(samples), qs)
+        parts = []
+        for ref in mat._block_refs:
+            out = _range_scatter.options(num_returns=num_parts).remote(
+                ref, key, boundaries)
+            parts.append(out if isinstance(out, list) else [out])
+        order = range(num_parts - 1, -1, -1) if descending else range(
+            num_parts)
+        out_refs = [
+            _sorted_combine.remote(key, descending,
+                                   *[parts[b][p] for b in range(len(parts))])
+            for p in order]
+        return Dataset(out_refs)
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Hash-partition exchange + per-partition grouping (reference:
+        ``Dataset.groupby`` -> aggregate exchange)."""
+        return GroupedData(self, key)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets with identical row counts; the
+        right side is repartitioned to the left's block layout and block
+        pairs merge in tasks (duplicate columns get a ``_1`` suffix,
+        reference: ``Dataset.zip``)."""
+        left = self.materialize()
+        counts = ray_tpu.get([_count_block.remote(r)
+                              for r in left._block_refs])
+        right = other.materialize()
+        r_counts = ray_tpu.get([_count_block.remote(r)
+                                for r in right._block_refs])
+        if sum(counts) != sum(r_counts):
+            raise ValueError(
+                f"zip needs equal row counts ({sum(counts)} vs "
+                f"{sum(r_counts)})")
+        # Repartition the right side to the left's exact row boundaries.
+        bounds = [0]
+        for c in counts:
+            bounds.append(bounds[-1] + c)
+        parts = []
+        offset = 0
+        n_out = len(counts)
+        for ref, count in zip(right._block_refs, r_counts):
+            out = _slice_for_ranges.options(num_returns=n_out).remote(
+                ref, offset, bounds)
+            parts.append(out if isinstance(out, list) else [out])
+            offset += count
+        right_refs = [
+            _concat_parts.remote(*[parts[b][p] for b in range(len(parts))])
+            for p in range(n_out)]
+        return Dataset([_zip_blocks.remote(l, r) for l, r in
+                        zip(left._block_refs, right_refs)])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (block-level, zero data movement)."""
+        refs = list(self.materialize()._block_refs)
+        for other in others:
+            refs.extend(other.materialize()._block_refs)
+        return Dataset(refs)
+
+    def limit(self, n: int) -> "Dataset":
+        """First ``n`` rows; trailing blocks are dropped unread, the
+        boundary block is sliced in a task."""
+        mat = self.materialize()
+        counts = ray_tpu.get([_count_block.remote(r)
+                              for r in mat._block_refs])
+        refs, have = [], 0
+        for ref, count in zip(mat._block_refs, counts):
+            if have + count <= n:
+                refs.append(ref)
+                have += count
+            else:
+                if n - have > 0:
+                    refs.append(_head_block.remote(ref, n - have))
+                break
+        return Dataset(refs)
+
+    def schema(self) -> Dict[str, Any]:
+        """Column name -> (dtype, element shape) from the first block."""
+        for block in self._streamed_blocks(max_in_flight=1):
+            return {k: (v.dtype, v.shape[1:]) for k, v in block.items()}
+        return {}
+
+    # ------------------------------------------------- global aggregates
+
+    def _column_agg(self, kind: str, col: str):
+        if self._has_actor_ops():
+            # Actor-pool ops can't run inside the plain fused-task path.
+            return self.materialize()._column_agg(kind, col)
+        fused = _fuse_ops(self._ops) if self._ops else None
+
+        def part(block: Block):
+            if fused is not None:
+                block = fused(block)
+            v = block[col]
+            if len(v) == 0:
+                return None
+            return (_AGG_FNS[kind](v), len(v), float(np.sum(v)))
+
+        task = ray_tpu.remote(part)
+        outs = [o for o in ray_tpu.get(
+            [task.remote(r) for r in self._block_refs]) if o is not None]
+        if not outs:
+            return None
+        vals = [o[0] for o in outs]
+        if kind == "sum":
+            return np.sum(vals)
+        if kind == "min":
+            return np.min(vals)
+        if kind == "max":
+            return np.max(vals)
+        if kind == "mean":  # weighted by block size
+            total_rows = sum(o[1] for o in outs)
+            return sum(o[2] for o in outs) / total_rows
+        raise ValueError(kind)
+
+    def sum(self, col: str):
+        return self._column_agg("sum", col)
+
+    def min(self, col: str):
+        return self._column_agg("min", col)
+
+    def max(self, col: str):
+        return self._column_agg("max", col)
+
+    def mean(self, col: str):
+        return self._column_agg("mean", col)
+
+    def stats(self) -> str:
+        """Human-readable execution summary (reference:
+        ``Dataset.stats()``): block count, rows, bytes, operator chain."""
+        counts = ray_tpu.get([_count_block.remote(r)
+                              for r in self._block_refs])
+        sizer = ray_tpu.remote(
+            lambda b: int(sum(v.nbytes for v in b.values())))
+        sizes = ray_tpu.get([sizer.remote(r) for r in self._block_refs])
+        ops = " -> ".join(type(op).__name__.lstrip("_")
+                          for op in self._ops) or "Read"
+        return (f"Dataset: {len(self._block_refs)} blocks, "
+                f"{sum(counts)} rows, {sum(sizes) / 1e6:.2f} MB "
+                f"(pending ops: {ops})")
 
     # --------------------------------------------------------- execution
 
@@ -400,6 +684,75 @@ class Dataset:
             self._block_refs, n)
         fused = _fuse_ops(self._ops) if self._ops else None
         return [DataIterator(coordinator, i, fused) for i in range(n)]
+
+
+class GroupedData:
+    """Grouped view of a Dataset (reference: ``GroupedData`` in
+    ``data/grouped_data.py``): hash-exchange rows on the key, then compute
+    per-group aggregates or apply ``map_groups`` per partition."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _exchange(self):
+        mat = self._ds.materialize()
+        num_parts = max(1, len(mat._block_refs))
+        parts = []
+        for ref in mat._block_refs:
+            out = _hash_scatter.options(num_returns=num_parts).remote(
+                ref, self._key, num_parts)
+            parts.append(out if isinstance(out, list) else [out])
+        return parts, num_parts
+
+    def aggregate(self, *aggs: Tuple[str, Optional[str], str]) -> Dataset:
+        """``aggs``: (kind, column, output_name) with kind in
+        count/sum/min/max/mean/std. Returns a Dataset with one row per
+        group."""
+        for kind, _col, _out in aggs:
+            if kind not in _AGG_FNS:
+                raise ValueError(f"unknown aggregate {kind!r}")
+        parts, num_parts = self._exchange()
+        if not parts:
+            return Dataset([])
+        out_refs = [
+            _group_combine.remote(self._key, list(aggs),
+                                  *[parts[b][p] for b in range(len(parts))])
+            for p in range(num_parts)]
+        return Dataset(out_refs)
+
+    def count(self) -> Dataset:
+        return self.aggregate(("count", None, "count"))
+
+    def sum(self, col: str) -> Dataset:
+        return self.aggregate(("sum", col, f"sum({col})"))
+
+    def min(self, col: str) -> Dataset:
+        return self.aggregate(("min", col, f"min({col})"))
+
+    def max(self, col: str) -> Dataset:
+        return self.aggregate(("max", col, f"max({col})"))
+
+    def mean(self, col: str) -> Dataset:
+        return self.aggregate(("mean", col, f"mean({col})"))
+
+    def std(self, col: str) -> Dataset:
+        return self.aggregate(("std", col, f"std({col})"))
+
+    def map_groups(self, fn: Callable[[Block], Block]) -> Dataset:
+        """Apply ``fn`` to each group's rows (as one block); groups of one
+        key never span partitions thanks to the hash exchange."""
+        from ray_tpu.core import serialization
+
+        parts, num_parts = self._exchange()
+        if not parts:
+            return Dataset([])
+        fn_blob = serialization.dumps_function(fn)
+        out_refs = [
+            _map_groups_part.remote(self._key, fn_blob,
+                                    *[parts[b][p] for b in range(len(parts))])
+            for p in range(num_parts)]
+        return Dataset(out_refs)
 
 
 @ray_tpu.remote

@@ -1,0 +1,7 @@
+"""Model families (TPU-first: scanned layers, GSPMD logical axes).
+
+``llama`` — decoder-only LM (flash/ring/Ulysses attention, MoE variant).
+``vit`` — Vision Transformer image classifier.
+"""
+
+from ray_tpu.models import llama, vit  # noqa: F401

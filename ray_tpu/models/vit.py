@@ -1,0 +1,211 @@
+"""Vision Transformer: the image-model family.
+
+Second model family beside Llama (SURVEY §7 phase 4 names ViT as the
+north-star Train/Tune workload — the reference's analogous benchmarks train
+ResNet/vision models through Ray Train, ``doc/source/train/benchmarks.rst``).
+Same TPU-first idiom as ``models/llama.py``:
+
+* patch embedding as ONE einsum over reshaped patches (a conv with
+  stride = kernel = patch collapses to a matmul — MXU-shaped, no XLA conv
+  needed for the stem);
+* encoder blocks stacked on a leading ``layers`` axis, executed by
+  ``lax.scan`` with optional ``jax.checkpoint`` (compile once per depth);
+* logical-axis sharding annotations (``constrain``) so the same code runs
+  DP/FSDP/TP on any mesh via the rule table in ``parallel/sharding.py``;
+* bf16 compute / fp32 master params, fp32 softmax-CE loss.
+
+Mean-pool classification head (no CLS token): equivalent accuracy at this
+scale and one less ragged token to shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    num_classes: int = 10
+    dim: int = 192
+    n_layers: int = 6
+    n_heads: int = 3
+    mlp_dim: int = 768
+    dropout: float = 0.0          # kept for API parity; eval path ignores
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+PRESETS: Dict[str, ViTConfig] = {
+    "debug": ViTConfig(image_size=16, patch_size=4, dim=64, n_layers=2,
+                       n_heads=2, mlp_dim=128, num_classes=10),
+    "vit_s16_cifar": ViTConfig(image_size=32, patch_size=4, dim=384,
+                               n_layers=12, n_heads=6, mlp_dim=1536),
+    "vit_b16": ViTConfig(image_size=224, patch_size=16, dim=768,
+                         n_layers=12, n_heads=12, mlp_dim=3072,
+                         num_classes=1000),
+}
+
+
+def param_axes(config: Optional[ViTConfig] = None) -> Dict[str, Any]:
+    """Logical axis names mirroring the params pytree (same rule table as
+    Llama: embed->fsdp, heads/mlp->tensor, batch->(data, fsdp))."""
+    return {
+        "patch_embed": ("patch", "embed"),
+        "pos_embed": ("length", "embed"),
+        "layers": {
+            "ln1_scale": ("layers", "embed"),
+            "ln1_bias": ("layers", "embed"),
+            "wqkv": ("layers", "embed", "heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "ln2_scale": ("layers", "embed"),
+            "ln2_bias": ("layers", "embed"),
+            "w_up": ("layers", "embed", "mlp"),
+            "b_up": ("layers", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "b_down": ("layers", "embed"),
+        },
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+        "head": ("embed", "vocab"),   # classes shard like vocab
+        "head_bias": ("vocab",),
+    }
+
+
+def init_params(config: ViTConfig, key: jax.Array,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    c = config
+    k_patch, k_pos, k_layers, k_head = jax.random.split(key, 4)
+
+    def trunc(key, shape, scale):
+        return (jax.random.truncated_normal(key, -2, 2, shape, dtype)
+                * scale)
+
+    L = c.n_layers
+    lk = jax.random.split(k_layers, 4)
+    layers = {
+        "ln1_scale": jnp.ones((L, c.dim), dtype),
+        "ln1_bias": jnp.zeros((L, c.dim), dtype),
+        "wqkv": trunc(lk[0], (L, c.dim, 3 * c.n_heads, c.head_dim),
+                      c.dim ** -0.5),
+        "wo": trunc(lk[1], (L, c.n_heads, c.head_dim, c.dim),
+                    (c.n_heads * c.head_dim) ** -0.5),
+        "ln2_scale": jnp.ones((L, c.dim), dtype),
+        "ln2_bias": jnp.zeros((L, c.dim), dtype),
+        "w_up": trunc(lk[2], (L, c.dim, c.mlp_dim), c.dim ** -0.5),
+        "b_up": jnp.zeros((L, c.mlp_dim), dtype),
+        "w_down": trunc(lk[3], (L, c.mlp_dim, c.dim), c.mlp_dim ** -0.5),
+        "b_down": jnp.zeros((L, c.dim), dtype),
+    }
+    return {
+        "patch_embed": trunc(k_patch, (c.patch_dim, c.dim),
+                             c.patch_dim ** -0.5),
+        "pos_embed": trunc(k_pos, (c.num_patches, c.dim), 0.02),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((c.dim,), dtype),
+        "final_ln_bias": jnp.zeros((c.dim,), dtype),
+        "head": jnp.zeros((c.dim, c.num_classes), dtype),
+        "head_bias": jnp.zeros((c.num_classes,), dtype),
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * scale + bias
+
+
+def patchify(images: jax.Array, config: ViTConfig) -> jax.Array:
+    """(B, H, W, C) -> (B, N_patches, patch_dim) by pure reshapes (the
+    stride-p conv stem as a matmul's input layout)."""
+    c = config
+    b, h, w, ch = images.shape
+    p = c.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * ch)
+
+
+def _block(x, layer, c: ViTConfig):
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    h = constrain(h, ("batch", "length", "act_embed"))
+    qkv = jnp.einsum("bne,ehd->bnhd", h, layer["wqkv"].astype(c.dtype))
+    q, k, v = jnp.split(qkv, 3, axis=2)
+    q = constrain(q, ("batch", "length", "heads", "head_dim"))
+    from ray_tpu.ops.attention import attention
+
+    out = attention(q, k, v, causal=False)  # scale applied in the kernel
+    out = jnp.einsum("bnhd,hde->bne", out, layer["wo"].astype(c.dtype))
+    x = x + constrain(out, ("batch", "length", "act_embed"))
+
+    h2 = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    up = jnp.einsum("bne,em->bnm", h2, layer["w_up"].astype(c.dtype))
+    up = jax.nn.gelu(up + layer["b_up"].astype(c.dtype))
+    up = constrain(up, ("batch", "length", "mlp"))
+    down = jnp.einsum("bnm,me->bne", up, layer["w_down"].astype(c.dtype))
+    down = down + layer["b_down"].astype(c.dtype)
+    return x + constrain(down, ("batch", "length", "act_embed"))
+
+
+def forward(params: Dict[str, Any], images: jax.Array,
+            config: ViTConfig) -> jax.Array:
+    """Images (B, H, W, C) float -> class logits (B, num_classes) fp32."""
+    c = config
+    patches = patchify(images.astype(c.dtype), c)
+    x = jnp.einsum("bnp,pe->bne", patches,
+                   params["patch_embed"].astype(c.dtype))
+    x = x + params["pos_embed"].astype(c.dtype)
+    x = constrain(x, ("batch", "length", "act_embed"))
+
+    def body(carry, layer):
+        layer = {k: v.astype(c.dtype) if v.dtype == jnp.float32 else v
+                 for k, v in layer.items()}
+        return _block(carry, layer, c), None
+
+    scan_body = jax.checkpoint(body) if c.remat else body
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _layer_norm(x, params["final_ln_scale"].astype(c.dtype),
+                    params["final_ln_bias"].astype(c.dtype))
+    pooled = jnp.mean(x, axis=1)  # mean-pool head
+    logits = jnp.einsum("be,ec->bc", pooled,
+                        params["head"].astype(c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits + params["head_bias"].astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: ViTConfig) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Softmax cross entropy; returns (loss, {"accuracy": ...})."""
+    logits = forward(params, batch["images"], config)
+    labels = batch["labels"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(logz - gold)
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
+def num_params(config: ViTConfig) -> int:
+    leaves = jax.tree.leaves(
+        jax.eval_shape(lambda: init_params(config, jax.random.key(0))))
+    return sum(int(jnp.prod(jnp.array(l.shape))) for l in leaves)

@@ -46,6 +46,7 @@ DEFAULT_RULES: Rules = {
     "expert_dim": None,      # router output dim (E as a feature axis)
     "layers": None,  # scanned-layer leading axis
     "norm": None,
+    "patch": None,   # ViT patch-pixel input axis
 }
 
 

@@ -119,5 +119,12 @@ def build_eval_step(loss_fn, mesh, rules=None):
 
 def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh,
                 rules: Optional[Rules] = None):
+    """Batch-shard every leaf: (batch, length) for rank >= 2 leaves, batch
+    only for rank-1 leaves (labels, weights — image batches mix ranks)."""
     sh = batch_sharding(mesh, rules)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+    sh1 = NamedSharding(mesh, spec_for(("batch",), rules))
+
+    def put(x):
+        return jax.device_put(x, sh1 if jnp.ndim(x) <= 1 else sh)
+
+    return jax.tree.map(put, batch)

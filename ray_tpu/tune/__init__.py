@@ -14,4 +14,5 @@ from ray_tpu.tune.search import (  # noqa: F401
     randint,
     uniform,
 )
+from ray_tpu.tune.tpe import TPESearcher  # noqa: F401
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401

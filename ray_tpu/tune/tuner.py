@@ -32,6 +32,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
+    search_alg: Any = None  # e.g. tune.TPESearcher; None = grid/random
     seed: int = 0
 
 
@@ -200,15 +201,31 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
+        searcher = tc.search_alg
         if self._restored_trials is not None:
             trials = self._restored_trials
+            searcher = None  # restored sweeps replay their saved configs
+        elif searcher is not None:
+            # Model-based search: configs are SUGGESTED one at a time as
+            # slots free up, informed by completed trials (reference:
+            # Optuna/HyperOpt searcher seam, tune/search/searcher.py).
+            searcher.set_search_properties(tc.metric, tc.mode,
+                                           self._param_space)
+            trials = []
         else:
             variants = generate_variants(self._param_space, tc.num_samples,
                                          tc.seed)
             trials = [_Trial(f"{self._name}_{i:05d}", cfg)
                       for i, cfg in enumerate(variants)]
         fn_blob = serialization.dumps_function(self._trainable)
-        max_conc = tc.max_concurrent_trials or len(trials)
+        if tc.max_concurrent_trials:
+            max_conc = tc.max_concurrent_trials
+        elif searcher is not None:
+            # Unbounded concurrency would suggest the whole sweep before
+            # any result lands, degenerating model-based search to random.
+            max_conc = 4
+        else:
+            max_conc = max(len(trials), tc.num_samples, 1)
 
         pending = [t for t in trials if t.state == "PENDING"]
         running: List[_Trial] = []
@@ -220,10 +237,18 @@ class Tuner:
             waiting[trial.actor.wait_status.remote(10.0)] = (
                 trial, trial.actor)
 
+        def more_to_suggest() -> bool:
+            return searcher is not None and len(trials) < tc.num_samples
+
         self._save_state(trials)
-        while pending or running:
-            while pending and len(running) < max_conc:
-                trial = pending.pop(0)
+        while pending or running or more_to_suggest():
+            while len(running) < max_conc and (pending or more_to_suggest()):
+                if pending:
+                    trial = pending.pop(0)
+                else:
+                    tid = f"{self._name}_{len(trials):05d}"
+                    trial = _Trial(tid, searcher.suggest(tid))
+                    trials.append(trial)
                 self._launch(trial, fn_blob)
                 running.append(trial)
                 arm(trial)
@@ -242,6 +267,9 @@ class Tuner:
                 else:
                     if trial in running:
                         running.remove(trial)
+                    if searcher is not None:
+                        searcher.on_trial_complete(trial.id,
+                                                   trial.result.metrics)
                     self._save_state(trials)
         self._save_state(trials)
         return ResultGrid([t.result for t in trials], tc.metric, tc.mode)

@@ -182,3 +182,116 @@ def test_write_and_read_parquet_roundtrip(ray_start_regular, tmp_path):
     back = rdata.read_parquet(str(tmp_path / "out" / "*.parquet"))
     xs = sorted(r["x"] for r in back.iter_rows())
     assert xs == list(range(50))
+
+
+# ---------------------------------------------------------- new data ops
+
+def test_sort_range_partition_exchange(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(500).astype(np.int64)
+    ds = rdata.from_numpy({"x": vals, "y": vals * 2.0}, num_blocks=7)
+    out = ds.sort("x")
+    rows = np.concatenate([b["x"] for b in out.iter_batches(batch_size=100)])
+    np.testing.assert_array_equal(rows, np.arange(500))
+    # Row alignment survives the exchange.
+    ys = np.concatenate([b["y"] for b in out.iter_batches(batch_size=100)])
+    np.testing.assert_array_equal(ys, np.arange(500) * 2.0)
+
+    desc = ds.sort("x", descending=True)
+    rows = np.concatenate([b["x"] for b in desc.iter_batches(batch_size=100)])
+    np.testing.assert_array_equal(rows, np.arange(499, -1, -1))
+
+
+def test_groupby_aggregates(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    n = 300
+    keys = (np.arange(n) % 3).astype(np.int64)
+    vals = np.arange(n, dtype=np.float64)
+    ds = rdata.from_numpy({"g": keys, "v": vals}, num_blocks=5)
+
+    out = ds.groupby("g").sum("v")
+    rows = {int(r["g"]): float(r["sum(v)"]) for r in out.iter_rows()}
+    for g in range(3):
+        assert rows[g] == pytest.approx(vals[keys == g].sum())
+
+    counts = {int(r["g"]): int(r["count"])
+              for r in ds.groupby("g").count().iter_rows()}
+    assert counts == {0: 100, 1: 100, 2: 100}
+
+    means = {int(r["g"]): float(r["mean(v)"])
+             for r in ds.groupby("g").mean("v").iter_rows()}
+    for g in range(3):
+        assert means[g] == pytest.approx(vals[keys == g].mean())
+
+
+def test_groupby_map_groups(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_numpy({
+        "g": np.array([0, 1, 0, 1, 2], np.int64),
+        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+    }, num_blocks=2)
+
+    def demean(block):
+        return {"g": block["g"], "v": block["v"] - block["v"].mean()}
+
+    out = ds.groupby("g").map_groups(demean)
+    rows = sorted(((int(r["g"]), float(r["v"])) for r in out.iter_rows()))
+    assert rows == [(0, -1.0), (0, 1.0), (1, -1.0), (1, 1.0), (2, 0.0)]
+
+
+def test_zip_union_limit_schema(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    a = rdata.from_numpy({"x": np.arange(100)}, num_blocks=4)
+    b = rdata.from_numpy({"x": np.arange(100) * 10,
+                          "y": np.ones(100)}, num_blocks=3)
+    z = a.zip(b)
+    rows = list(z.iter_rows())
+    assert len(rows) == 100
+    assert all(r["x_1"] == r["x"] * 10 for r in rows)
+    assert all(r["y"] == 1.0 for r in rows)
+
+    u = a.union(a, a)
+    assert u.count() == 300
+
+    lim = a.limit(42)
+    assert lim.count() == 42
+    got = np.sort(np.array([r["x"] for r in lim.iter_rows()]))
+    np.testing.assert_array_equal(got, np.arange(42))
+
+    sch = b.schema()
+    assert sch["x"][0] == np.dtype(np.int64)
+    assert sch["y"][0] == np.dtype(np.float64)
+
+
+def test_global_aggregates_and_stats(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    vals = np.arange(1, 101, dtype=np.float64)
+    ds = rdata.from_numpy({"v": vals}, num_blocks=6)
+    assert ds.sum("v") == pytest.approx(vals.sum())
+    assert ds.min("v") == 1.0
+    assert ds.max("v") == 100.0
+    assert ds.mean("v") == pytest.approx(vals.mean())
+    # mean on a filtered view (op chain applies before aggregation)
+    assert ds.filter(lambda r: r["v"] <= 50).mean("v") == pytest.approx(
+        np.arange(1, 51).mean())
+    s = ds.stats()
+    assert "100 rows" in s and "blocks" in s
+
+
+def test_groupby_string_keys_across_processes(ray_start_regular):
+    """String keys must hash deterministically across worker processes
+    (Python hash() is per-interpreter seed-randomized)."""
+    from ray_tpu import data as rdata
+
+    names = np.array(["alpha", "beta", "gamma"] * 40)
+    vals = np.arange(120, dtype=np.float64)
+    ds = rdata.from_numpy({"name": names, "v": vals}, num_blocks=6)
+    out = ds.groupby("name").count()
+    counts = {str(r["name"]): int(r["count"]) for r in out.iter_rows()}
+    assert counts == {"alpha": 40, "beta": 40, "gamma": 40}, counts
