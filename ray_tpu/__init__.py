@@ -37,6 +37,7 @@ from ray_tpu.core.errors import (  # noqa: F401
     WorkerCrashedError,
 )
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.runtime import ObjectRefGenerator  # noqa: F401
 from ray_tpu.core.placement import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroup,

@@ -214,6 +214,11 @@ class ActorHandle:
             "seq": seq,
             "epoch": incarnation,
         }
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.context_for_spec()
+        if trace_ctx is not None:
+            spec["trace"] = trace_ctx
         from ray_tpu.core.runtime import _collect_top_level_refs
 
         arg_refs = _collect_top_level_refs(args, kwargs)

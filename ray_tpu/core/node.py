@@ -166,6 +166,7 @@ class Node:
                 "read_shm_chunk": self.read_shm_chunk,
                 "free_shm_object": self.free_shm_object,
                 "worker_death_cause": self.worker_death_cause,
+                "list_workers": self.list_workers,
                 "get_info": self.get_info,
                 "ping": lambda: "pong",
             },
@@ -632,6 +633,18 @@ class Node:
             return True
         except OSError:
             return False
+
+    def list_workers(self) -> List[Dict[str, Any]]:
+        """Registered worker processes (for the state CLI's stack dumps —
+        the py-spy-equivalent introspection path)."""
+        with self._lock:
+            return [{
+                "worker_id": h.worker_id.hex(),
+                "addr": h.addr,
+                "pid": h.proc.pid,
+                "idle": h.idle,
+                "dedicated": h.dedicated,
+            } for h in self._workers.values() if h.addr is not None]
 
     def get_info(self) -> Dict[str, Any]:
         with self._lock:

@@ -27,6 +27,7 @@ Key protocol decisions mirrored from the reference:
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 import os
 import threading
@@ -57,6 +58,12 @@ _core_worker_lock = threading.Lock()
 # How long an actor's ordered queue waits for a missing sequence number
 # before treating it as skipped (see ActorExecutionRuntime._run_ordered).
 _GAP_WAIT_S = 30.0
+
+
+def _dump_stacks() -> str:
+    from ray_tpu.util.tracing import dump_stacks
+
+    return dump_stacks()
 
 
 def get_core_worker() -> "CoreWorker":
@@ -109,6 +116,10 @@ class CoreWorker:
         # lineage, object_recovery_manager.h:41).
         self._lineage: Dict[ObjectID, Dict[str, Any]] = {}
         self._lineage_lock = threading.Lock()
+        # Streaming-generator returns: task id bytes -> stream state
+        # (items: ObjectRefs in yield order; total set when the task ends).
+        self._streams: Dict[bytes, Dict[str, Any]] = {}
+        self._streams_cond = threading.Condition()
         # Admission control for remote object pulls (reference: PullManager's
         # memory budget, pull_manager.h:52): bounded chunk slots.
         slots = max(1, config.max_pull_bytes_in_flight
@@ -124,9 +135,11 @@ class CoreWorker:
                 "ref_update": self._handle_ref_update,
                 "reconstruct_object": self._handle_reconstruct,
                 "push_task": self._handle_push_task,
+                "stream_item": self._handle_stream_item,
                 "start_actor": self._handle_start_actor,
                 "push_actor_task": self._handle_push_actor_task,
                 "shutdown_worker": self._handle_shutdown,
+                "dump_stacks": _dump_stacks,
                 "ping": lambda: "pong",
             },
             name=f"{mode}-core",
@@ -625,6 +638,9 @@ class CoreWorker:
                     ) -> List[ObjectRef]:
         task_id = TaskID.from_random()
         num_returns = options.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         refs = [ObjectRef(oid, self.addr) for oid in return_ids]
         for oid in return_ids:
@@ -645,6 +661,17 @@ class CoreWorker:
             "return_ids": [o.binary() for o in return_ids],
             "owner_addr": self.addr,
         }
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.context_for_spec()
+        if trace_ctx is not None:
+            spec["trace"] = trace_ctx
+        if streaming:
+            spec["streaming"] = True
+            self._stream_state(task_id.binary())  # exists before items land
+            self.submitter.submit(spec, options, return_ids, arg_refs,
+                                  held_refs)
+            return ObjectRefGenerator(self, task_id.binary(), desc)
         if options.get("max_retries", 3) > 0:
             self.record_lineage(return_ids, spec, options)
         self.submitter.submit(spec, options, return_ids, arg_refs,
@@ -687,7 +714,25 @@ class CoreWorker:
             fn = self._load_function(spec["func_key"], spec.get("func_blob"))
             args, kwargs = self._resolve_args(spec["args_blob"])
             self._current_task_desc.value = spec.get("desc", "")
-            result = fn(*args, **kwargs)
+            from ray_tpu.util import tracing
+
+            with tracing.activate(spec.get("trace")):
+                result = fn(*args, **kwargs)
+                if spec.get("streaming"):
+                    # Streaming-generator task: push each yielded item to
+                    # the owner as it is produced (reference: streaming
+                    # returns, ReportGeneratorItemReturns); the reply
+                    # carries only the final count. Iteration runs the
+                    # USER's generator body, so it stays inside the trace
+                    # context.
+                    owner = self.clients.get(spec["owner_addr"])
+                    count = 0
+                    for item in result:
+                        owner.call("stream_item", spec["task_id"], count,
+                                   self._pack_results([item])[0])
+                        count += 1
+                    return {"ok": True, "results": [],
+                            "stream_len": count}
             n = len(spec["return_ids"])
             if n == 0:
                 results = []
@@ -729,6 +774,97 @@ class CoreWorker:
             write(out)
             packed.append(("inline", bytes(out), nested))
         return packed
+
+    # ------------------------------------------------ streaming generators
+
+    def _stream_state(self, task_id: bytes) -> Optional[Dict[str, Any]]:
+        """Live stream state, creating it on first touch. ``None`` means
+        the consumer dropped the stream (tombstone): late pushes must NOT
+        resurrect it (they would pin refs forever)."""
+        with self._streams_cond:
+            if task_id in self._streams:
+                return self._streams[task_id]  # may be a None tombstone
+            state = {"items": {}, "arrived": set(), "total": None,
+                     "error": None}
+            self._streams[task_id] = state
+            return state
+
+    def _handle_stream_item(self, task_id: bytes, index: int,
+                            packed: tuple) -> None:
+        """Owner-side: one yielded item from a streaming-generator task
+        (reference: ReportGeneratorItemReturns, core_worker.proto — items
+        stream back before the task finishes). The arrival check-and-claim
+        is atomic under the stream condition, so concurrent duplicate
+        pushes (original worker + retry) fulfil each index exactly once."""
+        state = self._stream_state(task_id)
+        if state is None:
+            return  # consumer dropped the stream; discard late pushes
+        with self._streams_cond:
+            if index in state["arrived"]:
+                return  # duplicate from a retry
+            state["arrived"].add(index)
+        oid = ObjectID(
+            hashlib.sha256(task_id + index.to_bytes(4, "little")).digest()
+            [:ObjectID.NBYTES])
+        self.store.create_pending(oid)
+        self.fulfil_result(oid, packed)
+        with self._streams_cond:
+            # Holding the ref in the state keeps the item alive until the
+            # consumer takes it (the ref sweeper frees unreferenced ids).
+            state["items"][index] = ObjectRef(oid, self.addr)
+            self._streams_cond.notify_all()
+
+    def _finish_stream(self, task_id: bytes, total: Optional[int],
+                       error: Optional[BaseException]) -> None:
+        state = self._stream_state(task_id)
+        if state is None:
+            return
+        with self._streams_cond:
+            state["total"] = (total if total is not None
+                              else len(state["arrived"]))
+            state["error"] = error
+            self._streams_cond.notify_all()
+
+    def stream_next(self, task_id: bytes, index: int,
+                    timeout: Optional[float] = None):
+        """Block until item ``index`` exists; returns its ObjectRef or
+        raises StopIteration/the task error. Single-consumer: the handed-
+        over ref is removed from the state (the caller's ref is the live
+        handle), so consumed items free as the consumer releases them
+        instead of accumulating for the stream's lifetime."""
+        state = self._stream_state(task_id)
+        if state is None:
+            raise StopIteration
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._streams_cond:
+            while True:
+                if index in state["items"]:
+                    return state["items"].pop(index)
+                if state["error"] is not None:
+                    raise state["error"]
+                if state["total"] is not None and index >= state["total"]:
+                    raise StopIteration
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    from ray_tpu.core.errors import GetTimeoutError
+
+                    raise GetTimeoutError(
+                        f"stream item {index} not ready in {timeout}s")
+                self._streams_cond.wait(
+                    1.0 if remaining is None else min(remaining, 1.0))
+
+    def drop_stream(self, task_id: bytes) -> None:
+        """Release a stream's state (its held item refs free via the normal
+        refcount path) — called when the consuming generator is GC'd. A
+        bounded tombstone remains so late pushes from the still-running
+        task are discarded instead of resurrecting the state."""
+        with self._streams_cond:
+            self._streams[task_id] = None
+            tombstones = [k for k, v in self._streams.items() if v is None]
+            for k in tombstones[:-256]:
+                del self._streams[k]
 
     def fulfil_result(self, oid: ObjectID, packed: tuple) -> None:
         """Owner-side: record a packed task result; refs nested in the frame
@@ -928,16 +1064,24 @@ class TaskSubmitter:
             if reply["ok"]:
                 for oid, packed in zip(return_ids, reply["results"]):
                     core.fulfil_result(oid, packed)
+                if spec.get("streaming"):
+                    core._finish_stream(spec["task_id"],
+                                        reply.get("stream_len"), None)
             else:
                 for oid in return_ids:
                     self._core.store.put_serialized(oid, reply["error_frame"])
+                if spec.get("streaming"):
+                    core._finish_stream(
+                        spec["task_id"], None,
+                        serialization.deserialize(reply["error_frame"]))
             core.record_task_event({
                 "task_id": TaskID(spec["task_id"]).hex(),
                 "desc": spec.get("desc", ""),
                 "state": "FINISHED" if reply["ok"] else "FAILED",
                 "submitted_ts": t_submit, "lease_ts": t_lease,
                 "end_ts": t_run, "worker": worker_hex,
-                "owner": core.addr})
+                "owner": core.addr,
+                "trace_id": (spec.get("trace") or {}).get("trace_id")})
         except BaseException as e:  # noqa: BLE001
             core.record_task_event({
                 "task_id": TaskID(spec["task_id"]).hex(),
@@ -946,6 +1090,48 @@ class TaskSubmitter:
                 "end_ts": time.time(), "worker": worker_hex,
                 "owner": core.addr, "error": repr(e)})
             self._fail(return_ids, e)
+            if spec.get("streaming"):
+                core._finish_stream(spec["task_id"], None, e)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming-generator task's yielded ObjectRefs
+    (reference: ``ObjectRefGenerator``/``StreamingObjectRefGenerator`` from
+    ``num_returns="streaming"``). ``next()`` blocks until the next item has
+    streamed back from the still-running task; iteration ends when the task
+    returns, and raises the task's error if it failed."""
+
+    def __init__(self, core: "CoreWorker", task_id: bytes, desc: str):
+        self._core = core
+        self._task_id = task_id
+        self._desc = desc
+        self._index = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._core.stream_next(self._task_id, self._index)
+        self._index += 1
+        return ref
+
+    def next_ready(self, timeout: float) -> ObjectRef:
+        """Like ``next()`` but bounded by ``timeout`` (GetTimeoutError)."""
+        ref = self._core.stream_next(self._task_id, self._index, timeout)
+        self._index += 1
+        return ref
+
+    def __repr__(self) -> str:
+        return (f"ObjectRefGenerator({self._desc}, "
+                f"consumed={self._index})")
+
+    def __del__(self):
+        core = getattr(self, "_core", None)
+        if core is not None:
+            try:
+                core.drop_stream(self._task_id)
+            except Exception:
+                pass
 
 
 # --------------------------------------------------------------------------
@@ -986,14 +1172,23 @@ class ActorExecutionRuntime:
         method_name = spec["method"]
         desc = spec.get("desc", method_name)
         try:
+            from ray_tpu.util import tracing
+
             method = getattr(self.instance, method_name)
             args, kwargs = self.core._resolve_args(spec["args_blob"])
-            if self.is_async:
-                result = self._run_async(method, args, kwargs)
-            elif self.max_concurrency > 1:
-                result = self._exec_pool.submit(method, *args, **kwargs).result()
-            else:
-                result = self._run_ordered(spec, method, args, kwargs)
+            with tracing.activate(spec.get("trace")):
+                if self.is_async:
+                    result = self._run_async(method, args, kwargs)
+                elif self.max_concurrency > 1:
+                    # Copy the handler thread's context (incl. the active
+                    # trace span) onto the pool thread running user code.
+                    import contextvars as _cv
+
+                    ctx = _cv.copy_context()
+                    result = self._exec_pool.submit(
+                        lambda: ctx.run(method, *args, **kwargs)).result()
+                else:
+                    result = self._run_ordered(spec, method, args, kwargs)
             n = len(spec["return_ids"])
             if n == 0:
                 results = []
@@ -1015,8 +1210,22 @@ class ActorExecutionRuntime:
         import inspect
 
         if inspect.iscoroutinefunction(method):
-            fut = asyncio.run_coroutine_threadsafe(
-                method(*args, **kwargs), self._loop)
+            from ray_tpu.util import tracing
+
+            trace = tracing.current()  # handler thread's active span
+
+            async def wrapped():
+                # The event-loop thread has no trace context; re-enter the
+                # caller's span inside the coroutine's own context.
+                if trace is None:
+                    return await method(*args, **kwargs)
+                token = tracing._ctx.set(trace)
+                try:
+                    return await method(*args, **kwargs)
+                finally:
+                    tracing._ctx.reset(token)
+
+            fut = asyncio.run_coroutine_threadsafe(wrapped(), self._loop)
             return fut.result()
         return method(*args, **kwargs)
 

@@ -382,8 +382,12 @@ class Dataset:
             [_sample_keys.remote(r, key) for r in mat._block_refs]))
         if len(samples) == 0:
             return mat
-        qs = np.linspace(0, 1, num_parts + 1)[1:-1]
-        boundaries = np.quantile(np.sort(samples), qs)
+        # Order-statistic boundaries (not np.quantile: no interpolation, so
+        # string/order-only key dtypes sort too).
+        samples = np.sort(samples)
+        idx = np.linspace(0, len(samples) - 1,
+                          num_parts + 1)[1:-1].astype(int)
+        boundaries = samples[idx]
         parts = []
         for ref in mat._block_refs:
             out = _range_scatter.options(num_returns=num_parts).remote(

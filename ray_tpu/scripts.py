@@ -136,17 +136,48 @@ def cmd_timeline(args) -> None:
             continue
         trace.append({
             "name": e.get("desc", e["task_id"][:8]),
-            "cat": "task",
+            "cat": "span" if e.get("state") == "SPAN" else "task",
             "ph": "X",
             "ts": e["lease_ts"] * 1e6,
             "dur": (e["end_ts"] - e["lease_ts"]) * 1e6,
             "pid": str(e.get("owner", "driver")),
             "tid": e.get("worker") or "worker",
-            "args": {"state": e.get("state")},
+            "args": {"state": e.get("state"),
+                     "trace_id": e.get("trace_id")},
         })
     with open(args.output, "w") as f:
         json.dump(trace, f)
     print(f"wrote {len(trace)} events to {args.output}")
+
+
+def cmd_stacks(args) -> None:
+    """Dump every live worker's Python thread stacks (the py-spy-equivalent
+    debugging view, reference: dashboard reporter profiling,
+    ``profile_manager.py:79`` — native via sys._current_frames here)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    client = _client(args)
+    for node in client.call("list_nodes"):
+        if not node.get("alive"):
+            continue
+        try:
+            node_client = RpcClient(tuple(node["addr"]))
+            workers = node_client.call("list_workers")
+        except Exception as e:
+            print(f"node {node['node_id'][:8]}: unreachable ({e})")
+            continue
+        print(f"=== node {node['node_id'][:8]} "
+              f"({len(workers)} workers) ===")
+        for w in workers:
+            print(f"--- worker {w['worker_id'][:8]} pid={w['pid']} "
+                  f"{'idle' if w['idle'] else 'busy'} ---")
+            try:
+                wc = RpcClient(tuple(w["addr"]))
+                print(wc.call("dump_stacks", timeout=10.0))
+                wc.close()
+            except Exception as e:
+                print(f"  unreachable: {e}")
+        node_client.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -163,6 +194,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
+    sub.add_parser("stacks")
     args = parser.parse_args(argv)
     if args.command == "status":
         cmd_status(args)
@@ -170,6 +202,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd_list(args)
     elif args.command == "timeline":
         cmd_timeline(args)
+    elif args.command == "stacks":
+        cmd_stacks(args)
     return 0
 
 

@@ -295,3 +295,14 @@ def test_groupby_string_keys_across_processes(ray_start_regular):
     out = ds.groupby("name").count()
     counts = {str(r["name"]): int(r["count"]) for r in out.iter_rows()}
     assert counts == {"alpha": 40, "beta": 40, "gamma": 40}, counts
+
+
+def test_sort_string_keys(ray_start_regular):
+    from ray_tpu import data as rdata
+
+    rng = np.random.default_rng(3)
+    words = np.array([f"w{int(i):04d}" for i in rng.permutation(200)])
+    ds = rdata.from_numpy({"w": words}, num_blocks=5)
+    out = np.concatenate([b["w"] for b in
+                          ds.sort("w").iter_batches(batch_size=64)])
+    assert list(out) == sorted(words.tolist())

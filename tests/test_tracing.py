@@ -1,0 +1,100 @@
+"""Tracing tests: span propagation through tasks/actors, profile events,
+stack dumps (reference: util/tracing/tracing_helper.py + profile_event +
+py-spy reporter)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def _events(core, match):
+    core._flush_task_events()
+    events = core.controller.call("list_task_events", 10000)
+    return [e for e in events if match(e)]
+
+
+def test_span_propagates_through_task(ray_start_regular):
+    core = ray_start_regular
+
+    @ray_tpu.remote
+    def traced_task():
+        ctx = tracing.current()
+        with tracing.profile_event("inner-work"):
+            time.sleep(0.01)
+        return ctx
+
+    with tracing.trace("root-span") as (trace_id, _span):
+        inside = ray_tpu.get(traced_task.remote())
+
+    # The worker saw the caller's trace id with a fresh span id.
+    assert inside is not None and inside[0] == trace_id
+
+    # The task's FINISHED event carries the trace id; the root span and the
+    # WORKER-side profile event (flushed on the worker's own cadence) land
+    # in the controller's event table too.
+    deadline = time.monotonic() + 30
+    linked, names = [], set()
+    while time.monotonic() < deadline:
+        linked = _events(core, lambda e: e.get("trace_id") == trace_id
+                         and e.get("state") in ("FINISHED", "FAILED"))
+        names = {e["desc"] for e in _events(
+            core, lambda e: e.get("state") == "SPAN"
+            and e.get("trace_id") == trace_id)}
+        if linked and {"root-span", "profile:inner-work"} <= names:
+            break
+        time.sleep(0.2)
+    assert linked, "no task event linked to the trace"
+    assert "root-span" in names
+    assert "profile:inner-work" in names, names
+
+
+def test_span_propagates_through_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Echo:
+        def ctx(self):
+            return tracing.current()
+
+    actor = Echo.remote()
+    with tracing.trace("actor-root") as (trace_id, _):
+        inside = ray_tpu.get(actor.ctx.remote())
+    assert inside is not None and inside[0] == trace_id
+    ray_tpu.kill(actor)
+
+
+def test_dump_stacks_local():
+    text = tracing.dump_stacks()
+    assert "thread" in text and "test_dump_stacks_local" in text
+
+
+def test_worker_stack_dump_rpc(ray_start_regular):
+    from ray_tpu.core import api as api_mod
+    from ray_tpu.core.rpc import RpcClient
+
+    @ray_tpu.remote
+    def napper():
+        time.sleep(5)
+        return 1
+
+    ref = napper.remote()
+    node = api_mod._local_cluster[1]
+    deadline = time.monotonic() + 30
+    dump = ""
+    while time.monotonic() < deadline:
+        busy = [w for w in node.list_workers() if not w["idle"]]
+        for w in busy:
+            try:
+                wc = RpcClient(tuple(w["addr"]))
+                dump = wc.call("dump_stacks", timeout=10.0)
+                wc.close()
+            except Exception:
+                continue
+            if "napper" in dump:
+                break
+        if "napper" in dump:
+            break
+        time.sleep(0.2)
+    assert "napper" in dump, dump[-2000:]
+    assert ray_tpu.get(ref, timeout=60) == 1
