@@ -110,6 +110,12 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "OOM victim selection: 'retriable_fifo' (newest retriable task "
         "first) or 'group_by_owner' (largest owner's newest task first) "
         "(reference: worker_killing_policy*.cc)."),
+    "lease_spillback_queue_depth": (int, 32,
+        "A node whose lease queue is deeper than this immediately rejects "
+        "new general-pool leases ('spillback') so submitters re-pick "
+        "another node from the controller's fresher view instead of "
+        "queueing behind a stale choice (reference: hybrid policy "
+        "spillback redirects, hybrid_scheduling_policy.cc). 0 disables."),
     "client_session_timeout_s": (float, 60.0,
         "Thin-client sessions with no RPC (incl. keepalive pings) for this "
         "long are reaped server-side — their refs released and unnamed "

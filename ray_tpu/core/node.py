@@ -150,6 +150,7 @@ class Node:
         self._idle: List[WorkerHandle] = []
         self._waiters: List[_LeaseWaiter] = []  # FIFO lease queue
         self._queue_len = 0
+        self._general_queue_len = 0  # waiters on the general (non-PG) pool
         self._death_causes: Dict[bytes, str] = {}
         self._stopped = threading.Event()
 
@@ -216,6 +217,7 @@ class Node:
         dedicated: bool = False,
         runtime_env: Optional[Dict[str, Any]] = None,
         task_meta: Optional[Dict[str, Any]] = None,
+        allow_spillback: bool = True,
     ) -> Dict[str, Any]:
         """Block until resources are free, then hand out a pooled or freshly
         forked worker. Returns {worker_id, addr} or {error}. ``dedicated``
@@ -233,12 +235,25 @@ class Node:
         with self._lock:
             if self._pool_for(bundle) is None:
                 return {"error": f"unknown bundle {bundle}"}
+            depth = config.lease_spillback_queue_depth
+            if (allow_spillback and not dedicated and bundle is None
+                    and depth and self._general_queue_len >= depth):
+                # Instant spillback: the caller re-picks with this node
+                # excluded rather than queueing behind a deep backlog on
+                # the GENERAL pool (bundle waiters don't contend with it)
+                # (reference: hybrid policy spillback redirects).
+                return {"error": f"spillback: lease queue depth "
+                        f"{self._general_queue_len}"}
             self._waiters.append(waiter)
             self._queue_len += 1
+            if bundle is None:
+                self._general_queue_len += 1
             self._drain_waiters_locked()
         granted = waiter.event.wait(timeout)
         with self._lock:
             self._queue_len -= 1
+            if bundle is None:
+                self._general_queue_len -= 1
             if not waiter.granted:
                 # Timed out (or lost a race): withdraw from the queue. The
                 # granted flag is only ever set under this lock, so this

@@ -998,8 +998,8 @@ class TaskSubmitter:
                 # wait out the owner's remaining deadline (genuinely
                 # saturated cluster). Both are clamped to that deadline.
                 remaining = max(0.2, deadline - time.monotonic())
-                patience = (min(5.0, remaining)
-                            if lease_attempts < 2 and bundle is None
+                early_attempt = lease_attempts < 2 and bundle is None
+                patience = (min(5.0, remaining) if early_attempt
                             else remaining)
                 lease_attempts += 1
                 try:
@@ -1011,6 +1011,11 @@ class TaskSubmitter:
                         {"retriable": retries_left > 0
                             and options.get("retry_on_crash", True),
                          "owner": core.node_id.hex()},
+                        # Early attempts may be spillback-rejected by a
+                        # backlogged node (re-pick elsewhere); later
+                        # attempts settle into the queue so a saturated or
+                        # single-node cluster still makes progress.
+                        early_attempt,
                         timeout=config.worker_lease_timeout_s + 10.0)
                 except (RpcError, RemoteCallError, TimeoutError) as e:
                     core.clients.invalidate(tuple(node_addr))

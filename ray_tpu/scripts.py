@@ -150,6 +150,74 @@ def cmd_timeline(args) -> None:
     print(f"wrote {len(trace)} events to {args.output}")
 
 
+def cmd_start(args) -> int:
+    """Bring up cluster daemons from the shell (reference: ``ray start``,
+    ``scripts.py:571``). ``--head`` starts the controller + a head node (+
+    thin-client server unless disabled) and writes the discovery file;
+    without it, a worker node joins ``--address``. Blocks until SIGINT/
+    SIGTERM, then shuts the daemons down."""
+    import signal
+    import threading
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    controller = client_server = None
+    if args.head:
+        from ray_tpu.core.controller import Controller
+
+        controller = Controller(host=args.host, port=args.port,
+                                persist_path=args.persist_path)
+        controller_addr = controller.address
+        write_discovery(controller_addr)
+        print(f"controller: {controller_addr[0]}:{controller_addr[1]}")
+    else:
+        spec = args.worker_address or args.address
+        if not spec:
+            raise SystemExit("worker start needs --address host:port "
+                             "(the head's controller address)")
+        host, _, port = spec.partition(":")
+        controller_addr = (host, int(port))
+
+    from ray_tpu.core.api import _autodetect_tpu
+    from ray_tpu.core.node import Node
+
+    labels: Dict[str, str] = {}
+    _autodetect_tpu(resources, labels)
+    node = Node(controller_addr, resources or None, labels, host=args.host)
+    print(f"node {node.node_id.hex()[:8]}: "
+          f"{node.address[0]}:{node.address[1]} "
+          f"resources={node.total_resources}")
+
+    if args.head and not args.no_client_server:
+        # The head also accepts thin clients (ray-tpu:// connect); this
+        # process is the hosting driver.
+        from ray_tpu import client as client_mod
+        from ray_tpu.core.api import init
+
+        init(address=controller_addr)
+        client_server = client_mod.ClientServer(host=args.host)
+        print(f"client server: ray-tpu://{client_server.address[0]}:"
+              f"{client_server.address[1]}")
+
+    print(f"to connect: ray_tpu.init(address="
+          f"('{controller_addr[0]}', {controller_addr[1]}))")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    print("daemons running; press Ctrl-C to stop")
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        if client_server is not None:
+            client_server.stop()
+        node.stop()
+        if controller is not None:
+            controller.stop()
+    return 0
+
+
 def cmd_stacks(args) -> None:
     """Dump every live worker's Python thread stacks (the py-spy-equivalent
     debugging view, reference: dashboard reporter profiling,
@@ -180,6 +248,46 @@ def cmd_stacks(args) -> None:
         node_client.close()
 
 
+def cmd_job(args) -> int:
+    """Job submission CLI (reference: ``ray job submit/status/logs/stop``,
+    ``dashboard/modules/job/cli.py``)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    addr = resolve_address(args.address)
+    client = JobSubmissionClient(addr)
+    if args.action == "submit":
+        if not args.arg:
+            raise SystemExit("usage: ray_tpu job submit '<entrypoint cmd>'")
+        runtime_env = None
+        if args.working_dir:
+            # Upload so the supervisor can land on ANY host (reference:
+            # ray job submit's working_dir package upload).
+            from ray_tpu.runtime_env import upload_working_dir
+
+            runtime_env = {
+                "working_dir": upload_working_dir(args.working_dir)}
+        job_id = client.submit_job(entrypoint=args.arg,
+                                   runtime_env=runtime_env)
+        print(f"submitted {job_id}")
+        if args.wait:
+            status = client.wait_until_finished(job_id)
+            print(client.get_job_logs(job_id), end="")
+            print(f"job {job_id}: {status}")
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.action == "status":
+        print(client.get_job_status(args.arg))
+    elif args.action == "logs":
+        print(client.get_job_logs(args.arg), end="")
+    elif args.action == "stop":
+        print("stopped" if client.stop_job(args.arg) else "not running")
+    elif args.action == "list":
+        jobs = client.list_jobs()
+        print(_table(
+            [{"job_id": k, **v} for k, v in jobs.items()],
+            ["job_id", "state", "entrypoint"]))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster state CLI")
@@ -195,6 +303,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
     sub.add_parser("stacks")
+    p_start = sub.add_parser("start")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", dest="worker_address", default=None,
+                         help="controller host:port to join (worker mode)")
+    p_start.add_argument("--host", default="127.0.0.1")
+    p_start.add_argument("--port", type=int, default=0,
+                         help="controller port (head only; 0 = ephemeral)")
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--resources", default=None,
+                         help='JSON, e.g. \'{"TPU": 4}\'')
+    p_start.add_argument("--persist-path", default=None,
+                         help="controller state snapshot dir (GCS FT)")
+    p_start.add_argument("--no-client-server", action="store_true")
+    p_job = sub.add_parser("job")
+    p_job.add_argument("action", choices=["submit", "status", "logs",
+                                          "stop", "list"])
+    p_job.add_argument("arg", nargs="?", default=None,
+                       help="entrypoint (submit) or job id")
+    p_job.add_argument("--working-dir", default=None)
+    p_job.add_argument("--wait", action="store_true",
+                       help="submit: block until the job finishes")
     args = parser.parse_args(argv)
     if args.command == "status":
         cmd_status(args)
@@ -204,6 +333,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd_timeline(args)
     elif args.command == "stacks":
         cmd_stacks(args)
+    elif args.command == "start":
+        return cmd_start(args)
+    elif args.command == "job":
+        return cmd_job(args)
     return 0
 
 
