@@ -1,0 +1,129 @@
+"""MFU sweep with honest timing: K steps inside one jitted+donated scan,
+bracketed by a host fetch (block_until_ready under-reports on tunneled
+backends; a scalar fetch forces real completion)."""
+
+import dataclasses
+import functools
+import time
+
+import jax
+import optax
+
+from ray_tpu.models import llama
+from ray_tpu.parallel import train_step as ts
+from ray_tpu.parallel.mesh import MeshSpec
+from ray_tpu.parallel.sharding import axis_rules
+from ray_tpu.tpu import peak_flops_per_chip
+
+mesh = MeshSpec(fsdp=-1).build()
+PEAK = peak_flops_per_chip(getattr(jax.devices()[0], "device_kind", ""))
+K = 8
+
+
+def run(cfg, batch, seq=2048, accum=1):
+    import jax.numpy as jnp
+
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    params = ts.init_sharded_params(lambda k: llama.init_params(cfg, k),
+                                    llama.param_axes(cfg), mesh,
+                                    jax.random.key(0))
+    opt_state = ts.init_optimizer_state(opt, params)
+
+    def body(carry, tokens):
+        p, o = carry
+        with axis_rules(mesh):
+            if accum == 1:
+                loss, grads = jax.value_and_grad(
+                    lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg))(p)
+            else:
+                # Hoist the fp32->bf16 cast out of the microbatch loop and
+                # accumulate fp32 grads (gradient accumulation).
+                pbf = jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if x.dtype == jnp.float32 else x, p)
+                def micro(g_acc, mtoks):
+                    loss, g = jax.value_and_grad(
+                        lambda pp: llama.loss_fn(
+                            pp, {"tokens": mtoks}, cfg))(pbf)
+                    g_acc = jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                    return g_acc, loss
+                g0 = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                                  p)
+                mb = tokens.reshape(accum, tokens.shape[0] // accum,
+                                    tokens.shape[1])
+                grads, losses = jax.lax.scan(micro, g0, mb)
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss = losses.mean()
+            updates, o2 = opt.update(grads, o, p)
+            p2 = optax.apply_updates(p, updates)
+        return (p2, o2), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def multi(params, opt_state, toks):
+        (p, o), losses = jax.lax.scan(body, (params, opt_state), toks)
+        return p, o, losses
+
+    # (K, batch, seq): shard the BATCH axis (axis 1) on the data/fsdp mesh
+    # axes; the scan-step axis K stays replicated.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    toks = jax.device_put(
+        jax.random.randint(jax.random.key(1), (K, batch, seq + 1), 0,
+                           cfg.vocab_size),
+        NamedSharding(mesh, P(None, ("data", "fsdp"), None)))
+    params, opt_state, losses = multi(params, opt_state, toks)
+    _ = float(losses[-1])
+    dt = None
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        params, opt_state, losses = multi(params, opt_state, toks)
+        _ = float(losses[-1])
+        rep = (time.perf_counter() - t0) / K
+        dt = rep if dt is None else min(dt, rep)
+    tps = batch * seq / dt
+    mfu = 100 * tps * llama.flops_per_token(cfg, seq) / PEAK
+    return round(mfu, 2), round(tps), round(dt * 1000, 1)
+
+
+
+import dataclasses
+
+d1152 = llama.LlamaConfig(vocab_size=32000, dim=1152, n_layers=24, n_heads=9,
+                          n_kv_heads=9, mlp_dim=4608, max_seq_len=2048)
+d1280 = llama.LlamaConfig(vocab_size=32000, dim=1280, n_layers=24, n_heads=10,
+                          n_kv_heads=10, mlp_dim=5120, max_seq_len=2048)
+fl = lambda c, **kw: dataclasses.replace(c, attention_impl="flash", **kw)
+CONFIGS = [
+    ("d1280 b3x16 accum16", fl(d1280, loss_chunk=1024, fused_qkv=True,
+        fused_mlp=True, embed_via_matmul=True, embed_chunk=1024), 48, 2048, 16),
+    ("d1280 b4x8 accum8", fl(d1280, loss_chunk=1024, fused_qkv=True,
+        fused_mlp=True, embed_via_matmul=True, embed_chunk=1024), 32, 2048, 8),
+    ("d1536 b3x8 accum8",
+     fl(llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=24,
+                          n_heads=12, n_kv_heads=12, mlp_dim=6144,
+                          max_seq_len=2048),
+        loss_chunk=1024, fused_qkv=True, fused_mlp=True,
+        embed_via_matmul=True, embed_chunk=1024), 24, 2048, 8),
+    ("d1536 b2x8 accum8",
+     fl(llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=24,
+                          n_heads=12, n_kv_heads=12, mlp_dim=6144,
+                          max_seq_len=2048),
+        loss_chunk=1024, fused_qkv=True, fused_mlp=True,
+        embed_via_matmul=True, embed_chunk=1024), 16, 2048, 8),
+]
+
+if __name__ == "__main__":
+    for desc, cfg, b, seq, acc in CONFIGS:
+        for attempt in range(2):
+            try:
+                print(desc, run(cfg, b, seq, acc),
+                      f"params={cfg.num_params()/1e6:.0f}M", flush=True)
+                break
+            except Exception as e:  # noqa: BLE001
+                msg = str(e)[:90].replace("\n", " ")
+                if "remote_compile" in msg and attempt == 0:
+                    print(desc, "retrying after compile-helper 500", flush=True)
+                    continue
+                print(desc, "FAIL", msg, flush=True)
+                break

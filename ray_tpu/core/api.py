@@ -145,6 +145,13 @@ def shutdown() -> None:
             pass
         _log_streamer.stop()
         _log_streamer = None
+    try:
+        # Local-only usage report (reference phones home; we never do).
+        from ray_tpu import usage as _usage
+
+        _usage.write_report()
+    except Exception:
+        pass
     if _config_snapshot is not None:
         # _system_config overrides are scoped to the init()..shutdown() span;
         # restore so a later init() in the same process starts clean.

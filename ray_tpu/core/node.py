@@ -662,6 +662,9 @@ class Node:
             } for h in self._workers.values() if h.addr is not None]
 
     def get_info(self) -> Dict[str, Any]:
+        # Disk scan outside the scheduling lock: an observability RPC must
+        # never stall lease/return paths behind slow IO.
+        spilled = self._spilled_bytes()
         with self._lock:
             return {
                 "node_id": self.node_id.hex(),
@@ -673,7 +676,23 @@ class Node:
                 "num_idle": len(self._idle),
                 "num_oom_kills": (self.memory_monitor.total_kills
                                   if self.memory_monitor else 0),
+                "store_used_bytes": self._shm.used_bytes(),
+                "store_capacity_bytes": self._shm.capacity(),
+                "spilled_bytes": spilled,
             }
+
+    def _spilled_bytes(self) -> int:
+        total = 0
+        try:
+            with os.scandir(spill_dir(self.node_id)) as it:
+                for entry in it:
+                    try:
+                        total += entry.stat().st_size
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return total
 
     def stop(self) -> None:
         self._stopped.set()

@@ -6,10 +6,14 @@ from ray_tpu.data.dataset import (  # noqa: F401
     GroupedData,
 )
 from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
     from_items,
     from_numpy,
+    from_pandas,
     range,
     read_csv,
     read_json,
+    read_numpy,
     read_parquet,
+    read_text,
 )

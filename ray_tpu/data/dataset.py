@@ -652,15 +652,21 @@ class Dataset:
     def num_blocks(self) -> int:
         return len(self._block_refs)
 
-    def write_parquet(self, path: str) -> List[str]:
-        """Write one parquet file per block via tasks (reference:
-        ``Dataset.write_parquet``); returns the written paths."""
+    def _write_blocks(self, path: str, ext: str, write_block) -> List[str]:
+        """One output file per block via tasks (the shared write fan-out
+        behind write_parquet/csv/json); returns the written paths."""
         import os
 
         os.makedirs(path, exist_ok=True)
         mat = self.materialize()
+        task = ray_tpu.remote(write_block)
+        refs = [task.remote(r, os.path.join(path, f"part-{i:05d}.{ext}"))
+                for i, r in enumerate(mat._block_refs)]
+        return ray_tpu.get(refs)
 
-        @ray_tpu.remote
+    def write_parquet(self, path: str) -> List[str]:
+        """Write one parquet file per block via tasks (reference:
+        ``Dataset.write_parquet``); returns the written paths."""
         def write_one(block: Block, out_path: str) -> str:
             import pyarrow as pa
             import pyarrow.parquet as pq
@@ -668,9 +674,40 @@ class Dataset:
             pq.write_table(pa.table(dict(block)), out_path)
             return out_path
 
-        refs = [write_one.remote(r, os.path.join(path, f"part-{i:05d}.parquet"))
-                for i, r in enumerate(mat._block_refs)]
-        return ray_tpu.get(refs)
+        return self._write_blocks(path, "parquet", write_one)
+
+    def write_csv(self, path: str) -> List[str]:
+        """One CSV file per block via tasks (reference:
+        ``Dataset.write_csv``)."""
+        def write_one(block: Block, out_path: str) -> str:
+            import csv
+
+            cols = list(block.keys())
+            with open(out_path, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(cols)
+                for i in range(_block_len(block)):
+                    w.writerow([block[c][i] for c in cols])
+            return out_path
+
+        return self._write_blocks(path, "csv", write_one)
+
+    def write_json(self, path: str) -> List[str]:
+        """One JSON-lines file per block via tasks (reference:
+        ``Dataset.write_json``)."""
+        def write_one(block: Block, out_path: str) -> str:
+            import json
+
+            cols = list(block.keys())
+            with open(out_path, "w") as f:
+                for i in range(_block_len(block)):
+                    row = {c: block[c][i] for c in cols}
+                    f.write(json.dumps(
+                        {k: (v.item() if hasattr(v, "item") else v)
+                         for k, v in row.items()}) + "\n")
+            return out_path
+
+        return self._write_blocks(path, "json", write_one)
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by whole blocks."""

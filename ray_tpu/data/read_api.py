@@ -82,3 +82,36 @@ def read_json(paths) -> Dataset:
         return _rows_to_block(rows)
 
     return _read_files(paths, reader)
+
+
+def read_text(paths) -> Dataset:
+    """One row per line, column ``text`` (reference: ``read_text``)."""
+    def reader(path: str):
+        with open(path) as f:
+            lines = [line.rstrip("\r\n") for line in f]  # CRLF-safe
+        return {"text": np.asarray(lines, dtype=object)}
+
+    return _read_files(paths, reader)
+
+
+def read_numpy(paths, column: str = "data") -> Dataset:
+    """One .npy file per block (reference: ``read_numpy``)."""
+    def reader(path: str):
+        return {column: np.load(path, allow_pickle=False)}
+
+    return _read_files(paths, reader)
+
+
+def from_pandas(df, num_blocks: int = 8) -> Dataset:
+    """A pandas DataFrame -> column-block Dataset (reference:
+    ``from_pandas``)."""
+    return from_numpy({c: df[c].to_numpy() for c in df.columns},
+                      num_blocks=num_blocks)
+
+
+def from_arrow(table, num_blocks: int = 8) -> Dataset:
+    """A pyarrow Table -> column-block Dataset (reference:
+    ``from_arrow``)."""
+    return from_numpy(
+        {name: table[name].to_numpy(zero_copy_only=False)
+         for name in table.column_names}, num_blocks=num_blocks)

@@ -74,6 +74,39 @@ def cmd_status(args) -> None:
     print(f"available: {avail}")
 
 
+def cmd_memory(args) -> None:
+    """Per-node object-store usage (reference: ``ray memory`` /
+    object-store columns of ``ray status``): shared-memory segment used /
+    capacity plus bytes spilled to disk, live from each node supervisor."""
+    from ray_tpu.core.rpc import RpcClient
+
+    client = _client(args)
+    rows = []
+    for n in client.call("list_nodes"):
+        if not n.get("alive"):
+            continue
+        try:
+            nc = RpcClient(tuple(n["addr"]))
+            info = nc.call("get_info")
+            nc.close()
+        except Exception as e:
+            rows.append({"node": n["node_id"][:12],
+                         "store_used": f"unreachable: {e}"})
+            continue
+        used = info.get("store_used_bytes", 0)
+        cap = info.get("store_capacity_bytes", 0) or 1
+        rows.append({
+            "node": info["node_id"][:12],
+            "store_used": f"{used / 1e6:.1f} MB",
+            "capacity": f"{cap / 1e6:.1f} MB",
+            "util": f"{100 * used / cap:.1f}%",
+            "spilled": f"{info.get('spilled_bytes', 0) / 1e6:.1f} MB",
+            "workers": info.get("num_workers", 0),
+        })
+    print(_table(rows, ["node", "store_used", "capacity", "util",
+                        "spilled", "workers"]))
+
+
 def cmd_list(args) -> None:
     client = _client(args)
     kind = args.kind
@@ -177,6 +210,9 @@ def cmd_start(args) -> int:
             raise SystemExit("worker start needs --address host:port "
                              "(the head's controller address)")
         host, _, port = spec.partition(":")
+        if not port.isdigit():
+            raise SystemExit(f"malformed --address {spec!r}: "
+                             f"expected host:port")
         controller_addr = (host, int(port))
 
     from ray_tpu.core.api import _autodetect_tpu
@@ -303,6 +339,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
     sub.add_parser("stacks")
+    sub.add_parser("memory")
     p_start = sub.add_parser("start")
     p_start.add_argument("--head", action="store_true")
     p_start.add_argument("--address", dest="worker_address", default=None,
@@ -333,6 +370,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd_timeline(args)
     elif args.command == "stacks":
         cmd_stacks(args)
+    elif args.command == "memory":
+        cmd_memory(args)
     elif args.command == "start":
         return cmd_start(args)
     elif args.command == "job":

@@ -27,6 +27,9 @@ def run(app: Deployment, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
         ready_timeout_s: float = 60.0) -> DeploymentHandle:
     """Deploy (or redeploy) an application; returns its handle."""
+    from ray_tpu import usage as _usage
+
+    _usage.record_feature("serve.run")
     name = name or app.name
     controller = get_or_create_controller()
     version = ray_tpu.get(controller.deploy.remote(

@@ -57,6 +57,9 @@ class JaxTrainer:
         self._name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
 
     def fit(self) -> Result:
+        from ray_tpu import usage as _usage
+
+        _usage.record_feature("train.JaxTrainer")
         max_failures = self.run_config.failure_config.max_failures
         attempts = 0
         latest_checkpoint: Optional[str] = None

@@ -141,3 +141,33 @@ def test_spillback_rejects_deep_queue(ray_start_cluster):
         assert len(set(nodes)) == 2, set(nodes)
     finally:
         config.update({"lease_spillback_queue_depth": old})
+
+
+def test_memory_cli_and_usage_report(ray_start_regular):
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu import usage
+    from ray_tpu.core import api as api_mod
+    from ray_tpu.scripts import main as cli_main
+
+    # Put something sizable so store usage is visible.
+    ref = ray_tpu.put(np.ones(500_000))
+    ctrl = api_mod._local_cluster[0]
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["--address",
+                       f"{ctrl.address[0]}:{ctrl.address[1]}", "memory"])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "store_used" in out and "MB" in out
+
+    usage.record_feature("test.feature")
+    path = usage.write_report()
+    assert path
+    import json
+
+    report = json.load(open(path))
+    assert "test.feature" in report["features"]
+    assert report["nodes"] == 1
+    del ref
