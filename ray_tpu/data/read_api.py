@@ -35,6 +35,8 @@ def range(n: int, num_blocks: int = 8) -> Dataset:
 
 
 def from_numpy(arrays: Dict[str, np.ndarray], num_blocks: int = 8) -> Dataset:
+    if not arrays:
+        return Dataset([])
     n = len(next(iter(arrays.values())))
     per = max(1, math.ceil(n / num_blocks))
     refs = [ray_tpu.put({k: v[i:i + per] for k, v in arrays.items()})

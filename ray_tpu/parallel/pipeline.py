@@ -1,0 +1,126 @@
+"""Pipeline parallelism: model-stage splitting for compiled actor pipelines.
+
+SURVEY §2.4 PP row: the reference has no native PP — its compiled DAGs
+(``dag/compiled_dag_node.py:389``) are the intended substrate. Here the
+substrate exists (``ray_tpu.dag`` compiled stage pipelines with direct
+actor-to-actor pushes over the shm store), and this module supplies the
+model half: split a stacked-layer transformer's params into contiguous
+stage slices with pure, jittable per-stage functions. Stage actors each
+jit THEIR slice only (intra-stage parallelism still comes from the mesh;
+PP composes on top as host-level microbatch pipelining — the GPipe
+schedule emerges from the DAG's bounded in-flight window).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def stage_boundaries(n_layers: int, n_stages: int) -> List[Tuple[int, int]]:
+    """Contiguous [start, end) layer ranges, balanced like np.array_split."""
+    sizes = [len(part) for part in np.array_split(np.arange(n_layers),
+                                                  n_stages)]
+    bounds, start = [], 0
+    for size in sizes:
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def llama_stage_fn(config, first: bool, last: bool) -> Callable:
+    """Pure jittable fn for one Llama pipeline stage: ``fn(stage_params,
+    x)``. Stage 0 takes token ids and embeds; middle stages map hidden
+    states; the last stage adds final norm + LM head (fp32 logits)."""
+    from ray_tpu.models.llama import (
+        _decoder_layer,
+        _embed_matmul,
+        rms_norm,
+        rope_frequencies,
+    )
+
+    c = config
+
+    def stage_fn(p, x):
+        if first:
+            if c.embed_via_matmul:
+                h = _embed_matmul(p["tok_embed"].astype(c.dtype), x,
+                                  chunk=c.embed_chunk)
+            else:
+                h = p["tok_embed"].astype(c.dtype)[x]
+        else:
+            h = x.astype(c.dtype)
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+
+        def body(carry, layer):
+            y, _ = _decoder_layer(c, carry, layer, cos, sin, 0)
+            return y, None
+
+        if c.remat:
+            # Same remat policy as hidden_states: without it, training
+            # through a stage materializes every per-layer activation —
+            # OOM at exactly the sizes PP exists for.
+            policy = None
+            if c.remat_policy == "dots":
+                policy = (jax.checkpoint_policies
+                          .dots_with_no_batch_dims_saveable)
+            elif c.remat_policy == "names":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "mlp_hidden")
+            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+        h, _ = jax.lax.scan(body, h, p["layers"])
+        if last:
+            h = rms_norm(h, p["final_norm"], c.norm_eps)
+            return jnp.einsum("bse,ev->bsv", h,
+                              p["lm_head"].astype(c.dtype),
+                              preferred_element_type=jnp.float32)
+        return h
+
+    return stage_fn
+
+
+def split_llama_stages(params: Dict[str, Any], config,
+                       n_stages: int) -> List[Tuple[Dict[str, Any],
+                                                    Callable]]:
+    """Split Llama params into ``n_stages`` contiguous-layer pipeline
+    stages (Megatron/GPipe layout). Returns [(stage_params, stage_fn)];
+    each fn is pure and jittable in isolation — exactly what a DAG
+    ``_PipeStage`` actor hosts."""
+    bounds = stage_boundaries(config.n_layers, n_stages)
+    stages: List[Tuple[Dict[str, Any], Callable]] = []
+    for idx, (start, end) in enumerate(bounds):
+        first, last = idx == 0, idx == n_stages - 1
+        stage_params: Dict[str, Any] = {
+            "layers": jax.tree.map(lambda x: x[start:end],
+                                   params["layers"])}
+        if first:
+            stage_params["tok_embed"] = params["tok_embed"]
+        if last:
+            stage_params["final_norm"] = params["final_norm"]
+            stage_params["lm_head"] = params["lm_head"]
+        stages.append((stage_params, llama_stage_fn(config, first, last)))
+    return stages
+
+
+def make_stage_worker(config, stage_index: int, n_stages: int,
+                      stage_params: Dict[str, Any]) -> Callable:
+    """A host-callable closure for one pipeline stage, jitted lazily in
+    the hosting actor process — hand this to a DAG stage. numpy in/out so
+    microbatch payloads ride the object store between stage actors."""
+    state: Dict[str, Any] = {"params": stage_params}
+
+    def call(x):
+        if "jitted" not in state:
+            import functools
+
+            fn = llama_stage_fn(config, stage_index == 0,
+                                stage_index == n_stages - 1)
+            device_params = jax.tree.map(jnp.asarray, state["params"])
+            state["params"] = None  # free the host copy of the weights
+            state["jitted"] = jax.jit(functools.partial(fn, device_params))
+        return np.asarray(state["jitted"](jnp.asarray(x)))
+
+    return call
