@@ -1,0 +1,26 @@
+"""Distributed data pipeline: read -> transform -> shuffle -> groupby.
+
+Run: python examples/data_pipeline.py
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=8)
+    rng = np.random.default_rng(0)
+    ds = rdata.from_numpy({
+        "user": rng.integers(0, 5, 10_000),
+        "value": rng.normal(size=10_000),
+    }, num_blocks=8)
+
+    result = (ds
+              .filter(lambda r: r["value"] > 0)
+              .random_shuffle(seed=0)
+              .groupby("user")
+              .mean("value"))
+    for row in result.sort("user").iter_rows():
+        print(f"user {int(row['user'])}: mean {row['mean(value)']:.4f}")
+    ray_tpu.shutdown()

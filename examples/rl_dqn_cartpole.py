@@ -1,0 +1,23 @@
+"""DQN on CartPole with prioritized replay.
+
+Run: python examples/rl_dqn_cartpole.py
+"""
+
+import ray_tpu
+from ray_tpu.rl import DQNConfig
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=8)
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(2, num_envs_per_runner=4)
+            .training(rollout_length=64, prioritized_replay=True,
+                      learning_starts=500)).build()
+    for i in range(10):
+        m = algo.train()
+        ret = m.get("episode_return_mean")
+        print(f"iter {m['training_iteration']}: steps={m['env_steps_total']}"
+              f" eps={m['epsilon']:.2f}"
+              + (f" return={ret:.1f}" if ret else ""))
+    algo.stop()
+    ray_tpu.shutdown()

@@ -1,0 +1,48 @@
+"""Train a Llama model with FSDP+TP sharding through JaxTrainer.
+
+Run: python examples/train_llama_fsdp.py
+(On a multi-chip host the mesh spans all local devices; on CPU it uses
+whatever XLA_FLAGS --xla_force_host_platform_device_count provides.)
+"""
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, ScalingConfig
+
+
+def train_loop(config):
+    import jax
+    import optax
+
+    from ray_tpu import train
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    cfg = llama.PRESETS[config.get("preset", "debug")]
+    mesh = MeshSpec(fsdp=-1).build()
+    params = ts.init_sharded_params(
+        lambda k: llama.init_params(cfg, k), llama.param_axes(), mesh,
+        jax.random.key(0))
+    opt = optax.adamw(config.get("lr", 1e-3))
+    opt_state = ts.init_optimizer_state(opt, params)
+    step_fn = ts.build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh)
+
+    for step in range(config.get("steps", 5)):
+        tokens = jax.random.randint(
+            jax.random.key(step), (8, 33), 0, cfg.vocab_size)
+        batch = ts.shard_batch({"tokens": tokens}, mesh)
+        params, opt_state, metrics = step_fn(params, opt_state, batch)
+        train.report({"loss": float(metrics["loss"]), "step": step})
+
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=8)
+    result = JaxTrainer(
+        train_loop,
+        train_loop_config={"steps": 5},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+    ).fit()
+    print("final:", result.metrics)
+    ray_tpu.shutdown()

@@ -1,0 +1,37 @@
+"""Hyperparameter sweep with the native TPE searcher + ASHA early stopping.
+
+Run: python examples/tune_tpe_sweep.py
+"""
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, TPESearcher, TuneConfig, Tuner
+
+
+def trainable(config):
+    # A fake training curve: quality depends on lr; improves per step.
+    import math
+
+    from ray_tpu import train
+
+    quality = (math.log10(config["lr"]) + 3) ** 2
+    for step in range(10):
+        train.report({"loss": quality + 1.0 / (step + 1)})
+
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=8)
+    grid = Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=12,
+            max_concurrent_trials=3,
+            search_alg=TPESearcher(n_startup_trials=4),
+            scheduler=ASHAScheduler(metric="loss", mode="min", max_t=10,
+                                    grace_period=2),
+        ),
+    ).fit()
+    best = grid.get_best_result()
+    print(f"best lr={best.config['lr']:.2e} loss={best.metrics['loss']:.3f}")
+    ray_tpu.shutdown()

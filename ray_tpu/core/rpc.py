@@ -144,7 +144,14 @@ class RpcServer:
                 if msg.get("method") in self._inline:
                     self._handle(conn, send_lock, msg)
                 else:
-                    self._pool.submit(self._handle, conn, send_lock, msg)
+                    try:
+                        self._pool.submit(self._handle, conn, send_lock, msg)
+                    except RuntimeError:
+                        # Pool shut down while a request was in flight
+                        # (server stopping): drop the request quietly.
+                        if self._stopped.is_set():
+                            break
+                        raise
         except (ConnectionError, OSError):
             pass
         finally:

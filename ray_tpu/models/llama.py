@@ -311,6 +311,18 @@ def _embed_matmul(table: jax.Array, tokens: jax.Array,
     return out.reshape(b, s, e)
 
 
+def remat_wrap(body, config: LlamaConfig):
+    """Apply the config's remat policy to a scan body (shared by the full
+    model and pipeline stages so policies never diverge)."""
+    policy = None
+    if config.remat_policy == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif config.remat_policy == "names":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_hidden")
+    return jax.checkpoint(body, prevent_cse=False, policy=policy)
+
+
 def hidden_states(params: Dict[str, Any], tokens: jax.Array,
                   config: LlamaConfig) -> jax.Array:
     """Token ids (B, S) -> final-norm hidden states (B, S, E)."""
@@ -329,13 +341,7 @@ def hidden_states(params: Dict[str, Any], tokens: jax.Array,
         return (x, aux_sum + aux), None
 
     if c.remat:
-        policy = None
-        if c.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        elif c.remat_policy == "names":
-            policy = jax.checkpoint_policies.save_only_these_names(
-                "attn_out", "mlp_hidden")
-        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+        body = remat_wrap(body, c)
     (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                                    params["layers"])
     return rms_norm(x, params["final_norm"], c.norm_eps), aux_sum

@@ -60,17 +60,12 @@ def llama_stage_fn(config, first: bool, last: bool) -> Callable:
             return y, None
 
         if c.remat:
-            # Same remat policy as hidden_states: without it, training
-            # through a stage materializes every per-layer activation —
-            # OOM at exactly the sizes PP exists for.
-            policy = None
-            if c.remat_policy == "dots":
-                policy = (jax.checkpoint_policies
-                          .dots_with_no_batch_dims_saveable)
-            elif c.remat_policy == "names":
-                policy = jax.checkpoint_policies.save_only_these_names(
-                    "attn_out", "mlp_hidden")
-            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+            # Same remat policy as hidden_states (shared helper): without
+            # it, training through a stage materializes every per-layer
+            # activation — OOM at exactly the sizes PP exists for.
+            from ray_tpu.models.llama import remat_wrap
+
+            body = remat_wrap(body, c)
         h, _ = jax.lax.scan(body, h, p["layers"])
         if last:
             h = rms_norm(h, p["final_norm"], c.norm_eps)

@@ -85,14 +85,19 @@ def cmd_memory(args) -> None:
     for n in client.call("list_nodes"):
         if not n.get("alive"):
             continue
+        # Same per-node poll as ray_tpu.util.state.node_infos, but over the
+        # CLI's standalone controller connection (no core worker here).
+        nc = None
         try:
             nc = RpcClient(tuple(n["addr"]))
             info = nc.call("get_info")
-            nc.close()
         except Exception as e:
             rows.append({"node": n["node_id"][:12],
                          "store_used": f"unreachable: {e}"})
             continue
+        finally:
+            if nc is not None:
+                nc.close()
         used = info.get("store_used_bytes", 0)
         cap = info.get("store_capacity_bytes", 0) or 1
         rows.append({

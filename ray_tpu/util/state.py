@@ -1,0 +1,92 @@
+"""Python state API (reference: ``ray.util.state`` — ``api.py``:
+``list_nodes/list_actors/list_tasks/list_jobs/summarize_tasks``).
+
+The CLI (``python -m ray_tpu list ...``) and dashboard share these same
+controller RPCs; this module is the in-process Python surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _controller():
+    from ray_tpu.core.runtime import get_core_worker
+
+    return get_core_worker().controller
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _controller().call("list_nodes")
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _controller().call("list_actors")
+
+
+def list_jobs() -> Dict[str, Dict[str, Any]]:
+    return _controller().call("list_jobs")
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Recent task state-transition events (FINISHED/FAILED/SPAN...)."""
+    return _controller().call("list_task_events", limit)
+
+
+def node_infos() -> List[Dict[str, Any]]:
+    """Live node-supervisor ``get_info`` for every alive node (shared by
+    ``list_objects`` and the ``memory`` CLI). Unreachable nodes yield an
+    ``{"error": ...}`` entry rather than disappearing."""
+    from ray_tpu.core.rpc import RpcClient
+
+    out = []
+    for n in list_nodes():
+        if not n.get("alive"):
+            continue
+        client = None
+        try:
+            client = RpcClient(tuple(n["addr"]))
+            out.append(client.call("get_info"))
+        except Exception as e:
+            out.append({"node_id": n["node_id"], "error": str(e)})
+        finally:
+            if client is not None:
+                client.close()
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Per-node object-store occupancy (the object-level listing the
+    reference offers is owner-distributed; store totals are the
+    cluster-level view)."""
+    return [{
+        "node_id": info["node_id"],
+        "store_used_bytes": info.get("store_used_bytes", 0),
+        "store_capacity_bytes": info.get("store_capacity_bytes", 0),
+        "spilled_bytes": info.get("spilled_bytes", 0),
+    } for info in node_infos() if "error" not in info]
+
+
+def summarize_tasks(limit: int = 10000) -> Dict[str, Any]:
+    """Counts by (desc, state) — reference: ``ray summary tasks``."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for e in list_tasks(limit):
+        desc = e.get("desc") or e.get("task_id", "?")[:8]
+        states = summary.setdefault(desc, {})
+        state = e.get("state", "?")
+        states[state] = states.get(state, 0) + 1
+    return {"by_task": summary,
+            "total": sum(sum(s.values()) for s in summary.values())}
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _controller().call("cluster_resources")
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in list_nodes():
+        if n.get("alive"):
+            for k, v in n.get("available", {}).items():
+                total[k] = total.get(k, 0.0) + v
+    return total

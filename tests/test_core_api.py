@@ -127,3 +127,29 @@ def test_large_object_roundtrip(ray_start_regular):
     arr = np.random.rand(1 << 20)  # 8 MB
     out = ray_tpu.get(echo.remote(arr))
     np.testing.assert_array_equal(arr, out)
+
+
+def test_util_state_api(ray_start_regular):
+    """Python state surface (reference: ray.util.state api.py)."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    assert ray_tpu.get(touch.remote()) == 1
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    objs = state.list_objects()
+    assert objs and objs[0]["store_capacity_bytes"] > 0
+    assert state.cluster_resources()["CPU"] == 4.0
+    assert state.available_resources()["CPU"] <= 4.0
+    # Task events land asynchronously; summarize sees them eventually.
+    import time as _t
+
+    deadline = _t.monotonic() + 30
+    total = 0
+    while total == 0 and _t.monotonic() < deadline:
+        total = state.summarize_tasks()["total"]
+        _t.sleep(0.2)
+    assert total >= 1
