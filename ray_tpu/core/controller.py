@@ -412,6 +412,14 @@ class Controller:
                 return None
             self._pending_demand.pop(shape_key, None)
 
+            def rank(r):
+                return (_utilization(r), r.queue_len, r.node_id.binary())
+
+            def prefer_room(pool):
+                with_room = [r for r in pool
+                             if resmath.fits(r.available, resources)]
+                return with_room or pool
+
             kind = strategy.get("kind", "hybrid")
             if kind == "node_affinity":
                 target = NodeID.from_hex(strategy["node_id"])
@@ -421,9 +429,33 @@ class Controller:
                 if not strategy.get("soft", False):
                     return None
             elif kind == "spread":
-                feasible.sort(key=lambda r: (_utilization(r), r.queue_len,
-                                             r.node_id.binary()))
-                return self._grant(feasible[0], resources)
+                return self._grant(min(feasible, key=rank), resources)
+            elif kind == "node_label":
+                # Label policy (reference:
+                # node_label_scheduling_policy.cc): hard constraints must
+                # all match; soft labels prefer matching nodes but fall
+                # back to any hard-matching node. Nodes with room now beat
+                # lower-utilization nodes that are currently full.
+                hard = strategy.get("labels") or {}
+                soft = strategy.get("soft_labels") or {}
+                matching = [r for r in feasible
+                            if all(r.labels.get(k) == v
+                                   for k, v in hard.items())]
+                if not matching:
+                    return None
+                preferred = [r for r in matching
+                             if all(r.labels.get(k) == v
+                                    for k, v in soft.items())]
+                pool = prefer_room(preferred or matching)
+                return self._grant(min(pool, key=rank), resources)
+            elif kind == "random":
+                # Random policy (reference: random_scheduling_policy.cc):
+                # uniform over feasible nodes with room (load-oblivious
+                # scatter for e.g. monitoring tasks).
+                import random as _random
+
+                return self._grant(_random.choice(prefer_room(feasible)),
+                                   resources)
 
             # Hybrid: local-first below the spread threshold.
             if caller_node_id is not None:
@@ -433,11 +465,8 @@ class Controller:
                             and _utilization(r) < config.scheduler_spread_threshold
                             and resmath.fits(r.available, resources)):
                         return self._grant(r, resources)
-            with_room = [r for r in feasible
-                         if resmath.fits(r.available, resources)]
-            pool = with_room or feasible
-            pool.sort(key=lambda r: (_utilization(r), r.queue_len,
-                                     r.node_id.binary()))
+            pool = prefer_room(feasible)
+            pool = sorted(pool, key=rank)
             return self._grant(pool[0], resources)
 
     def _grant(self, rec: NodeRecord, resources: Dict[str, float]):

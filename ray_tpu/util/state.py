@@ -58,13 +58,21 @@ def node_infos() -> List[Dict[str, Any]]:
 def list_objects() -> List[Dict[str, Any]]:
     """Per-node object-store occupancy (the object-level listing the
     reference offers is owner-distributed; store totals are the
-    cluster-level view)."""
-    return [{
-        "node_id": info["node_id"],
-        "store_used_bytes": info.get("store_used_bytes", 0),
-        "store_capacity_bytes": info.get("store_capacity_bytes", 0),
-        "spilled_bytes": info.get("spilled_bytes", 0),
-    } for info in node_infos() if "error" not in info]
+    cluster-level view). Unreachable nodes appear with an ``error`` field
+    so capacity sums don't silently shrink."""
+    out = []
+    for info in node_infos():
+        if "error" in info:
+            out.append({"node_id": info["node_id"],
+                        "error": info["error"]})
+        else:
+            out.append({
+                "node_id": info["node_id"],
+                "store_used_bytes": info.get("store_used_bytes", 0),
+                "store_capacity_bytes": info.get("store_capacity_bytes", 0),
+                "spilled_bytes": info.get("spilled_bytes", 0),
+            })
+    return out
 
 
 def summarize_tasks(limit: int = 10000) -> Dict[str, Any]:
