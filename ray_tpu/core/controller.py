@@ -442,11 +442,20 @@ class Controller:
                             if all(r.labels.get(k) == v
                                    for k, v in hard.items())]
                 if not matching:
+                    # Label-blocked: keep the demand visible to operators
+                    # and the autoscaler (popped above on feasibility).
+                    self._pending_demand[shape_key] = (dict(resources),
+                                                       time.monotonic())
                     return None
                 preferred = [r for r in matching
                              if all(r.labels.get(k) == v
                                     for k, v in soft.items())]
-                pool = prefer_room(preferred or matching)
+                # Having room outranks soft-label preference: a full
+                # soft-match must not beat an idle hard-match.
+                with_room = [r for r in matching
+                             if resmath.fits(r.available, resources)]
+                pool = ([r for r in preferred if r in with_room]
+                        or with_room or preferred or matching)
                 return self._grant(min(pool, key=rank), resources)
             elif kind == "random":
                 # Random policy (reference: random_scheduling_policy.cc):

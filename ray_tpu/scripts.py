@@ -329,6 +329,31 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Declarative serve operations (reference: ``serve deploy/status/
+    shutdown`` CLI, ``serve/scripts.py``)."""
+    import json as _json
+
+    import ray_tpu
+
+    ray_tpu.init(address=resolve_address(args.address))
+    from ray_tpu import serve
+
+    if args.action == "deploy":
+        if not args.config:
+            raise SystemExit("usage: ray_tpu serve deploy config.yaml")
+        from ray_tpu.serve.build import deploy_config
+
+        handles = deploy_config(args.config)
+        print(f"deployed {len(handles)} application(s)")
+    elif args.action == "status":
+        print(_json.dumps(serve.status(), indent=2, default=str))
+    elif args.action == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster state CLI")
@@ -358,6 +383,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_start.add_argument("--persist-path", default=None,
                          help="controller state snapshot dir (GCS FT)")
     p_start.add_argument("--no-client-server", action="store_true")
+    p_serve = sub.add_parser("serve")
+    p_serve.add_argument("action", choices=["deploy", "status", "shutdown"])
+    p_serve.add_argument("config", nargs="?", default=None,
+                         help="config.yaml (deploy)")
     p_job = sub.add_parser("job")
     p_job.add_argument("action", choices=["submit", "status", "logs",
                                           "stop", "list"])
@@ -381,6 +410,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_start(args)
     elif args.command == "job":
         return cmd_job(args)
+    elif args.command == "serve":
+        return cmd_serve(args)
     return 0
 
 

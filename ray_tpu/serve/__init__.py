@@ -9,6 +9,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.build import deploy_config  # noqa: F401
 from ray_tpu.serve.deployment import (  # noqa: F401
     AutoscalingConfig,
     Deployment,

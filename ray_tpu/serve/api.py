@@ -35,6 +35,10 @@ def run(app: Deployment, name: Optional[str] = None,
     version = ray_tpu.get(controller.deploy.remote(
         name, serialization.dumps_function(app.cls), app._init_args,
         app._init_kwargs, app.config_dict()), timeout=ready_timeout_s)
+    # HTTP route: explicit prefix, or /<name> by default. Stored on the
+    # controller so proxies in ANY process resolve it.
+    ray_tpu.get(controller.set_route.remote(
+        route_prefix or f"/{name}", name), timeout=30.0)
     handle = DeploymentHandle(name)
     router = _Router.get(name)
     if version is not None:
@@ -73,10 +77,39 @@ def shutdown() -> None:
         _http_server = None
 
 
+def _resolve_route(path: str) -> Optional[str]:
+    """Longest-prefix route lookup against the controller's route table
+    (cached briefly; the proxy may live in any process)."""
+    global _routes_cache
+    now = time.monotonic()
+    if _routes_cache is None or now - _routes_cache[0] > 2.0:
+        try:
+            controller = get_or_create_controller()
+            routes = ray_tpu.get(controller.get_routes.remote(),
+                                 timeout=10.0)
+            _routes_cache = (now, routes)
+        except Exception:
+            routes = {} if _routes_cache is None else _routes_cache[1]
+    else:
+        routes = _routes_cache[1]
+    path = "/" + path.strip("/")
+    best = None
+    for prefix, name in routes.items():
+        if path == prefix or path.startswith(prefix + "/"):
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, name)
+    return best[1] if best else None
+
+
+_routes_cache = None
+
+
 class _ProxyHandler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 (stdlib API)
         parts = self.path.strip("/").split("/")
-        name = parts[0]
+        # Route table first (supports custom route_prefix); fall back to
+        # the first path segment as the app name.
+        name = _resolve_route(self.path) or parts[0]
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b"null"
         model_id = self.headers.get("serve_multiplexed_model_id", "")

@@ -55,6 +55,7 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, DeploymentRecord] = {}
         self._last_models: Dict[str, Any] = {}
+        self._routes: Dict[str, str] = {}  # HTTP route prefix -> app name
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reconciler = threading.Thread(
@@ -171,7 +172,21 @@ class ServeController:
                 for name, rec in self._deployments.items()
             }
 
+    def set_route(self, prefix: str, name: str) -> None:
+        """Register an HTTP route prefix for an application (reference:
+        route_prefix in serve deployments; the proxy resolves by longest
+        matching prefix)."""
+        with self._lock:
+            self._routes[prefix.rstrip("/") or "/"] = name
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
     def delete(self, name: str) -> None:
+        with self._lock:
+            self._routes = {p: n for p, n in self._routes.items()
+                            if n != name}
         with self._lock:
             rec = self._deployments.pop(name, None)
             if rec is not None:

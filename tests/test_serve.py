@@ -331,3 +331,78 @@ def test_jitted_llama_replica_with_bucketed_batching(serve_cluster):
     buckets = handle.options(method_name="buckets").remote(None).result(
         timeout=60)
     assert set(buckets) <= {2, 4, 8}, buckets  # only bucket shapes compiled
+
+
+def test_deploy_from_config_file(ray_start_regular, tmp_path):
+    """Declarative deployment from a YAML config (reference: serve deploy
+    config.yaml / ServeDeploySchema)."""
+    import sys
+    import textwrap
+
+    from ray_tpu.serve.build import deploy_config
+
+    mod = tmp_path / "my_app_mod.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Greeter:
+            def __init__(self, greeting="hi"):
+                self.greeting = greeting
+
+            def __call__(self, name):
+                return f"{self.greeting}, {name}!"
+
+        app = Greeter
+    """))
+    cfg = tmp_path / "serve_config.yaml"
+    cfg.write_text(textwrap.dedent("""
+        applications:
+          - name: greeter
+            import_path: my_app_mod:app
+            num_replicas: 2
+            init_kwargs:
+              greeting: hello
+    """))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        handles = deploy_config(str(cfg))
+        assert len(handles) == 1
+        assert handles[0].remote("tpu").result(timeout=60) == "hello, tpu!"
+        from ray_tpu import serve
+
+        st = serve.status()
+        assert "greeter" in st
+        serve.shutdown()
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+def test_http_route_prefix(ray_start_regular):
+    """Custom route_prefix routes through the HTTP proxy (longest-prefix
+    match against the controller's route table)."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Sum:
+        def __call__(self, xs):
+            return {"total": sum(xs)}
+
+    serve.run(Sum.bind(), name="summer", route_prefix="/api/v1/sum")
+    host, port = serve.start_http()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/api/v1/sum",
+        data=json.dumps([1, 2, 3]).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.load(urllib.request.urlopen(req, timeout=30))
+    assert out == {"total": 6}
+    # Default route (/<name>) still works too.
+    req = urllib.request.Request(
+        f"http://{host}:{port}/summer",
+        data=json.dumps([4, 5]).encode(),
+        headers={"Content-Type": "application/json"})
+    assert json.load(urllib.request.urlopen(req, timeout=30)) == {"total": 9}
+    serve.shutdown()
