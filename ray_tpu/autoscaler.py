@@ -138,13 +138,15 @@ class StandardAutoscaler:
                  node_resources: Dict[str, float],
                  min_nodes: int = 0, max_nodes: int = 8,
                  idle_timeout_s: float = 60.0,
-                 update_interval_s: float = 1.0):
+                 update_interval_s: float = 1.0,
+                 node_labels: "Optional[Dict[str, str]]" = None):
         if hasattr(controller, "call") and not hasattr(
                 controller, "autoscaler_state"):
             controller = _RemoteController(controller)
         self._controller = controller
         self._provider = provider
         self._node_resources = dict(node_resources)
+        self._node_labels = dict(node_labels or {})
         self._min_nodes = min_nodes
         self._max_nodes = max_nodes
         self._idle_timeout_s = idle_timeout_s
@@ -188,7 +190,22 @@ class StandardAutoscaler:
         autoscaler.py:374)."""
         state = self._controller.autoscaler_state()
         nodes = [n for n in state["nodes"] if n["alive"]]
-        demand = state["pending_demand"]  # list of resource dicts
+        # Demand entries: {"resources": ..., "labels": ...} (labels from
+        # node_label-blocked tasks). A label-constrained demand only counts
+        # against this autoscaler's node type if the template labels
+        # satisfy it — otherwise launching would never help and the
+        # bin-pack would mis-account capacity for other demand.
+        demand = []
+        for entry in state["pending_demand"]:
+            if isinstance(entry, dict) and "resources" in entry:
+                labels = entry.get("labels")
+                if labels and not all(
+                        self._node_labels.get(k) == v
+                        for k, v in labels.items()):
+                    continue
+                demand.append(entry["resources"])
+            else:  # legacy plain resource dict
+                demand.append(entry)
         provider_ids = set(self._provider.non_terminated_nodes())
         registered = {n["labels"].get("provider_node_id")
                       for n in nodes}
@@ -220,13 +237,15 @@ class StandardAutoscaler:
             to_launch,
             self._max_nodes - len(self._provider.non_terminated_nodes())))
         for _ in range(launchable):
-            self._provider.create_node(self._node_resources, {})
+            self._provider.create_node(self._node_resources,
+                                       dict(self._node_labels))
             self.num_launches += 1
 
         # Ensure the floor.
         short = self._min_nodes - len(self._provider.non_terminated_nodes())
         for _ in range(max(0, short)):
-            self._provider.create_node(self._node_resources, {})
+            self._provider.create_node(self._node_resources,
+                                       dict(self._node_labels))
             self.num_launches += 1
 
         # Plan scale-down: terminate nodes idle past the timeout. Any

@@ -125,7 +125,9 @@ class Controller:
         # placement of that shape succeeds — waiting submitters retry, so
         # live demand keeps itself fresh and satisfied demand evaporates
         # (no scale-up/down oscillation from stale history).
-        self._pending_demand: Dict[tuple, Tuple[Dict[str, float], float]] = {}
+        # shape key -> (resources, ts, labels-or-None): unmet scheduling
+        # demand, labels carried so the autoscaler can match node types.
+        self._pending_demand: Dict[tuple, tuple] = {}
         self._clients = ClientPool()
         self._stopped = threading.Event()
         # Long-poll notification hub (reference: src/ray/pubsub/publisher.h
@@ -401,14 +403,17 @@ class Controller:
         """
         strategy = strategy or {}
         excluded_ids = {NodeID(b) for b in (excluded or [])}
+        demand_labels = (strategy.get("labels")
+                         if strategy.get("kind") == "node_label" else None)
         with self._lock:
             alive = [r for r in self._nodes.values()
                      if r.alive and r.node_id not in excluded_ids]
             feasible = [r for r in alive if resmath.fits(r.total, resources)]
-            shape_key = tuple(sorted(resources.items()))
+            shape_key = (tuple(sorted(resources.items())),
+                         tuple(sorted((demand_labels or {}).items())))
             if not feasible:
-                self._pending_demand[shape_key] = (dict(resources),
-                                                   time.monotonic())
+                self._pending_demand[shape_key] = (
+                    dict(resources), time.monotonic(), demand_labels)
                 return None
             self._pending_demand.pop(shape_key, None)
 
@@ -443,9 +448,10 @@ class Controller:
                                    for k, v in hard.items())]
                 if not matching:
                     # Label-blocked: keep the demand visible to operators
-                    # and the autoscaler (popped above on feasibility).
-                    self._pending_demand[shape_key] = (dict(resources),
-                                                       time.monotonic())
+                    # and the autoscaler, WITH its labels, so the bin-pack
+                    # only counts it against label-satisfying node types.
+                    self._pending_demand[shape_key] = (
+                        dict(resources), time.monotonic(), demand_labels)
                     return None
                 preferred = [r for r in matching
                              if all(r.labels.get(k) == v
@@ -890,12 +896,13 @@ class Controller:
         cutoff = time.monotonic() - 10.0
         with self._lock:
             self._pending_demand = {
-                k: (s, ts) for k, (s, ts) in self._pending_demand.items()
-                if ts > cutoff}
+                k: entry for k, entry in self._pending_demand.items()
+                if entry[1] > cutoff}
             return {
                 "nodes": [r.summary() for r in self._nodes.values()],
-                "pending_demand": [s for s, _ in
-                                   self._pending_demand.values()],
+                "pending_demand": [
+                    {"resources": s, "labels": labels}
+                    for s, _ts, labels in self._pending_demand.values()],
             }
 
     # ------------------------------------------- metrics + task events

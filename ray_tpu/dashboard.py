@@ -27,6 +27,7 @@ _PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <h1>ray_tpu cluster</h1><div id="content">%s</div>
 <p><a href="/api/nodes">/api/nodes</a> <a href="/api/actors">/api/actors</a>
 <a href="/api/jobs">/api/jobs</a> <a href="/api/tasks">/api/tasks</a>
+<a href="/api/memory">/api/memory</a>
 <a href="/metrics">/metrics</a></p></body></html>"""
 
 
@@ -68,6 +69,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/api/tasks":
                 self._send(json.dumps(
                     self.client.call("list_task_events", 500)).encode())
+            elif self.path == "/api/memory":
+                self._send(json.dumps(self._memory()).encode())
             elif self.path == "/metrics":
                 self._send(self.client.call("metrics_text").encode(),
                            "text/plain")
@@ -77,6 +80,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(b'{"error": "not found"}', code=404)
         except Exception as e:  # noqa: BLE001
             self._send(json.dumps({"error": str(e)}).encode(), code=500)
+
+    def _memory(self):
+        """Per-node object-store usage via the shared node-info poll
+        (bounded RPCs: one hung supervisor can't wedge the page)."""
+        from ray_tpu.util.state import node_infos
+
+        out = []
+        for info in node_infos(self.client.call("list_nodes"),
+                               timeout=5.0):
+            if "error" in info:
+                out.append(info)
+            else:
+                out.append({
+                    "node_id": info["node_id"],
+                    "store_used_bytes": info.get("store_used_bytes", 0),
+                    "store_capacity_bytes":
+                        info.get("store_capacity_bytes", 0),
+                    "spilled_bytes": info.get("spilled_bytes", 0),
+                    "workers": info.get("num_workers", 0),
+                    "oom_kills": info.get("num_oom_kills", 0),
+                })
+        return out
 
     def _render(self) -> str:
         nodes = self.client.call("list_nodes")
@@ -98,6 +123,22 @@ class _Handler(BaseHTTPRequestHandler):
                 + _table(arows, ["actor_id", "class", "name", "state",
                                  "restarts"])
                 + "<h2>jobs</h2>" + _table(jobs, ["job_id", "state"]))
+        mem = []
+        for m in self._memory():
+            if "error" in m:
+                mem.append({"node_id": m["node_id"][:16],
+                            "store": m["error"]})
+            else:
+                mem.append({
+                    "node_id": m["node_id"][:16],
+                    "store": f"{m['store_used_bytes'] / 1e6:.1f} / "
+                             f"{m['store_capacity_bytes'] / 1e6:.0f} MB",
+                    "spilled": f"{m['spilled_bytes'] / 1e6:.1f} MB",
+                    "workers": m["workers"],
+                    "oom_kills": m["oom_kills"],
+                })
+        html += "<h2>object store</h2>" + _table(
+            mem, ["node_id", "store", "spilled", "workers", "oom_kills"])
         return _PAGE % html
 
     def log_message(self, *args):  # silence

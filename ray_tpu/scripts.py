@@ -78,26 +78,15 @@ def cmd_memory(args) -> None:
     """Per-node object-store usage (reference: ``ray memory`` /
     object-store columns of ``ray status``): shared-memory segment used /
     capacity plus bytes spilled to disk, live from each node supervisor."""
-    from ray_tpu.core.rpc import RpcClient
+    from ray_tpu.util.state import node_infos
 
     client = _client(args)
     rows = []
-    for n in client.call("list_nodes"):
-        if not n.get("alive"):
+    for info in node_infos(client.call("list_nodes")):
+        if "error" in info:
+            rows.append({"node": info["node_id"][:12],
+                         "store_used": f"unreachable: {info['error']}"})
             continue
-        # Same per-node poll as ray_tpu.util.state.node_infos, but over the
-        # CLI's standalone controller connection (no core worker here).
-        nc = None
-        try:
-            nc = RpcClient(tuple(n["addr"]))
-            info = nc.call("get_info")
-        except Exception as e:
-            rows.append({"node": n["node_id"][:12],
-                         "store_used": f"unreachable: {e}"})
-            continue
-        finally:
-            if nc is not None:
-                nc.close()
         used = info.get("store_used_bytes", 0)
         cap = info.get("store_capacity_bytes", 0) or 1
         rows.append({

@@ -95,7 +95,8 @@ def _resolve_route(path: str) -> Optional[str]:
     path = "/" + path.strip("/")
     best = None
     for prefix, name in routes.items():
-        if path == prefix or path.startswith(prefix + "/"):
+        if (prefix == "/" or path == prefix
+                or path.startswith(prefix + "/")):
             if best is None or len(prefix) > len(best[0]):
                 best = (prefix, name)
     return best[1] if best else None

@@ -175,9 +175,14 @@ class ServeController:
     def set_route(self, prefix: str, name: str) -> None:
         """Register an HTTP route prefix for an application (reference:
         route_prefix in serve deployments; the proxy resolves by longest
-        matching prefix)."""
+        matching prefix). REPLACES the app's previous routes so redeploys
+        with a new prefix converge; prefixes normalize to a leading
+        slash (a slash-less YAML value would otherwise never match)."""
+        prefix = "/" + prefix.strip("/")
         with self._lock:
-            self._routes[prefix.rstrip("/") or "/"] = name
+            self._routes = {p: n for p, n in self._routes.items()
+                            if n != name}
+            self._routes[prefix] = name
 
     def get_routes(self) -> Dict[str, str]:
         with self._lock:
@@ -185,9 +190,10 @@ class ServeController:
 
     def delete(self, name: str) -> None:
         with self._lock:
+            # Route purge + record removal atomically: a concurrent
+            # redeploy can't leave a route pointing at a popped record.
             self._routes = {p: n for p, n in self._routes.items()
                             if n != name}
-        with self._lock:
             rec = self._deployments.pop(name, None)
             if rec is not None:
                 rec.deleting = True  # under lock: reconcile must not heal it

@@ -33,20 +33,24 @@ def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
     return _controller().call("list_task_events", limit)
 
 
-def node_infos() -> List[Dict[str, Any]]:
-    """Live node-supervisor ``get_info`` for every alive node (shared by
-    ``list_objects`` and the ``memory`` CLI). Unreachable nodes yield an
-    ``{"error": ...}`` entry rather than disappearing."""
+def node_infos(nodes: Optional[List[Dict[str, Any]]] = None,
+               timeout: float = 10.0) -> List[Dict[str, Any]]:
+    """Live node-supervisor ``get_info`` for every alive node (the ONE
+    per-node poll shared by ``list_objects``, the ``memory`` CLI and the
+    dashboard — pass ``nodes`` when the caller already has a node list or
+    no core worker, e.g. a standalone controller client). Unreachable
+    nodes yield an ``{"error": ...}`` entry rather than disappearing; RPCs
+    are bounded so one hung supervisor can't wedge the caller."""
     from ray_tpu.core.rpc import RpcClient
 
     out = []
-    for n in list_nodes():
+    for n in (nodes if nodes is not None else list_nodes()):
         if not n.get("alive"):
             continue
         client = None
         try:
-            client = RpcClient(tuple(n["addr"]))
-            out.append(client.call("get_info"))
+            client = RpcClient(tuple(n["addr"]), connect_timeout=timeout)
+            out.append(client.call("get_info", timeout=timeout))
         except Exception as e:
             out.append({"node_id": n["node_id"], "error": str(e)})
         finally:

@@ -158,5 +158,9 @@ def test_dashboard_endpoints(ray_start_regular):
         metrics = urllib.request.urlopen(
             f"{base}/metrics", timeout=10).read().decode()
         assert isinstance(metrics, str)
+        mem = json.loads(urllib.request.urlopen(
+            f"{base}/api/memory", timeout=10).read())
+        assert mem and mem[0]["store_capacity_bytes"] > 0
+        assert "object store" in html
     finally:
         server.shutdown()

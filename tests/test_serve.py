@@ -406,3 +406,31 @@ def test_http_route_prefix(ray_start_regular):
         headers={"Content-Type": "application/json"})
     assert json.load(urllib.request.urlopen(req, timeout=30)) == {"total": 9}
     serve.shutdown()
+
+
+def test_route_prefix_redeploy_converges(ray_start_regular):
+    """Re-deploying with a new route_prefix retires the old route (the
+    declarative config workflow must converge)."""
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    @serve.deployment
+    class V:
+        def __call__(self, x):
+            return x
+
+    serve.run(V.bind(), name="v", route_prefix="/v1")
+    serve_api._routes_cache = None
+    controller = serve_api.get_or_create_controller()
+    import ray_tpu as rt
+
+    routes = rt.get(controller.get_routes.remote(), timeout=30)
+    assert routes == {"/v1": "v"}
+
+    serve.run(V.bind(), name="v", route_prefix="v2")  # slash-less input
+    routes = rt.get(controller.get_routes.remote(), timeout=30)
+    assert routes == {"/v2": "v"}  # normalized AND old route retired
+    serve_api._routes_cache = None
+    assert serve_api._resolve_route("/v2/anything") == "v"
+    assert serve_api._resolve_route("/v1") is None
+    serve.shutdown()
