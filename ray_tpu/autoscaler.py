@@ -193,19 +193,22 @@ class StandardAutoscaler:
         # Demand entries: {"resources": ..., "labels": ...} (labels from
         # node_label-blocked tasks). A label-constrained demand only counts
         # against this autoscaler's node type if the template labels
-        # satisfy it — otherwise launching would never help and the
-        # bin-pack would mis-account capacity for other demand.
-        demand = []
+        # satisfy it — otherwise launching would never help — and, below,
+        # only label-satisfying nodes' capacity can absorb it (a label-less
+        # head node's free CPUs must not mark {pool: tpu} demand as met).
+        def label_ok(node_labels, want):
+            return not want or all(node_labels.get(k) == v
+                                   for k, v in want.items())
+
+        demand = []  # (resources, labels-or-None)
         for entry in state["pending_demand"]:
             if isinstance(entry, dict) and "resources" in entry:
                 labels = entry.get("labels")
-                if labels and not all(
-                        self._node_labels.get(k) == v
-                        for k, v in labels.items()):
+                if not label_ok(self._node_labels, labels):
                     continue
-                demand.append(entry["resources"])
+                demand.append((entry["resources"], labels))
             else:  # legacy plain resource dict
-                demand.append(entry)
+                demand.append((entry, None))
         provider_ids = set(self._provider.non_terminated_nodes())
         registered = {n["labels"].get("provider_node_id")
                       for n in nodes}
@@ -215,18 +218,20 @@ class StandardAutoscaler:
         # provisioning (minutes for a TPU slice) doesn't relaunch the same
         # demand every tick.
         provisioning = len(provider_ids - registered)
-        unmet: List[Dict[str, float]] = []
-        capacity = ([dict(n["available"]) for n in nodes]
-                    + [dict(self._node_resources)
+        unmet: List[tuple] = []
+        capacity = ([(n.get("labels", {}), dict(n["available"]))
+                     for n in nodes]
+                    + [(self._node_labels, dict(self._node_resources))
                        for _ in range(provisioning)])
-        for shape in demand:
-            if not any(resmath.fits(c, shape) and resmath.take(c, shape)
-                       for c in capacity):
-                unmet.append(shape)
+        for shape, want in demand:
+            if not any(label_ok(lbls, want) and resmath.fits(c, shape)
+                       and resmath.take(c, shape)
+                       for lbls, c in capacity):
+                unmet.append((shape, want))
         to_launch = 0
         new_node = dict(self._node_resources)
         pool: Dict[str, float] = {}
-        for shape in unmet:
+        for shape, _want in unmet:  # template labels already vetted above
             if not resmath.fits(new_node, shape):
                 continue  # this node type can never satisfy it
             if not (pool and resmath.take(pool, shape)):

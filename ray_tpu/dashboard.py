@@ -81,14 +81,16 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send(json.dumps({"error": str(e)}).encode(), code=500)
 
-    def _memory(self):
+    def _memory(self, nodes=None):
         """Per-node object-store usage via the shared node-info poll
-        (bounded RPCs: one hung supervisor can't wedge the page)."""
+        (bounded RPCs: one hung supervisor can't wedge the page; the
+        HTML render passes its already-fetched node list)."""
         from ray_tpu.util.state import node_infos
 
         out = []
-        for info in node_infos(self.client.call("list_nodes"),
-                               timeout=5.0):
+        for info in node_infos(
+                nodes if nodes is not None
+                else self.client.call("list_nodes"), timeout=2.0):
             if "error" in info:
                 out.append(info)
             else:
