@@ -19,11 +19,38 @@ from ray_tpu.core.node import Node
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[Dict] = None):
-        self.controller = Controller()
+                 head_node_args: Optional[Dict] = None,
+                 controller_kwargs: Optional[Dict] = None):
+        self._controller_kwargs = dict(controller_kwargs or {})
+        self.controller = Controller(**self._controller_kwargs)
         self.nodes = []
         if initialize_head:
             self.add_node(**(head_node_args or {}))
+
+    def crash_controller(self) -> None:
+        """Simulate a head crash: the control-plane process dies without a
+        graceful final snapshot (its periodic persist loop may have saved).
+        Raylets and workers stay up."""
+        self.controller._stopped.set()
+        self.controller._server.stop()
+        self.controller._clients.close_all()
+        # Drain the old persist loop before a replacement can share the
+        # snapshot path: _save_lock is per-instance, so without this join
+        # two controllers could interleave writes on the same .tmp file.
+        persist = getattr(self.controller, "_persist_thread", None)
+        if persist is not None:
+            persist.join(timeout=10.0)
+
+    def restart_controller(self) -> Controller:
+        """Start a replacement controller on the SAME address (head
+        fault-tolerance: raylets re-register via heartbeats; persisted
+        state — KV, jobs, named actors, actor records — restores from the
+        snapshot when ``persist_path`` was configured)."""
+        kwargs = dict(self._controller_kwargs)
+        host, port = self.controller.address
+        kwargs.update(host=host, port=port)
+        self.controller = Controller(**kwargs)
+        return self.controller
 
     @property
     def address(self):

@@ -171,6 +171,8 @@ class Controller:
                 "psub_keys": self.pubsub.keys,
                 "ping": lambda: "pong",
             },
+            host=host,
+            port=port,
             name="controller",
             max_workers=256,  # long-polls park handler threads
             inline_methods={"heartbeat"},
@@ -316,15 +318,20 @@ class Controller:
         self._on_node_dead(node_id)
 
     def heartbeat(self, node_id_bytes: bytes, available: Dict[str, float],
-                  queue_len: int) -> None:
+                  queue_len: int) -> Dict[str, bool]:
+        """Returns ``known=False`` when this controller has no record of the
+        node — the signal for a live raylet to re-register after a head
+        restart (node membership is not persisted; reference: raylets
+        re-registering with a restarted GCS, conftest.py:532)."""
         with self._lock:
             rec = self._nodes.get(NodeID(node_id_bytes))
             if rec is None:
-                return
+                return {"known": False}
             rec.available = dict(available)
             rec.queue_len = queue_len
             rec.last_heartbeat = time.monotonic()
             rec.alive = True
+            return {"known": True}
 
     def list_nodes(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -500,10 +507,16 @@ class Controller:
                        spec: Dict[str, Any], opts: Dict[str, Any]) -> None:
         actor_id = ActorID(actor_id_bytes)
         with self._lock:
+            # Idempotent per actor id: the creator's client retries through
+            # controller restarts (ReconnectingClient), so a re-delivered
+            # registration must not spawn a second scheduler thread or
+            # trip the name-conflict check against itself.
+            if actor_id in self._actors:
+                return
             name = info.get("name")
             if name:
                 existing = self._named_actors.get(name)
-                if existing is not None:
+                if existing is not None and existing != actor_id:
                     rec = self._actors.get(existing)
                     if rec is not None and rec.state != DEAD:
                         raise ValueError(

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import resources as resmath
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import NodeID, WorkerID
-from ray_tpu.core.rpc import ClientPool, RpcClient, RpcServer
+from ray_tpu.core.rpc import ClientPool, ReconnectingClient, RpcServer
 
 Addr = Tuple[str, int]
 BundleKey = Tuple[bytes, int]  # (placement group id, bundle index)
@@ -180,7 +180,9 @@ class Node:
         )
         self.address: Addr = self._server.addr
 
-        self._controller = RpcClient(self.controller_addr)
+        # Survives controller restarts: calls retry through a fresh socket
+        # (head fault tolerance — the raylet outlives the GCS).
+        self._controller = ReconnectingClient(self.controller_addr)
         self._controller.call(
             "register_node", self.node_id.binary(), self.address,
             self.total_resources, self.labels)
@@ -549,8 +551,15 @@ class Node:
                 with self._lock:
                     available = dict(self._available)
                     queue_len = self._queue_len
-                self._controller.notify(
-                    "heartbeat", self.node_id.binary(), available, queue_len)
+                reply = self._controller.call(
+                    "heartbeat", self.node_id.binary(), available, queue_len,
+                    timeout=5.0)
+                if reply and not reply.get("known", True):
+                    # A restarted controller doesn't know us: re-register
+                    # (membership is heartbeat-driven, not persisted).
+                    self._controller.call(
+                        "register_node", self.node_id.binary(), self.address,
+                        self.total_resources, self.labels, timeout=5.0)
             except Exception:
                 pass
 

@@ -183,10 +183,21 @@ class RpcServer:
 
     def stop(self) -> None:
         self._stopped.set()
+        # Wake the accept thread: a thread blocked in accept() holds a
+        # kernel reference to the listening socket, so close() alone leaves
+        # the port bound (a restarted peer could never rebind the same
+        # address). A self-connect makes accept() return; the loop then
+        # sees _stopped and exits, releasing the fd for real.
+        try:
+            with socket.create_connection(self.addr, timeout=1.0):
+                pass
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        self._accept_thread.join(timeout=2.0)
         with self._conns_lock:
             for c in self._conns:
                 try:
@@ -317,6 +328,62 @@ def _connect(addr: Addr, timeout: Optional[float]) -> socket.socket:
                 break
             time.sleep(0.05)
     raise RpcError(f"could not connect to {addr}: {last_err}")
+
+
+class ReconnectingClient:
+    """Controller-facing client that survives peer restarts.
+
+    The reference's GCS client retries RPCs with backoff while the GCS is
+    down and reconnects when it returns (``gcs_rpc_client.h`` retry loop);
+    this is that behavior for the framed-pickle transport: on a transport
+    error the socket is re-established and the call retried until
+    ``retry_window_s`` elapses. Only use against the controller — its
+    handlers are idempotent by design (re-register, kv_put, heartbeat,
+    create_placement_group 2PC)."""
+
+    def __init__(self, addr: Addr, retry_window_s: float = 10.0):
+        self.addr = tuple(addr)
+        self._window = retry_window_s
+        self._client: Optional[RpcClient] = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _get(self) -> RpcClient:
+        with self._lock:
+            if self._closed:
+                raise RpcError(f"client to {self.addr} is closed")
+            if self._client is None or self._client._closed:
+                self._client = RpcClient(self.addr)
+            return self._client
+
+    def call(self, method: str, *args, timeout: Optional[float] = None,
+             **kwargs):
+        deadline = time.monotonic() + self._window
+        while True:
+            try:
+                return self._get().call(method, *args, timeout=timeout,
+                                        **kwargs)
+            except TimeoutError:
+                # A per-call timeout on a healthy connection is the
+                # caller's latency bound, not a transport failure —
+                # resending would both break the bound and duplicate the
+                # request (TimeoutError subclasses OSError since 3.10, so
+                # this arm must precede the transport arm).
+                raise
+            except (RpcError, ConnectionError, OSError):
+                if self._closed or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def notify(self, method: str, *args, **kwargs) -> None:
+        """Best-effort one-way send (no retry: notifications are periodic)."""
+        self._get().notify(method, *args, **kwargs)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._client is not None:
+                self._client.close()
 
 
 class ClientPool:

@@ -48,7 +48,13 @@ from ray_tpu.core.errors import (
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import MemoryStore, wait_any
-from ray_tpu.core.rpc import ClientPool, RemoteCallError, RpcError, RpcServer
+from ray_tpu.core.rpc import (
+    ClientPool,
+    ReconnectingClient,
+    RemoteCallError,
+    RpcError,
+    RpcServer,
+)
 
 Addr = Tuple[str, int]
 
@@ -100,7 +106,10 @@ class CoreWorker:
 
         self.store = MemoryStore()
         self.clients = ClientPool()
-        self.controller = self.clients.get(controller_addr)
+        # Controller link retries through reconnects, so a head restart
+        # (controller FT) stalls control-plane calls briefly instead of
+        # failing in-flight tasks (reference: gcs_rpc_client.h retries).
+        self.controller = ReconnectingClient(tuple(controller_addr))
         # Lazily opened shared-memory stores: our own node's (for writes) and
         # any local store we read from. {path: ShmStore}
         self._shm_stores: Dict[str, Any] = {}
@@ -907,6 +916,7 @@ class CoreWorker:
     def shutdown(self) -> None:
         self._shutdown.set()
         self.submitter.stop()
+        self.controller.close()
         self.clients.close_all()
         self.server.stop()
 

@@ -44,6 +44,8 @@ class DeploymentRecord:
         self.next_replica_ord = 0
         self.last_scale = time.monotonic()
         self.deleting = False
+        self.pub_version = 0      # last version _publish saw on the hub
+        self.last_pub_check = 0.0  # hub-version heal throttle
         # Serializes structural changes (deploy's settle vs reconcile) so
         # two threads can't both observe len < target and double-add.
         self.lock = threading.Lock()
@@ -152,8 +154,9 @@ class ServeController:
             "deleted": rec.deleting,
         }
         try:
-            return get_core_worker().controller.call(
+            rec.pub_version = get_core_worker().controller.call(
                 "psub_publish", SNAPSHOT_CHANNEL, rec.name, snapshot)
+            return rec.pub_version
         except Exception:
             return None
 
@@ -306,6 +309,26 @@ class ServeController:
         # Model residency changes also need a push (multiplex routing).
         if changed or self._models_changed(rec):
             self._publish(rec)
+        elif rec.pub_version:
+            # Head-restart healing: a restarted cluster controller comes
+            # back with an EMPTY pubsub hub, so routers created after the
+            # restart would find no snapshot. Periodically compare the
+            # hub's current version with what we last published and
+            # republish on regression.
+            now = time.monotonic()
+            if now - rec.last_pub_check > 2.0:
+                rec.last_pub_check = now
+                try:
+                    from ray_tpu.core.runtime import get_core_worker
+
+                    cur = get_core_worker().controller.call(
+                        "psub_poll", SNAPSHOT_CHANNEL, rec.name, 0, 0.0,
+                        timeout=5.0)
+                except Exception:
+                    cur = rec.pub_version  # unreachable hub: not a reset
+                if cur is None or (isinstance(cur, tuple)
+                                   and cur[0] < rec.pub_version):
+                    self._publish(rec)
 
     def _min_replicas(self, rec: DeploymentRecord) -> int:
         auto = rec.cfg.get("autoscaling")

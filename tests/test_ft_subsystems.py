@@ -185,3 +185,123 @@ def test_workflow_steps_not_reexecuted(ray_start_regular, tmp_path):
     assert workflow.resume("wf2", storage=storage) == 2
     with open(count_file) as f:
         assert int(f.read()) == 1  # executed exactly once
+
+
+@pytest.mark.timeout_s(170)
+def test_head_restart_with_live_raylets(tmp_path):
+    """Kill + restart the controller mid-run (VERDICT r2 #9): live raylets
+    re-register via heartbeats, the restored named-actor record keeps
+    serving calls, and new task submissions schedule on the re-registered
+    nodes (reference: GCS FT with raylet reconnect, conftest.py:532)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=False,
+        controller_kwargs={"persist_path": str(tmp_path / "gcs.snap")})
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes(30)
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        counter = Counter.options(name="survivor").remote()
+        assert ray_tpu.get(counter.inc.remote(), timeout=60) == 1
+
+        @ray_tpu.remote
+        def plus(x):
+            return x + 1
+
+        assert ray_tpu.get(plus.remote(1), timeout=60) == 2
+
+        # Make the snapshot deterministic, then crash the head (no graceful
+        # final save) and bring a replacement up on the same address.
+        cluster.controller.save_state()
+        cluster.crash_controller()
+        time.sleep(1.0)
+        ctrl = cluster.restart_controller()
+
+        # Raylets re-register within a few heartbeats.
+        deadline = time.monotonic() + 30
+        while sum(n["alive"] for n in ctrl.list_nodes()) < 2:
+            assert time.monotonic() < deadline, ctrl.list_nodes()
+            time.sleep(0.2)
+
+        # The actor worker never died: the restored record still routes.
+        found = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(found.inc.remote(), timeout=60) == 2
+        # The pre-restart handle also still works.
+        assert ray_tpu.get(counter.inc.remote(), timeout=60) == 3
+
+        # Fresh submissions schedule on re-registered nodes.
+        assert ray_tpu.get([plus.remote(i) for i in range(20)],
+                           timeout=120) == [i + 1 for i in range(20)]
+    finally:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.timeout_s(170)
+def test_serve_survives_head_restart(tmp_path):
+    """A serve deployment keeps answering across a controller crash +
+    restart: the existing handle routes from its cached snapshot, and a
+    handle created AFTER the restart heals via the serve controller's
+    periodic republish (hub-version regression check)."""
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=False,
+        controller_kwargs={"persist_path": str(tmp_path / "gcs.snap")})
+    try:
+        cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(30)
+        ray_tpu.init(address=cluster.address)
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, x):
+                return x
+
+        handle = serve.run(Echo.bind(), name="echo")
+        assert handle.remote("pre").result(timeout=60) == "pre"
+
+        cluster.controller.save_state()
+        cluster.crash_controller()
+        time.sleep(1.0)
+        ctrl = cluster.restart_controller()
+        deadline = time.monotonic() + 30
+        while not any(n["alive"] for n in ctrl.list_nodes()):
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+
+        # Existing handle: cached replica snapshot keeps routing.
+        assert handle.remote("during").result(timeout=60) == "during"
+        # New handle: needs the snapshot republished into the fresh hub
+        # (serve controller heals it within a few reconcile ticks).
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                fresh = serve.get_deployment_handle("echo")
+                assert fresh.remote("post").result(timeout=10) == "post"
+                break
+            except Exception:
+                assert time.monotonic() < deadline
+                time.sleep(0.5)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
