@@ -80,43 +80,74 @@ class FakeMultiNodeProvider(NodeProvider):
 
 
 class TPUVMNodeProvider(NodeProvider):
-    """TPU-VM slices as atomic gangs. ``transport(verb, path, body)`` is the
-    injected HTTP layer for the TPU VM REST API (``tpu.googleapis.com``);
-    tests drive it with a fake. One "node" = one pod slice; a slice's
-    resources advertise every chip (``TPU: chips``) plus the slice-topology
-    label the gang scheduler keys on."""
+    """TPU-VM slices as atomic gangs over the real REST client
+    (:class:`ray_tpu.tpu_vm_api.TpuVmClient`; reference: the GCP provider
+    speaking the TPU VM API, ``gcp/node_provider.py:75-94``). One "node" =
+    one pod slice; a slice's resources advertise every chip (``TPU:
+    chips``) plus the slice-topology label the gang scheduler keys on.
 
-    def __init__(self, transport: Callable[[str, str, Optional[dict]], dict],
-                 project: str, zone: str,
+    ``bootstrap(node_dict, labels)`` — when given — runs after a created
+    slice turns READY (the launcher uses it to SSH ``ray_tpu start`` onto
+    every slice host via :class:`TPUPodCommandRunner`); tests and dry-run
+    skip it. ``transport``/legacy 3-arg transports are adapted for tests
+    that fake the HTTP layer."""
+
+    def __init__(self, transport=None, project: str = "", zone: str = "",
                  accelerator_type: str = "v5litepod-16",
-                 runtime_version: str = "v2-alpha-tpuv5-lite"):
-        self._transport = transport
-        self._base = (f"projects/{project}/locations/{zone}")
+                 runtime_version: str = "v2-alpha-tpuv5-lite",
+                 client=None,
+                 bootstrap: "Optional[Callable[[dict, Dict], None]]" = None,
+                 name_prefix: str = "ray-tpu-slice"):
+        if client is None:
+            from ray_tpu.tpu_vm_api import TpuVmClient
+
+            if transport is not None:
+                # Legacy test transports take (verb, path, body); the
+                # client calls (verb, url, body, headers). No token needed
+                # against a fake.
+                def adapted(verb, url, body, headers, _t=transport):
+                    path = url.split("/v2/", 1)[-1]
+                    return _t(verb, path, body)
+
+                client = TpuVmClient(project, zone, token_fn=lambda: "",
+                                     transport=adapted)
+            else:
+                # Real HTTP: default auth (GCE metadata-server token).
+                client = TpuVmClient(project, zone)
+        self._client = client
         self._accelerator_type = accelerator_type
         self._runtime_version = runtime_version
+        self._bootstrap = bootstrap
+        self._name_prefix = name_prefix
         self._counter = 0
 
     def create_node(self, resources, labels) -> str:
+        import json as _json
+
         self._counter += 1
-        name = f"ray-tpu-slice-{self._counter}"
-        node_path = f"{self._base}/nodes/{name}"
-        self._transport("POST", f"{self._base}/nodes?nodeId={name}", {
-            "acceleratorType": self._accelerator_type,
-            "runtimeVersion": self._runtime_version,
+        name = f"{self._name_prefix}-{self._counter}"
+        node_path = f"{self._client.parent}/nodes/{name}"
+        op = self._client.create_node(
+            name,
+            self._accelerator_type,
+            self._runtime_version,
             # The slice's nodes start ray with this label so the autoscaler
             # can map cluster nodes back to provider instances (idle
             # teardown keys on it).
-            "labels": {**labels, "provider_node_id": node_path},
-            "metadata": {"ray_resources": str(dict(resources))},
-        })
+            labels={**labels, "provider_node_id": node_path},
+            metadata={"ray_resources": _json.dumps(dict(resources))},
+        )
+        if self._bootstrap is not None:
+            self._client.wait_operation(op)
+            node = self._client.get_node(node_path)
+            self._bootstrap(node, {**labels, "provider_node_id": node_path})
         return node_path
 
     def terminate_node(self, provider_node_id: str) -> None:
-        self._transport("DELETE", provider_node_id, None)
+        self._client.delete_node(provider_node_id)
 
     def non_terminated_nodes(self) -> List[str]:
-        reply = self._transport("GET", f"{self._base}/nodes", None)
-        return [n["name"] for n in reply.get("nodes", [])
+        return [n["name"] for n in self._client.list_nodes()
                 if n.get("state") not in ("DELETING", "TERMINATED")]
 
 

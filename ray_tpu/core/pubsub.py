@@ -28,9 +28,15 @@ class Pubsub:
         # (channel, key) -> (version, value). Versions are per-(channel,key).
         self._state: Dict[Tuple[str, str], Tuple[int, Any]] = {}
 
-    def publish(self, channel: str, key: str, value: Any) -> int:
+    def publish(self, channel: str, key: str, value: Any,
+                min_version: int = 0) -> int:
+        """``min_version`` lets a publisher keep its subscribers' version
+        clocks monotonic across a HUB restart (head FT): a fresh hub would
+        restart at 1, below what long-pollers already saw, stranding them —
+        the publisher passes the floor it knows it reached before."""
         with self._cond:
-            version = self._state.get((channel, key), (0, None))[0] + 1
+            version = max(self._state.get((channel, key), (0, None))[0] + 1,
+                          min_version)
             self._state[(channel, key)] = (version, value)
             self._cond.notify_all()
             return version

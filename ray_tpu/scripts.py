@@ -214,6 +214,9 @@ def cmd_start(args) -> int:
 
     labels: Dict[str, str] = {}
     _autodetect_tpu(resources, labels)
+    if getattr(args, "labels", None):
+        labels.update({str(k): str(v)
+                       for k, v in json.loads(args.labels).items()})
     node = Node(controller_addr, resources or None, labels, host=args.host)
     print(f"node {node.node_id.hex()[:8]}: "
           f"{node.address[0]}:{node.address[1]} "
@@ -318,6 +321,88 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """``ray_tpu up cluster.yaml`` (reference: ``ray up``,
+    ``autoscaler/_private/commands.py`` create_or_update_cluster): validate
+    the YAML, boot head + autoscaler, keep provisioning until stopped."""
+    from ray_tpu.cluster_launcher import up
+
+    cluster = up(args.config, block=False)
+    for line in cluster.actions:
+        print(f"  {line}")
+    if cluster.address:
+        print(f"cluster up; controller at {cluster.address[0]}:"
+              f"{cluster.address[1]}")
+    if cluster.config.dry_run:
+        print("(dry run: no instances created)")
+        cluster.shutdown()
+        return 0
+    if args.no_block:
+        # Caller manages lifetime (tests); daemons die with this process.
+        return 0
+    from ray_tpu.cluster_launcher import block_until_signal
+
+    print("autoscaling; press Ctrl-C to stop")
+    block_until_signal(cluster)
+    return 0
+
+
+def cmd_down(args) -> int:
+    """``ray_tpu down cluster.yaml`` (reference: ``ray down``)."""
+    from ray_tpu.cluster_launcher import down
+
+    for name in down(args.config):
+        print(f"terminated {name}")
+    print("cluster down")
+    return 0
+
+
+def cmd_submit(args) -> int:
+    """``ray_tpu submit cluster.yaml 'entrypoint'`` — job submission against
+    the cluster the YAML describes (reference: ``ray submit``). --address
+    overrides; otherwise a tpu_vm YAML resolves the head via the TPU API
+    (its controller listens on the launcher's fixed port) and a
+    fake/local YAML falls back to the local discovery file."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    addr = None
+    if args.address:
+        addr = resolve_address(args.address)
+    else:
+        from ray_tpu.cluster_config import load_config
+
+        cfg = load_config(args.config)
+        if cfg.provider.type == "tpu_vm":
+            from ray_tpu.cluster_launcher import HEAD_PORT
+            from ray_tpu.tpu_vm_api import TpuVmClient
+
+            client_api = TpuVmClient(cfg.provider.project_id,
+                                     cfg.provider.zone, dry_run=cfg.dry_run)
+            head = client_api.get_node(
+                f"{client_api.parent}/nodes/{cfg.cluster_name}-head")
+            hosts = TpuVmClient.node_hosts(head)
+            if not hosts:
+                raise SystemExit(
+                    f"head node {cfg.cluster_name}-head not found or has "
+                    f"no endpoints (is the cluster up?)")
+            addr = (hosts[0], HEAD_PORT)
+        else:
+            addr = resolve_address(None)
+    client = JobSubmissionClient(addr)
+    runtime_env = None
+    if args.working_dir:
+        from ray_tpu.runtime_env import upload_working_dir
+
+        runtime_env = {"working_dir": upload_working_dir(args.working_dir)}
+    job_id = client.submit_job(entrypoint=args.entrypoint,
+                               runtime_env=runtime_env)
+    print(f"submitted {job_id}")
+    status = client.wait_until_finished(job_id)
+    print(client.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
 def cmd_serve(args) -> int:
     """Declarative serve operations (reference: ``serve deploy/status/
     shutdown`` CLI, ``serve/scripts.py``)."""
@@ -369,6 +454,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_start.add_argument("--num-cpus", type=float, default=None)
     p_start.add_argument("--resources", default=None,
                          help='JSON, e.g. \'{"TPU": 4}\'')
+    p_start.add_argument("--labels", default=None,
+                         help='JSON node labels, e.g. '
+                         '\'{"provider_node_id": "..."}\'')
     p_start.add_argument("--persist-path", default=None,
                          help="controller state snapshot dir (GCS FT)")
     p_start.add_argument("--no-client-server", action="store_true")
@@ -376,6 +464,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_serve.add_argument("action", choices=["deploy", "status", "shutdown"])
     p_serve.add_argument("config", nargs="?", default=None,
                          help="config.yaml (deploy)")
+    p_up = sub.add_parser("up")
+    p_up.add_argument("config", help="cluster YAML")
+    p_up.add_argument("--no-block", action="store_true",
+                      help="return after bring-up (testing)")
+    p_down = sub.add_parser("down")
+    p_down.add_argument("config", help="cluster YAML")
+    p_submit = sub.add_parser("submit")
+    p_submit.add_argument("config", help="cluster YAML (address discovery)")
+    p_submit.add_argument("entrypoint", help="shell command to run")
+    p_submit.add_argument("--working-dir", default=None)
     p_job = sub.add_parser("job")
     p_job.add_argument("action", choices=["submit", "status", "logs",
                                           "stop", "list"])
@@ -397,6 +495,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd_memory(args)
     elif args.command == "start":
         return cmd_start(args)
+    elif args.command == "up":
+        return cmd_up(args)
+    elif args.command == "down":
+        return cmd_down(args)
+    elif args.command == "submit":
+        return cmd_submit(args)
     elif args.command == "job":
         return cmd_job(args)
     elif args.command == "serve":

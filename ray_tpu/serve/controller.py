@@ -82,6 +82,11 @@ class ServeController:
                                    cfg)
             drain_old = False
             if old is not None:
+                # The version floor survives record replacement: routers'
+                # long-poll clocks are per deployment NAME, so a redeploy
+                # publishing below the old record's version would strand
+                # every existing handle.
+                rec.pub_version = old.pub_version
                 if (old.cls_blob == cls_blob
                         and old.init_args == init_args
                         and old.init_kwargs == init_kwargs):
@@ -154,8 +159,12 @@ class ServeController:
             "deleted": rec.deleting,
         }
         try:
+            # min_version keeps subscriber clocks monotonic across a hub
+            # (head) restart: routers long-poll with the last version they
+            # saw, so a republish below it would never wake them.
             rec.pub_version = get_core_worker().controller.call(
-                "psub_publish", SNAPSHOT_CHANNEL, rec.name, snapshot)
+                "psub_publish", SNAPSHOT_CHANNEL, rec.name, snapshot,
+                rec.pub_version + 1)
             return rec.pub_version
         except Exception:
             return None

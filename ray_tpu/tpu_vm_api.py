@@ -1,0 +1,165 @@
+"""TPU VM REST API client (``tpu.googleapis.com`` v2).
+
+The real HTTP layer for :class:`ray_tpu.autoscaler.TPUVMNodeProvider`
+(reference: the GCP node provider speaking the TPU API,
+``autoscaler/_private/gcp/node_provider.py:75-94`` + ``node.py`` GCPTPUNode;
+the reference goes through googleapiclient, this speaks REST directly with
+urllib — no SDK in the image).
+
+Every call goes through ``self._transport(verb, url, body, headers)`` which
+defaults to urllib; tests (and this zero-egress box) inject a fake transport
+or construct with ``dry_run=True`` to record requests. Auth is a pluggable
+``token_fn`` defaulting to the GCE metadata server (how a head node inside
+GCP authenticates without key files).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+API_ROOT = "https://tpu.googleapis.com/v2"
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+
+
+class TpuApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(f"TPU API error {status}: {message}")
+
+
+def metadata_token() -> str:
+    """OAuth token from the GCE metadata server (valid on any GCP VM)."""
+    req = urllib.request.Request(METADATA_TOKEN_URL,
+                                 headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())["access_token"]
+
+
+def _urllib_transport(verb: str, url: str, body: Optional[dict],
+                      headers: Dict[str, str]) -> dict:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=verb,
+                                 headers={"Content-Type": "application/json",
+                                          **headers})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            payload = resp.read()
+            return json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        raise TpuApiError(e.code, e.read().decode(errors="replace")) from e
+
+
+class TpuVmClient:
+    """Typed wrapper over the nodes/operations endpoints the provisioning
+    path needs: create (returns a long-running operation), delete, list,
+    get, and operation polling."""
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        token_fn: Callable[[], str] = metadata_token,
+        transport: Optional[Callable] = None,
+        dry_run: bool = False,
+        api_root: str = API_ROOT,
+    ):
+        self.parent = f"projects/{project}/locations/{zone}"
+        self._root = api_root.rstrip("/")
+        self._token_fn = token_fn
+        self.dry_run = dry_run
+        self.requests: List[Dict[str, Any]] = []  # dry-run/test record
+        self._transport = transport or _urllib_transport
+
+    # ------------------------------------------------------------ plumbing
+
+    def _call(self, verb: str, path: str,
+              body: Optional[dict] = None) -> dict:
+        url = f"{self._root}/{path}"
+        self.requests.append({"verb": verb, "path": path, "body": body})
+        if self.dry_run:
+            return {"name": f"{self.parent}/operations/dry-run",
+                    "done": True}
+        headers = {"Authorization": f"Bearer {self._token_fn()}"}
+        return self._transport(verb, url, body, headers)
+
+    # ------------------------------------------------------------- nodes
+
+    def create_node(
+        self,
+        node_id: str,
+        accelerator_type: str,
+        runtime_version: str,
+        labels: Optional[Dict[str, str]] = None,
+        metadata: Optional[Dict[str, str]] = None,
+        network_config: Optional[dict] = None,
+        startup_script: Optional[str] = None,
+    ) -> dict:
+        """POST nodes — creates one pod slice as a single API object (the
+        gang atomicity the scheduler's slice bundles rely on). Returns the
+        long-running operation."""
+        meta = dict(metadata or {})
+        if startup_script is not None:
+            meta["startup-script"] = startup_script
+        body = {
+            "acceleratorType": accelerator_type,
+            "runtimeVersion": runtime_version,
+            "labels": labels or {},
+            "metadata": meta,
+        }
+        if network_config:
+            body["networkConfig"] = network_config
+        return self._call("POST",
+                          f"{self.parent}/nodes?nodeId={node_id}", body)
+
+    def delete_node(self, name: str) -> dict:
+        return self._call("DELETE", name)
+
+    def get_node(self, name: str) -> dict:
+        return self._call("GET", name)
+
+    def list_nodes(self) -> List[dict]:
+        nodes: List[dict] = []
+        page = self._call("GET", f"{self.parent}/nodes")
+        nodes.extend(page.get("nodes", []))
+        while page.get("nextPageToken"):
+            page = self._call(
+                "GET",
+                f"{self.parent}/nodes?pageToken={page['nextPageToken']}")
+            nodes.extend(page.get("nodes", []))
+        return nodes
+
+    # --------------------------------------------------------- operations
+
+    def wait_operation(self, op: dict, timeout: float = 900.0,
+                       poll_s: float = 5.0) -> dict:
+        """Poll a long-running operation to completion (create/delete take
+        minutes for big slices)."""
+        deadline = time.monotonic() + timeout
+        while not op.get("done"):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"operation {op.get('name')} timed out")
+            time.sleep(poll_s)
+            op = self._call("GET", op["name"])
+        if "error" in op:
+            err = op["error"]
+            raise TpuApiError(err.get("code", -1),
+                              err.get("message", str(err)))
+        return op
+
+    # ----------------------------------------------------------- helpers
+
+    @staticmethod
+    def node_hosts(node: dict) -> List[str]:
+        """Internal IPs of every VM in the slice (for the pod command
+        runner; reference: GCPTPUNode.get_internal_ips)."""
+        return [ep.get("ipAddress", "")
+                for ep in node.get("networkEndpoints", [])]
+
+    @staticmethod
+    def node_state(node: dict) -> str:
+        return node.get("state", "UNKNOWN")

@@ -297,6 +297,27 @@ def test_serve_survives_head_restart(tmp_path):
             except Exception:
                 assert time.monotonic() < deadline
                 time.sleep(0.5)
+
+        # The pre-restart router must keep receiving updates: the fresh
+        # hub restarts version clocks, so publishes carry a floor above
+        # the pre-crash version (a redeploy's new replicas must reach the
+        # OLD handle, not just new ones).
+        @serve.deployment
+        class Echo2:
+            def __call__(self, x):
+                return ("v2", x)
+
+        serve.run(Echo2.bind(), name="echo")
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                if handle.remote("x").result(timeout=10) == ("v2", "x"):
+                    break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, \
+                "pre-restart router never saw the post-restart redeploy"
+            time.sleep(0.5)
     finally:
         try:
             serve.shutdown()
