@@ -97,7 +97,8 @@ class TPUVMNodeProvider(NodeProvider):
                  runtime_version: str = "v2-alpha-tpuv5-lite",
                  client=None,
                  bootstrap: "Optional[Callable[[dict, Dict], None]]" = None,
-                 name_prefix: str = "ray-tpu-slice"):
+                 name_prefix: str = "ray-tpu-slice",
+                 filter_labels: "Optional[Dict[str, str]]" = None):
         if client is None:
             from ray_tpu.tpu_vm_api import TpuVmClient
 
@@ -119,6 +120,11 @@ class TPUVMNodeProvider(NodeProvider):
         self._runtime_version = runtime_version
         self._bootstrap = bootstrap
         self._name_prefix = name_prefix
+        # Only nodes carrying ALL these labels belong to this provider:
+        # the project/zone is shared real estate — without the filter,
+        # idle teardown and shutdown would delete the head and other
+        # clusters' slices, and the provisioning count would see phantoms.
+        self._filter_labels = dict(filter_labels or {})
         self._counter = 0
 
     def create_node(self, resources, labels) -> str:
@@ -126,29 +132,44 @@ class TPUVMNodeProvider(NodeProvider):
 
         self._counter += 1
         name = f"{self._name_prefix}-{self._counter}"
-        node_path = f"{self._client.parent}/nodes/{name}"
         op = self._client.create_node(
             name,
             self._accelerator_type,
             self._runtime_version,
-            # The slice's nodes start ray with this label so the autoscaler
-            # can map cluster nodes back to provider instances (idle
-            # teardown keys on it).
-            labels={**labels, "provider_node_id": node_path},
+            # provider_node_id is the SHORT node name: GCP label values are
+            # [a-z0-9_-] and <= 63 chars, so the full resource path (with
+            # slashes) would be rejected by the live API. The slice's
+            # raylets start with this label so the autoscaler can map
+            # cluster nodes back to provider instances.
+            labels={**self._filter_labels, **labels,
+                    "provider_node_id": name},
             metadata={"ray_resources": _json.dumps(dict(resources))},
         )
         if self._bootstrap is not None:
             self._client.wait_operation(op)
-            node = self._client.get_node(node_path)
-            self._bootstrap(node, {**labels, "provider_node_id": node_path})
-        return node_path
+            node = self._client.get_node(self._node_path(name))
+            self._bootstrap(node, {**labels, "provider_node_id": name})
+        return name
+
+    def _node_path(self, provider_node_id: str) -> str:
+        if "/" in provider_node_id:  # already a full resource path
+            return provider_node_id
+        return f"{self._client.parent}/nodes/{provider_node_id}"
 
     def terminate_node(self, provider_node_id: str) -> None:
-        self._client.delete_node(provider_node_id)
+        self._client.delete_node(self._node_path(provider_node_id))
 
     def non_terminated_nodes(self) -> List[str]:
-        return [n["name"] for n in self._client.list_nodes()
-                if n.get("state") not in ("DELETING", "TERMINATED")]
+        out = []
+        for n in self._client.list_nodes():
+            if n.get("state") in ("DELETING", "TERMINATED"):
+                continue
+            node_labels = n.get("labels", {})
+            if any(node_labels.get(k) != v
+                   for k, v in self._filter_labels.items()):
+                continue
+            out.append(n["name"].rsplit("/", 1)[-1])
+        return out
 
 
 class _RemoteController:

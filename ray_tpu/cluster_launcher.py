@@ -141,7 +141,11 @@ def _start_command(head: bool, address: Optional[str],
         # nodes back to provider instances through it (idle teardown and
         # the provisioning count both key on the label).
         parts.append(f"--labels {shlex.quote(_json.dumps(labels))}")
-    return " ".join(parts)
+    # ray_tpu start parks in the foreground until SIGTERM; over SSH it must
+    # daemonize or the runner (and `up`) would hang until timeout.
+    role = "head" if head else "worker"
+    return (f"nohup {' '.join(parts)} > /tmp/ray_tpu_{role}.log 2>&1 "
+            f"< /dev/null &")
 
 
 def _up_tpu_vm(cfg: ClusterConfig) -> LaunchedCluster:
@@ -196,7 +200,12 @@ def _up_tpu_vm(cfg: ClusterConfig) -> LaunchedCluster:
         accelerator_type=cfg.provider.accelerator_type,
         runtime_version=cfg.provider.runtime_version,
         bootstrap=bootstrap,
-        name_prefix=f"{cfg.cluster_name}-worker")
+        name_prefix=f"{cfg.cluster_name}-worker",
+        # Scope every list/terminate to THIS cluster's workers: the head
+        # (ray-node-type=head) and other clusters in the zone are not the
+        # autoscaler's to reap.
+        filter_labels={"ray-cluster": cfg.cluster_name,
+                       "ray-node-type": "worker"})
     if not cfg.dry_run:
         controller_client = RpcClient(cluster.address, connect_timeout=120.0)
     else:

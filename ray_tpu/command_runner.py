@@ -13,6 +13,7 @@ command runners the same way: assert on the built command line).
 
 from __future__ import annotations
 
+import shlex
 import subprocess
 from typing import Dict, List, Optional, Sequence
 
@@ -86,8 +87,11 @@ class SSHCommandRunner(CommandRunner):
         return argv
 
     def run(self, cmd: str, timeout: float = 600.0) -> str:
+        # shlex.quote, not repr: commands routinely mix quote styles
+        # (--resources '{"CPU": 4}') and repr's \' is NOT an escape inside
+        # POSIX single quotes.
         argv = self._base("ssh") + [f"{self.user}@{self.host}",
-                                    f"bash -lc {cmd!r}"]
+                                    f"bash -lc {shlex.quote(cmd)}"]
         return self._execute(argv, timeout)
 
     def put(self, src: str, dst: str, timeout: float = 600.0) -> None:

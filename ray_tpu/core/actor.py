@@ -28,6 +28,7 @@ from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.remote_function import (
+    _normalized_env,
     _placement_tuple,
     _resources_from_options,
     _strategy_dict,
@@ -96,7 +97,7 @@ class ActorClass:
             "resources": resources,
             "scheduling_strategy": _strategy_dict(opts.get("scheduling_strategy")),
             "placement": _placement_tuple(opts),
-            "runtime_env": opts.get("runtime_env"),
+            "runtime_env": _normalized_env(opts),
         }
         core.controller.call("register_actor", actor_id.binary(), info,
                              spec, creation_opts)

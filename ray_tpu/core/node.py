@@ -371,10 +371,20 @@ class Node:
         env = dict(os.environ)
         env.update(self._extra_env)
         workdir = None
+        python_exe = sys.executable
+        env_paths: List[str] = []
         if runtime_env:
-            env.update({str(k): str(v) for k, v in
-                        (runtime_env.get("env_vars") or {}).items()})
-            workdir = self._materialize_working_dir(runtime_env)
+            # Full env build (working_dir + py_modules + pip venv); any
+            # failure raises and becomes the lease error (reference: the
+            # raylet failing leases on runtime-env agent build errors).
+            from ray_tpu.runtime_env import build_env
+
+            built = build_env(runtime_env, self._controller)
+            env.update(built["env_vars"])
+            workdir = built["cwd"]
+            env_paths = [p for p in built["pythonpath"] if p != workdir]
+            if built["python"]:
+                python_exe = built["python"]
         if not needs_tpu:
             # CPU-only workers skip accelerator attach: site hooks keyed on
             # these vars import jax (+PJRT registration) into EVERY python
@@ -389,9 +399,12 @@ class Node:
         inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
         env["PYTHONPATH"] = os.pathsep.join(
             dict.fromkeys(extra_paths + inherited))
-        if workdir:
+        front = ([workdir] if workdir else []) + env_paths
+        if front:
+            # working_dir + py_modules go FIRST so they shadow base-env
+            # modules of the same name.
             env["PYTHONPATH"] = os.pathsep.join(
-                [workdir] + [p for p in env.get("PYTHONPATH", "").split(
+                front + [p for p in env.get("PYTHONPATH", "").split(
                     os.pathsep) if p])
         stdout = stderr = None
         try:
@@ -410,7 +423,7 @@ class Node:
                 stdout = open(out_path, "ab", buffering=0)
                 stderr = open(err_path, "ab", buffering=0)
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main",
+                [python_exe, "-m", "ray_tpu.core.worker_main",
                  "--node-host", self.address[0],
                  "--node-port", str(self.address[1]),
                  "--controller-host", self.controller_addr[0],
@@ -451,20 +464,6 @@ class Node:
                 raise TimeoutError(
                     f"worker {worker_id.hex()} failed to register")
         return handle
-
-    def _materialize_working_dir(
-            self, runtime_env: Dict[str, Any]) -> Optional[str]:
-        """Resolve runtime_env['working_dir'] to a local directory: plain
-        paths pass through; ``kv://<key>`` zips (uploaded by the driver via
-        ray_tpu.runtime_env.upload_working_dir) are fetched from the
-        controller KV and extracted once per env hash (reference:
-        _private/runtime_env/packaging.py working_dir packages)."""
-        spec = runtime_env.get("working_dir")
-        if not spec:
-            return None
-        from ray_tpu.runtime_env import materialize_working_dir
-
-        return materialize_working_dir(spec, self._controller)
 
     def register_worker(self, worker_id_bytes: bytes, addr: Addr) -> Dict[str, Any]:
         worker_id = WorkerID(worker_id_bytes)

@@ -85,7 +85,7 @@ class RemoteFunction:
             "retry_on_crash": opts.get("max_retries", 3) != 0,
             "scheduling_strategy": _strategy_dict(opts.get("scheduling_strategy")),
             "placement": _placement_tuple(opts),
-            "runtime_env": opts.get("runtime_env"),
+            "runtime_env": _normalized_env(opts),
         }
         refs = core.submit_task(key, self._desc, args, kwargs,
                                 submit_options)
@@ -97,6 +97,17 @@ class RemoteFunction:
         raise TypeError(
             f"Remote function {self._desc} cannot be called directly; "
             f"use .remote().")
+
+
+def _normalized_env(opts) -> Optional[Dict[str, Any]]:
+    """Validate the runtime_env at SUBMISSION time (typos and bad types
+    fail in the driver, not as a lease error minutes later)."""
+    spec = opts.get("runtime_env")
+    if not spec:
+        return None
+    from ray_tpu.runtime_env import normalize
+
+    return normalize(spec)
 
 
 def _strategy_dict(strategy) -> Optional[Dict[str, Any]]:

@@ -1,14 +1,23 @@
-"""Runtime environments: per-task/actor env vars + working_dir packages.
+"""Runtime environments: per-task/actor isolated Python environments.
 
 Analogue of the reference's runtime-env subsystem
 (``_private/runtime_env/agent/runtime_env_agent.py:162`` builds envs on
-each node; ``packaging.py`` ships working_dir zips through the GCS KV).
-The supported spec keys:
+each node; ``packaging.py`` ships working_dir/py_modules zips through the
+GCS KV; ``runtime_env/pip.py`` builds per-env virtualenvs). The supported
+spec keys:
 
 * ``env_vars``: dict merged into the worker's environment at fork.
 * ``working_dir``: local path (same-host clusters) or ``kv://<key>`` from
   :func:`upload_working_dir` — extracted once per node per env hash, set
   as the worker's cwd and prepended to ``PYTHONPATH``.
+* ``py_modules``: list of module/package paths or ``kv://`` zips from
+  :func:`upload_py_module` — each lands on the worker's ``PYTHONPATH``.
+* ``pip``: list of requirement strings (or local wheel paths). Built into
+  a per-hash virtualenv on each node (``--system-site-packages`` so jax &
+  friends stay visible — the TPU stack must not be reinstalled per env),
+  cached across leases; the worker forks from the venv's interpreter.
+  Build failures surface at lease time as the task's error (reference:
+  ``pip.py`` + the agent's CreateRuntimeEnv reply).
 
 Workers are pooled per runtime-env hash (reference: worker_pool.h's
 runtime_env_hash matching), so repeated tasks with the same env reuse
@@ -19,8 +28,13 @@ from __future__ import annotations
 
 import io
 import os
+import subprocess
+import sys
+import threading
 import zipfile
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional
+
+ENV_ROOT = "/tmp/ray_tpu_envs"
 
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
@@ -68,7 +82,7 @@ def materialize_working_dir(spec: str, controller_client) -> str:
     import hashlib
 
     key = str(spec)[len("kv://"):]
-    dest = os.path.join("/tmp/ray_tpu_envs",
+    dest = os.path.join(ENV_ROOT,
                         hashlib.sha1(key.encode()).hexdigest()[:16])
     marker = os.path.join(dest, ".ready")
     if not os.path.exists(marker):
@@ -83,6 +97,126 @@ def materialize_working_dir(spec: str, controller_client) -> str:
     return dest
 
 
+def upload_py_module(path: str) -> str:
+    """Package one module/package directory (zipped UNDER its own name, so
+    the extraction dir is a valid sys.path entry) and upload to the KV;
+    returns the ``kv://`` URI for ``runtime_env['py_modules']``
+    (reference: packaging.py py_modules upload)."""
+    import hashlib
+
+    from ray_tpu.core.runtime import get_core_worker
+
+    root = os.path.abspath(path)
+    name = os.path.basename(root.rstrip("/"))
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(root):
+            zf.write(root, name)
+        else:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames
+                               if d not in _EXCLUDE_DIRS]
+                for fname in filenames:
+                    full = os.path.join(dirpath, fname)
+                    zf.write(full, os.path.join(
+                        name, os.path.relpath(full, root)))
+    blob = buf.getvalue()
+    key = f"__pkg__/{hashlib.sha1(blob).hexdigest()[:20]}.zip"
+    get_core_worker().controller.call("kv_put", key, blob)
+    return f"kv://{key}"
+
+
+def materialize_py_module(spec: str, controller_client) -> str:
+    """Resolve one py_modules entry to a sys.path directory: ``kv://``
+    zips extract (cached per content) and the extraction dir is the path
+    entry; plain paths contribute their parent directory."""
+    if str(spec).startswith("kv://"):
+        return materialize_working_dir(spec, controller_client)
+    return os.path.dirname(os.path.abspath(str(spec)))
+
+
+# ----------------------------------------------------------- pip / venv
+
+_pip_lock = threading.Lock()
+
+
+def pip_env_dir(pip: List[str]) -> str:
+    import hashlib
+    import json
+
+    key = hashlib.sha1(
+        json.dumps(list(pip), sort_keys=True).encode()).hexdigest()[:16]
+    return os.path.join(ENV_ROOT, f"venv-{key}")
+
+
+def ensure_pip_env(pip: List[str]) -> str:
+    """Build (once, cached per requirement-list hash) a virtualenv with the
+    requested packages; returns its python executable. The venv sees the
+    base interpreter's site-packages (--system-site-packages), so the
+    heavyweight TPU stack is inherited, not reinstalled (reference:
+    runtime_env/pip.py builds a venv per env and caches by URI hash)."""
+    dest = pip_env_dir(pip)
+    python = os.path.join(dest, "bin", "python")
+    marker = os.path.join(dest, ".ready")
+    if os.path.exists(marker):
+        return python
+    with _pip_lock:  # serialize builds in this node process
+        if os.path.exists(marker):
+            return python
+        build = f"{dest}.build-{os.getpid()}"
+        try:
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 build],
+                check=True, capture_output=True, text=True, timeout=300)
+            proc = subprocess.run(
+                [os.path.join(build, "bin", "python"), "-m", "pip",
+                 "install", "--no-input", *pip],
+                capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip install {pip} failed: "
+                    f"{(proc.stderr or proc.stdout)[-800:]}")
+            with open(os.path.join(build, ".ready"), "w") as f:
+                f.write("ok")
+            try:
+                os.rename(build, dest)
+            except OSError:
+                if not os.path.exists(marker):  # lost a cross-process race
+                    raise
+        finally:
+            import shutil
+
+            shutil.rmtree(build, ignore_errors=True)
+    return python
+
+
+def build_env(runtime_env: Dict[str, Any],
+              controller_client) -> Dict[str, Any]:
+    """Materialize a full runtime env on this node. Returns
+    ``{python, pythonpath, cwd, env_vars}`` for the worker fork; raises on
+    build failure (the node surfaces it in the lease reply — reference:
+    the raylet failing a lease when the agent's CreateRuntimeEnv errors)."""
+    out: Dict[str, Any] = {
+        "python": None,
+        "pythonpath": [],
+        "cwd": None,
+        "env_vars": {str(k): str(v) for k, v in
+                     (runtime_env.get("env_vars") or {}).items()},
+    }
+    wd = runtime_env.get("working_dir")
+    if wd:
+        out["cwd"] = materialize_working_dir(wd, controller_client)
+        out["pythonpath"].append(out["cwd"])
+    for mod in runtime_env.get("py_modules") or []:
+        out["pythonpath"].append(
+            materialize_py_module(mod, controller_client))
+    pip = runtime_env.get("pip")
+    if pip:
+        out["python"] = ensure_pip_env(list(pip))
+    return out
+
+
 def normalize(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
     """Validate + normalize a runtime_env spec (uploads local working_dir
     automatically when the cluster spans hosts is the caller's choice —
@@ -94,8 +228,23 @@ def normalize(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
     wd = runtime_env.get("working_dir")
     if wd:
         out["working_dir"] = str(wd)
-    unknown = set(runtime_env) - {"env_vars", "working_dir"}
+    mods = runtime_env.get("py_modules")
+    if mods:
+        if not isinstance(mods, (list, tuple)):
+            raise ValueError("runtime_env['py_modules'] must be a list of "
+                             "paths or kv:// URIs")
+        out["py_modules"] = [str(m) for m in mods]
+    pip = runtime_env.get("pip")
+    if pip:
+        if not isinstance(pip, (list, tuple)) or not all(
+                isinstance(p, str) for p in pip):
+            raise ValueError("runtime_env['pip'] must be a list of "
+                             "requirement strings")
+        out["pip"] = list(pip)
+    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules",
+                                  "pip"}
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
-                         "(supported: env_vars, working_dir)")
+                         "(supported: env_vars, working_dir, py_modules, "
+                         "pip)")
     return out

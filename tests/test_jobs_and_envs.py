@@ -164,3 +164,120 @@ def test_dashboard_endpoints(ray_start_regular):
         assert "object store" in html
     finally:
         server.shutdown()
+
+
+# ------------------------------------------------- runtime envs v2 (pip +
+# py_modules; VERDICT r2 #4. Reference: _private/runtime_env/pip.py,
+# packaging.py py_modules, agent/runtime_env_agent.py:162)
+
+
+def _make_wheel(path, name, version, source):
+    """Hand-rolled offline wheel (this box has zero egress, so the pip
+    test installs a local wheel absent from the base environment)."""
+    import base64
+    import hashlib
+    import zipfile
+
+    record = []
+
+    def add(zf, arcname, data):
+        zf.writestr(arcname, data)
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data.encode()).digest()).rstrip(b"=").decode()
+        record.append(f"{arcname},sha256={digest},{len(data)}")
+
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(path, "w") as zf:
+        add(zf, f"{name}.py", source)
+        add(zf, f"{dist}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n")
+        add(zf, f"{dist}/WHEEL", "Wheel-Version: 1.0\nGenerator: t\n"
+            "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        record.append(f"{dist}/RECORD,,")
+        zf.writestr(f"{dist}/RECORD", "\n".join(record) + "\n")
+
+
+@pytest.mark.timeout_s(240)
+def test_runtime_env_pip_wheel_isolated(ray_start_regular, tmp_path):
+    """A task whose runtime_env pips in a wheel ABSENT from the base env
+    imports it; a plain task on the same cluster cannot (isolation), and
+    same-env tasks reuse one worker (env-hash pooling)."""
+    whl = tmp_path / "envprobe_pkg-0.1-py3-none-any.whl"
+    _make_wheel(str(whl), "envprobe_pkg", "0.1", "MAGIC = 'from-wheel'\n")
+
+    @ray_tpu.remote
+    def probe():
+        import envprobe_pkg
+
+        return envprobe_pkg.MAGIC, os.getpid()
+
+    env = {"pip": [str(whl)]}
+    magic, pid1 = ray_tpu.get(
+        probe.options(runtime_env=env).remote(), timeout=240)
+    assert magic == "from-wheel"
+    _, pid2 = ray_tpu.get(
+        probe.options(runtime_env=env).remote(), timeout=120)
+    assert pid1 == pid2  # same env hash -> pooled worker reused
+
+    @ray_tpu.remote
+    def probe_base():
+        try:
+            import envprobe_pkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(probe_base.remote(), timeout=60) == "isolated"
+
+
+def test_runtime_env_pip_failure_surfaces(ray_start_regular):
+    """A broken pip spec fails the lease, and the task's error says why."""
+    @ray_tpu.remote
+    def never():
+        return 1
+
+    ref = never.options(
+        max_retries=0,
+        runtime_env={"pip": ["/nonexistent/definitely-missing.whl"]},
+    ).remote()
+    with pytest.raises(Exception, match="pip|lease|worker start"):
+        ray_tpu.get(ref, timeout=120)
+
+
+def test_runtime_env_py_modules_local_and_kv(ray_start_regular, tmp_path):
+    """py_modules via a local package dir and via a kv:// upload both land
+    on the worker's import path."""
+    pkg = tmp_path / "kvmod"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("WHO = 'kvmod-local'\n")
+
+    @ray_tpu.remote
+    def who():
+        import kvmod
+
+        return kvmod.WHO
+
+    env = {"py_modules": [str(pkg)]}
+    assert ray_tpu.get(who.options(runtime_env=env).remote(),
+                       timeout=120) == "kvmod-local"
+
+    from ray_tpu.runtime_env import upload_py_module
+
+    (pkg / "__init__.py").write_text("WHO = 'kvmod-kv'\n")
+    uri = upload_py_module(str(pkg))
+    assert uri.startswith("kv://")
+    assert ray_tpu.get(
+        who.options(runtime_env={"py_modules": [uri]}).remote(),
+        timeout=120) == "kvmod-kv"
+
+
+def test_runtime_env_rejects_unknown_keys(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        f.options(runtime_env={"conda": {"deps": []}}).remote()
+    with pytest.raises(ValueError, match="pip"):
+        f.options(runtime_env={"pip": "not-a-list"}).remote()
