@@ -39,10 +39,14 @@ class LaunchedCluster:
         self.head_node = None
         self.autoscaler = None
         self.provider = None
+        self.head_path = None          # tpu_vm: head slice resource path
+        self.api_client = None         # tpu_vm: TpuVmClient (head teardown)
         self.actions: List[str] = []   # human-readable launch log
 
     def shutdown(self) -> None:
-        """Stop autoscaler -> workers -> head (reverse launch order)."""
+        """Stop autoscaler -> workers -> head (reverse launch order). The
+        tpu_vm provider only lists THIS cluster's workers (label filter),
+        so the head slice is deleted explicitly here."""
         if self.autoscaler is not None:
             self.autoscaler.stop()
         if self.provider is not None:
@@ -51,6 +55,12 @@ class LaunchedCluster:
                     self.provider.terminate_node(pid)
                 except Exception:
                     pass
+        if self.api_client is not None and self.head_path is not None:
+            try:
+                self.api_client.delete_node(self.head_path)
+                self.actions.append(f"deleted head slice {self.head_path}")
+            except Exception:
+                pass
         if self.head_node is not None:
             self.head_node.stop()
         if self.controller is not None:
@@ -160,8 +170,10 @@ def _up_tpu_vm(cfg: ClusterConfig) -> LaunchedCluster:
     cluster = LaunchedCluster(cfg)
     client = TpuVmClient(cfg.provider.project_id, cfg.provider.zone,
                          dry_run=cfg.dry_run)
+    cluster.api_client = client
     head_name = f"{cfg.cluster_name}-head"
     head_path = f"{client.parent}/nodes/{head_name}"
+    cluster.head_path = head_path
     op = client.create_node(
         head_name, cfg.provider.accelerator_type,
         cfg.provider.runtime_version,

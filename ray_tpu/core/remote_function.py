@@ -86,6 +86,7 @@ class RemoteFunction:
             "scheduling_strategy": _strategy_dict(opts.get("scheduling_strategy")),
             "placement": _placement_tuple(opts),
             "runtime_env": _normalized_env(opts),
+            "inline_results": opts.get("inline_results", True),
         }
         refs = core.submit_task(key, self._desc, args, kwargs,
                                 submit_options)

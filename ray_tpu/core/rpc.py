@@ -147,11 +147,11 @@ class RpcServer:
                     try:
                         self._pool.submit(self._handle, conn, send_lock, msg)
                     except RuntimeError:
-                        # Pool shut down while a request was in flight
-                        # (server stopping): drop the request quietly.
-                        if self._stopped.is_set():
-                            break
-                        raise
+                        # Pool shut down while a request was in flight:
+                        # server stopping, or interpreter exit (the
+                        # concurrent.futures atexit hook kills all pools
+                        # before daemon threads die). Drop the request.
+                        break
         except (ConnectionError, OSError):
             pass
         finally:

@@ -670,6 +670,8 @@ class CoreWorker:
             "return_ids": [o.binary() for o in return_ids],
             "owner_addr": self.addr,
         }
+        if not options.get("inline_results", True):
+            spec["force_shm"] = True
         from ray_tpu.util import tracing
 
         trace_ctx = tracing.context_for_spec()
@@ -754,7 +756,8 @@ class CoreWorker:
                         f"task {spec['desc']} declared num_returns={n} but "
                         f"returned {len(result)} values")
                 results = list(result)
-            return {"ok": True, "results": self._pack_results(results)}
+            return {"ok": True, "results": self._pack_results(
+                results, force_shm=spec.get("force_shm", False))}
         except BaseException as e:  # noqa: BLE001 — shipped to the owner
             err = TaskError(e, task_desc=spec.get("desc", ""))
             return {"ok": False,
@@ -762,18 +765,26 @@ class CoreWorker:
         finally:
             self._current_task_desc.value = None
 
-    def _pack_results(self, results: List[Any]) -> List[tuple]:
+    def _pack_results(self, results: List[Any],
+                      force_shm: bool = False) -> List[tuple]:
         """Serialize task returns; large frames go into this node's shm store
         and ship as locators (reference: small returns in-band to the owner's
         memory store, large returns plasma-put — core_worker task reply
         path). Each element is ("inline", bytes, nested_refs) or
         ("shm", locator, nested_refs); nested_refs are the ObjectRefs pickled
-        inside the frame — the owner pins them for the frame's lifetime."""
+        inside the frame — the owner pins them for the frame's lifetime.
+
+        ``force_shm`` (task option ``inline_results=False``) routes even
+        small returns through the node store: an all-to-all exchange emits
+        P^2 sub-threshold slices whose inline copies would otherwise pile
+        up O(dataset) in the owner's heap while the exchange is in flight
+        (the reference keeps shuffle chunks in plasma for the same
+        reason)."""
         packed = []
         for r in results:
             with serialization.capture_refs() as nested:
                 total, write = serialization.build_frame(r)
-            if total > config.inline_object_max_bytes:
+            if force_shm or total > config.inline_object_max_bytes:
                 oid = ObjectID.from_random()
                 locator = self._try_put_frame(oid, total, write)
                 if locator is not None:

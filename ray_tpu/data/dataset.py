@@ -23,23 +23,15 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 import numpy as np
 
 import ray_tpu
-
-Block = Dict[str, np.ndarray]
-
-
-def _block_len(block: Block) -> int:
-    if not block:
-        return 0
-    return len(next(iter(block.values())))
-
-
-def _concat_blocks(blocks: List[Block]) -> Block:
-    keys = blocks[0].keys()
-    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
-
-
-def _slice_block(block: Block, start: int, end: int) -> Block:
-    return {k: v[start:end] for k, v in block.items()}
+from ray_tpu.data.block import (
+    Block,
+    Schema,
+    block_len as _block_len,
+    block_nbytes as _block_nbytes,
+    concat_blocks as _concat_blocks,
+    slice_block as _slice_block,
+    wrap_batch_fn,
+)
 
 
 # ----------------------------------------- shuffle/repartition exchanges
@@ -287,13 +279,42 @@ class Dataset:
     # ---------------------------------------------------- transformations
 
     def map_batches(self, fn: Callable[[Block], Block],
-                    compute: str = "tasks", concurrency: int = 2,
+                    compute: str = "tasks", concurrency=2,
                     fn_constructor_args: tuple = (),
+                    batch_format: str = "numpy",
                     **_compat) -> "Dataset":
-        """``compute="actors"`` runs this op on a pool of ``concurrency``
-        stateful actors; ``fn`` may be a callable CLASS constructed once per
-        actor (reference: ``Dataset.map_batches`` compute=ActorPoolStrategy,
-        ``actor_pool_map_operator.py``)."""
+        """``compute="actors"`` runs this op on a pool of stateful actors;
+        ``concurrency`` is a fixed size or a ``(min, max)`` autoscaling
+        range (reference: ``ActorPoolStrategy(min_size, max_size)``); ``fn``
+        may be a callable CLASS constructed once per actor.
+        ``batch_format`` selects what ``fn`` sees: ``"numpy"`` (dict of
+        column arrays, the canonical zero-copy block), ``"pyarrow"``
+        (``pa.Table``) or ``"pandas"`` (``pd.DataFrame``); the return value
+        may be any of the three."""
+        from ray_tpu.data.block import _FORMATS
+
+        if batch_format not in _FORMATS:
+            raise ValueError(f"batch_format must be one of {_FORMATS}, "
+                             f"got {batch_format!r}")
+        if batch_format != "numpy":
+            import inspect
+
+            if inspect.isclass(fn):
+                # Wrap the *instance* call, preserving once-per-actor
+                # construction semantics.
+                orig_cls = fn
+
+                class _Formatted:
+                    def __init__(self, *a):
+                        self._wrapped = wrap_batch_fn(orig_cls(*a),
+                                                      batch_format)
+
+                    def __call__(self, block):
+                        return self._wrapped(block)
+
+                fn = _Formatted
+            else:
+                fn = wrap_batch_fn(fn, batch_format)
         return Dataset(self._block_refs, self._ops + [_MapBatches(
             fn, compute, concurrency, fn_constructor_args)])
 
@@ -328,7 +349,8 @@ class Dataset:
         offset = 0
         for ref, count in zip(mat._block_refs, counts):
             out = _slice_for_ranges.options(
-                num_returns=num_blocks).remote(ref, offset, bounds)
+                num_returns=num_blocks,
+                inline_results=False).remote(ref, offset, bounds)
             parts.append(out if isinstance(out, list) else [out])
             offset += count
         live = [p for p, (lo, hi) in enumerate(zip(bounds, bounds[1:]))
@@ -355,7 +377,8 @@ class Dataset:
         base_seed = seed
         parts = []
         for i, ref in enumerate(mat._block_refs):
-            out = _shuffle_scatter.options(num_returns=num_parts).remote(
+            out = _shuffle_scatter.options(num_returns=num_parts,
+                                         inline_results=False).remote(
                 ref, num_parts, base_seed + 7919 * i)
             parts.append(out if isinstance(out, list) else [out])
         out_refs = [
@@ -390,7 +413,8 @@ class Dataset:
         boundaries = samples[idx]
         parts = []
         for ref in mat._block_refs:
-            out = _range_scatter.options(num_returns=num_parts).remote(
+            out = _range_scatter.options(num_returns=num_parts,
+                                       inline_results=False).remote(
                 ref, key, boundaries)
             parts.append(out if isinstance(out, list) else [out])
         order = range(num_parts - 1, -1, -1) if descending else range(
@@ -429,7 +453,8 @@ class Dataset:
         offset = 0
         n_out = len(counts)
         for ref, count in zip(right._block_refs, r_counts):
-            out = _slice_for_ranges.options(num_returns=n_out).remote(
+            out = _slice_for_ranges.options(num_returns=n_out,
+                                          inline_results=False).remote(
                 ref, offset, bounds)
             parts.append(out if isinstance(out, list) else [out])
             offset += count
@@ -463,11 +488,13 @@ class Dataset:
                 break
         return Dataset(refs)
 
-    def schema(self) -> Dict[str, Any]:
-        """Column name -> (dtype, element shape) from the first block."""
+    def schema(self) -> Optional[Schema]:
+        """Arrow-typed schema from the first block (reference:
+        ``Dataset.schema()``): iterable of names, ``[name] -> (np dtype,
+        element shape)``, ``.types[name]`` -> Arrow type."""
         for block in self._streamed_blocks(max_in_flight=1):
-            return {k: (v.dtype, v.shape[1:]) for k, v in block.items()}
-        return {}
+            return Schema(block)
+        return None
 
     # ------------------------------------------------- global aggregates
 
@@ -534,9 +561,30 @@ class Dataset:
         return any(isinstance(op, _MapBatches) and op.compute == "actors"
                    for op in self._ops)
 
-    def _streamed_blocks(self, max_in_flight: int = 8) -> Iterator[Block]:
-        """Pull-based streaming execution with a bounded in-flight window
-        (the backpressure half of the reference's StreamingExecutor)."""
+    @staticmethod
+    def _memory_budget_bytes() -> int:
+        """Streaming-window memory budget: a fraction of the local node's
+        FREE object-store capacity (reference: the streaming executor's
+        resource-manager budget, ``execution/resource_manager.py`` — the
+        window must shrink when the store is tight, not use a constant)."""
+        try:
+            from ray_tpu.core.runtime import get_core_worker
+
+            core = get_core_worker()
+            info = core.clients.get(core.node_addr).call("get_info",
+                                                         timeout=5.0)
+            free = info["store_capacity_bytes"] - info["store_used_bytes"]
+            return max(32 * 1024 * 1024, free // 4)
+        except Exception:
+            return 256 * 1024 * 1024
+
+    def _streamed_blocks(self,
+                         max_in_flight: Optional[int] = None
+                         ) -> Iterator[Block]:
+        """Pull-based streaming execution with a memory-aware in-flight
+        window (the backpressure half of the reference's StreamingExecutor):
+        the window targets ``budget / block_bytes`` blocks, sized after the
+        first block and clamped to [2, 32]."""
         if self._has_actor_ops():
             # Actor segments materialize via the pool executor.
             for ref in self.materialize()._block_refs:
@@ -548,15 +596,29 @@ class Dataset:
             return
         fused = _fuse_ops(self._ops)
         process = ray_tpu.remote(lambda block: fused(block))
+        ref_iter = iter(self._block_refs)
         pending: List[Any] = []
-        refs = iter(self._block_refs)
-        for ref in itertools.islice(refs, max_in_flight):
-            pending.append(process.remote(ref))
-        for ref in refs:
-            yield ray_tpu.get(pending.pop(0))
-            pending.append(process.remote(ref))
-        for p in pending:
-            yield ray_tpu.get(p)
+        fixed = max_in_flight is not None
+        window = max_in_flight if fixed else 2
+
+        def refill():
+            while len(pending) < window:
+                try:
+                    pending.append(process.remote(next(ref_iter)))
+                except StopIteration:
+                    return
+
+        refill()
+        sized = fixed
+        while pending:
+            block = ray_tpu.get(pending.pop(0))
+            if not sized:
+                sized = True
+                size = max(1, _block_nbytes(block))
+                window = int(np.clip(
+                    self._memory_budget_bytes() // size, 2, 32))
+            refill()
+            yield block
 
     def materialize(self) -> "Dataset":
         if not self._ops:
@@ -586,19 +648,56 @@ class Dataset:
         return Dataset(refs)
 
     def _actor_map(self, op: "_MapBatches", refs: List[Any]) -> List[Any]:
+        """Actor-pool execution with min/max autoscaling (reference:
+        ``actor_pool_map_operator.py`` + ``ActorPoolStrategy(min_size,
+        max_size)``): start ``min`` workers, submit with bounded per-actor
+        in-flight, and add workers (up to ``max``) while a backlog remains.
+        Results stay as refs — the data plane never routes through the
+        driver."""
         from ray_tpu.core import serialization
+
+        if isinstance(op.concurrency, (tuple, list)):
+            min_size, max_size = op.concurrency
+        else:
+            min_size = max_size = int(op.concurrency)
+        min_size = max(1, min_size)
+        max_size = max(min_size, max_size)
+        per_actor_in_flight = 2
 
         worker_cls = ray_tpu.remote(_ActorMapWorker)
         fn_blob = serialization.dumps_function(op.fn)
         actors = [worker_cls.options(num_cpus=1).remote(
-            fn_blob, op.fn_constructor_args)
-            for _ in range(max(1, op.concurrency))]
+            fn_blob, op.fn_constructor_args) for _ in range(min_size)]
         try:
-            # Round-robin blocks over the pool; results stay as refs (the
-            # data plane never routes through the driver).
-            out_refs = [actors[i % len(actors)].apply.remote(ref)
-                        for i, ref in enumerate(refs)]
-            ray_tpu.wait(out_refs, num_returns=len(out_refs), timeout=None)
+            out_refs: List[Any] = [None] * len(refs)
+            in_flight: Dict[Any, int] = {}  # result ref -> actor index
+            load = [0] * len(actors)
+            queue = list(enumerate(refs))
+            while queue or in_flight:
+                # Scale up: backlog beyond what the pool can absorb.
+                backlog = len(queue) - sum(
+                    per_actor_in_flight - l for l in load if
+                    l < per_actor_in_flight)
+                if backlog > 0 and len(actors) < max_size:
+                    actors.append(worker_cls.options(num_cpus=1).remote(
+                        fn_blob, op.fn_constructor_args))
+                    load.append(0)
+                # Submit to the least-loaded actors up to the cap.
+                while queue:
+                    ai = min(range(len(actors)), key=lambda i: load[i])
+                    if load[ai] >= per_actor_in_flight:
+                        break
+                    i, ref = queue.pop(0)
+                    out = actors[ai].apply.remote(ref)
+                    out_refs[i] = out
+                    in_flight[out] = ai
+                    load[ai] += 1
+                if in_flight:
+                    ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                            timeout=None)
+                    for r in ready:
+                        load[in_flight.pop(r)] -= 1
+            self.last_actor_pool_size = len(actors)
             return out_refs
         finally:
             for actor in actors:
@@ -741,7 +840,8 @@ class GroupedData:
         num_parts = max(1, len(mat._block_refs))
         parts = []
         for ref in mat._block_refs:
-            out = _hash_scatter.options(num_returns=num_parts).remote(
+            out = _hash_scatter.options(num_returns=num_parts,
+                                      inline_results=False).remote(
                 ref, self._key, num_parts)
             parts.append(out if isinstance(out, list) else [out])
         return parts, num_parts

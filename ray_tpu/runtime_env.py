@@ -40,8 +40,10 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
 
-def package_working_dir(path: str) -> bytes:
-    """Zip a working directory (reference: packaging.py's package zips)."""
+def package_working_dir(path: str, arcname_prefix: str = "") -> bytes:
+    """Zip a directory (reference: packaging.py's package zips), size-capped.
+    ``arcname_prefix`` nests the content under one directory inside the
+    archive (py_modules packages zip under their own name)."""
     buf = io.BytesIO()
     root = os.path.abspath(path)
     total = 0
@@ -53,23 +55,27 @@ def package_working_dir(path: str) -> bytes:
                 total += os.path.getsize(full)
                 if total > _MAX_PACKAGE_BYTES:
                     raise ValueError(
-                        f"working_dir {path} exceeds "
+                        f"package {path} exceeds "
                         f"{_MAX_PACKAGE_BYTES >> 20} MiB")
-                zf.write(full, os.path.relpath(full, root))
+                zf.write(full, os.path.join(
+                    arcname_prefix, os.path.relpath(full, root)))
     return buf.getvalue()
+
+
+def _upload_blob(blob: bytes) -> str:
+    import hashlib
+
+    from ray_tpu.core.runtime import get_core_worker
+
+    key = f"__pkg__/{hashlib.sha1(blob).hexdigest()[:20]}.zip"
+    get_core_worker().controller.call("kv_put", key, blob)
+    return f"kv://{key}"
 
 
 def upload_working_dir(path: str) -> str:
     """Package + upload a working dir to the cluster KV; returns the
     ``kv://`` URI to put in ``runtime_env['working_dir']``."""
-    import hashlib
-
-    from ray_tpu.core.runtime import get_core_worker
-
-    blob = package_working_dir(path)
-    key = f"__pkg__/{hashlib.sha1(blob).hexdigest()[:20]}.zip"
-    get_core_worker().controller.call("kv_put", key, blob)
-    return f"kv://{key}"
+    return _upload_blob(package_working_dir(path))
 
 
 def materialize_working_dir(spec: str, controller_client) -> str:
@@ -98,32 +104,18 @@ def materialize_working_dir(spec: str, controller_client) -> str:
 
 
 def upload_py_module(path: str) -> str:
-    """Package one module/package directory (zipped UNDER its own name, so
-    the extraction dir is a valid sys.path entry) and upload to the KV;
-    returns the ``kv://`` URI for ``runtime_env['py_modules']``
-    (reference: packaging.py py_modules upload)."""
-    import hashlib
-
-    from ray_tpu.core.runtime import get_core_worker
-
+    """Package one module/package (zipped UNDER its own name, so the
+    extraction dir is a valid sys.path entry) and upload to the KV; returns
+    the ``kv://`` URI for ``runtime_env['py_modules']`` (reference:
+    packaging.py py_modules upload)."""
     root = os.path.abspath(path)
     name = os.path.basename(root.rstrip("/"))
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-        if os.path.isfile(root):
+    if os.path.isfile(root):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.write(root, name)
-        else:
-            for dirpath, dirnames, filenames in os.walk(root):
-                dirnames[:] = [d for d in dirnames
-                               if d not in _EXCLUDE_DIRS]
-                for fname in filenames:
-                    full = os.path.join(dirpath, fname)
-                    zf.write(full, os.path.join(
-                        name, os.path.relpath(full, root)))
-    blob = buf.getvalue()
-    key = f"__pkg__/{hashlib.sha1(blob).hexdigest()[:20]}.zip"
-    get_core_worker().controller.call("kv_put", key, blob)
-    return f"kv://{key}"
+        return _upload_blob(buf.getvalue())
+    return _upload_blob(package_working_dir(root, arcname_prefix=name))
 
 
 def materialize_py_module(spec: str, controller_client) -> str:

@@ -351,3 +351,160 @@ def test_from_pandas_arrow(ray_start_regular):
     table = pa.table({"x": [10, 20]})
     ds = rdata.from_arrow(table)
     assert ds.sum("x") == 30
+
+
+# --------------------------------------------------- data engine v2
+# (VERDICT r2 #5: Arrow interop, batch formats, memory-aware window,
+# autoscaling actor pool. Reference: _internal/arrow_block.py,
+# block_batching, streaming_executor.py:48, actor_pool_map_operator.py)
+
+
+def test_map_batches_pyarrow_and_pandas_formats(ray_start_regular):
+    import pyarrow as pa
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_numpy({"x": np.arange(100, dtype=np.int64)},
+                          num_blocks=4)
+
+    def arrow_fn(table):
+        import pyarrow.compute as pc
+
+        assert isinstance(table, pa.Table)
+        return table.append_column(
+            "y", pc.multiply(table.column("x"), 2))
+
+    out = ds.map_batches(arrow_fn, batch_format="pyarrow")
+    rows = list(out.iter_rows())
+    assert all(r["y"] == 2 * r["x"] for r in rows)
+
+    def pandas_fn(df):
+        import pandas as pd
+
+        assert isinstance(df, pd.DataFrame)
+        df["z"] = df["x"] + 1
+        return df
+
+    out2 = ds.map_batches(pandas_fn, batch_format="pandas")
+    assert all(r["z"] == r["x"] + 1 for r in out2.iter_rows())
+
+    with pytest.raises(ValueError, match="batch_format"):
+        ds.map_batches(lambda b: b, batch_format="polars")
+
+
+def test_arrow_zero_copy_roundtrip():
+    import pyarrow as pa
+
+    from ray_tpu.data.block import from_arrow, to_arrow
+
+    block = {"a": np.arange(1000, dtype=np.float32),
+             "m": np.ones((1000, 4), dtype=np.int32)}
+    table = to_arrow(block)
+    assert isinstance(table, pa.Table)
+    back = from_arrow(table)
+    np.testing.assert_array_equal(back["a"], block["a"])
+    np.testing.assert_array_equal(back["m"], block["m"])
+    # Primitive 1-D columns round-trip without copying the data buffer.
+    assert back["a"].__array_interface__["data"][0] == \
+        block["a"].__array_interface__["data"][0]
+
+
+def test_schema_arrow_types(ray_start_regular):
+    import pyarrow as pa
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_numpy({"i": np.arange(10, dtype=np.int32),
+                           "f": np.ones(10),
+                           "v": np.zeros((10, 3), np.float32)})
+    sch = ds.schema()
+    assert sch.types["i"] == pa.int32()
+    assert sch.types["f"] == pa.float64()
+    assert sch["v"] == (np.dtype(np.float32), (3,))
+    assert set(sch) == {"i", "f", "v"}
+
+
+@pytest.mark.timeout_s(240)
+def test_actor_pool_autoscales_between_min_max(ray_start_regular):
+    """concurrency=(1, 3): a backlog of slow blocks grows the pool past its
+    min size; results are correct and ordered."""
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_numpy({"x": np.arange(24, dtype=np.int64)},
+                          num_blocks=12)
+
+    class SlowId:
+        def __call__(self, block):
+            import os
+            import time
+
+            time.sleep(0.3)
+            return {**block, "pid": np.full(len(block["x"]), os.getpid())}
+
+    out = ds.map_batches(SlowId, compute="actors", concurrency=(1, 3))
+    mat = out.materialize()
+    rows = list(mat.iter_rows())
+    assert sorted(r["x"] for r in rows) == list(range(24))
+    assert len({r["pid"] for r in rows}) >= 2, "pool never scaled past min"
+    assert out.last_actor_pool_size <= 3
+
+
+@pytest.mark.timeout_s(240)
+def test_shuffle_iterate_larger_than_store_bounded_memory(ray_start_cluster):
+    """A dataset ~2.5x the object-store capacity shuffles and iterates with
+    bounded driver RSS: blocks spill + stream through the memory-aware
+    window instead of accumulating (reference: streaming executor
+    backpressure + object spilling)."""
+    import ray_tpu
+    from ray_tpu.core.config import config
+
+    old = config.object_store_memory_bytes
+    config.object_store_memory_bytes = 96 * 1024 * 1024
+    try:
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=2)
+        ray_tpu.init(address=cluster.address)
+        from ray_tpu import data as rdata
+
+        n_blocks, rows_per = 60, 500_000  # 4 MB/block, 240 MB total
+        ds = rdata.from_numpy(
+            {"x": np.arange(n_blocks * rows_per, dtype=np.int64)},
+            num_blocks=n_blocks)
+        shuffled = ds.random_shuffle(seed=7)
+
+        import psutil
+
+        proc = psutil.Process()
+        start_rss = proc.memory_info().rss
+        peak_extra = 0
+        total = 0
+        count = 0
+        for batch in shuffled.map_batches(
+                lambda b: {"x": b["x"]}).iter_batches(batch_size=250_000):
+            total += int(batch["x"].sum())
+            count += len(batch["x"])
+            peak_extra = max(peak_extra,
+                             proc.memory_info().rss - start_rss)
+        n = n_blocks * rows_per
+        assert count == n
+        assert total == n * (n - 1) // 2  # every row exactly once
+        # Bounded: driver never held anything near the full dataset
+        # (240 MB); generous cap for allocator slack under load.
+        assert peak_extra < 160 * 1024 * 1024, f"RSS grew {peak_extra >> 20} MiB"
+    finally:
+        config.object_store_memory_bytes = old
+
+
+def test_arrow_tensor_shapes_and_slices_roundtrip():
+    from ray_tpu.data.block import from_arrow, to_arrow
+
+    block = {"m": np.arange(60, dtype=np.float32).reshape(10, 2, 3),
+             "x": np.arange(10, dtype=np.int64)}
+    table = to_arrow(block)
+    back = from_arrow(table)
+    assert back["m"].shape == (10, 2, 3)
+    np.testing.assert_array_equal(back["m"], block["m"])
+    # Sliced tables honor the offset (flatten(), not .values).
+    sl = from_arrow(table.slice(4, 3))
+    np.testing.assert_array_equal(sl["x"], block["x"][4:7])
+    np.testing.assert_array_equal(sl["m"], block["m"][4:7])
