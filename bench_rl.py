@@ -1,0 +1,82 @@
+"""RL throughput benchmark: env-steps/sec for PPO, DQN, SAC + multi-agent.
+
+Writes BENCH_RL.json — the committed artifact for BASELINE.json's
+"PPO env-steps/sec tracked" north star (VERDICT r2 #6: the number must
+live in the repo, not die in a result dict). Box-bound absolute numbers;
+the shape (sample + learn overlap, steps/sec accounting identical to the
+reference's ``env_runner_sampling_speed`` release test) is the comparison.
+
+Usage: python bench_rl.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+# RL inference/learning runs on host CPU by design (env runners are CPU
+# actors; the tunneled TPU chip adds ~ms of round-trip per tiny policy op).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import ray_tpu  # noqa: E402
+
+
+def bench(name: str, algo, iters: int, warmup: int = 2) -> dict:
+    for _ in range(warmup):  # compile + worker fork
+        algo.train()
+    t0 = time.monotonic()
+    steps = 0
+    returns = None
+    for _ in range(iters):
+        m = algo.train()
+        steps += m["env_steps_this_iter"] if "env_steps_this_iter" in m \
+            else m["env_steps_total"]
+        returns = m.get("episode_return_mean", returns)
+    wall = time.monotonic() - t0
+    algo.stop()
+    row = {"algo": name, "env_steps_per_sec": round(steps / wall, 1),
+           "iters": iters, "wall_s": round(wall, 1),
+           "episode_return_mean": returns}
+    print(json.dumps(row))
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=15)
+    args = ap.parse_args()
+
+    from ray_tpu.rl import DQNConfig, MultiAgentPPOConfig, PPOConfig, SACConfig
+
+    ray_tpu.init(num_cpus=6)
+    rows = [
+        bench("PPO/CartPole-v1", PPOConfig(
+            env="CartPole-v1", num_env_runners=2, seed=0).build(),
+            args.iters),
+        bench("DQN/CartPole-v1", DQNConfig(
+            env="CartPole-v1", num_env_runners=2, seed=0).build(),
+            args.iters),
+        bench("SAC/Pendulum-v1", SACConfig(
+            env="Pendulum-v1", num_env_runners=2, seed=0).build(),
+            args.iters),
+        bench("MultiAgentPPO/GuideFollow", MultiAgentPPOConfig(
+            num_env_runners=2, episodes_per_sample=16, seed=0).build(),
+            args.iters),
+    ]
+    ray_tpu.shutdown()
+    out = {
+        "metric": "rl_env_steps_per_sec",
+        "host": f"{os.cpu_count()}-core",
+        "rows": rows,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_RL.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -180,17 +180,15 @@ def from_pandas(df) -> Block:
     return out
 
 
-_FORMATS = ("numpy", "pyarrow", "pandas")
+BATCH_FORMATS = ("numpy", "pyarrow", "pandas")
 
 
 def wrap_batch_fn(fn, batch_format: str):
     """Adapt a user batch fn operating in ``batch_format`` to the canonical
     numpy block (reference: ``map_batches(batch_format=...)``,
     ``_internal/block_batching``). The fn may return any of the three
-    formats regardless of its input format."""
-    if batch_format not in _FORMATS:
-        raise ValueError(f"batch_format must be one of {_FORMATS}, "
-                         f"got {batch_format!r}")
+    formats regardless of its input format. Callers validate
+    ``batch_format`` against :data:`BATCH_FORMATS` up front."""
     if batch_format == "numpy":
         convert_in = None
     elif batch_format == "pyarrow":

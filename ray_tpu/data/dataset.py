@@ -291,10 +291,10 @@ class Dataset:
         column arrays, the canonical zero-copy block), ``"pyarrow"``
         (``pa.Table``) or ``"pandas"`` (``pd.DataFrame``); the return value
         may be any of the three."""
-        from ray_tpu.data.block import _FORMATS
+        from ray_tpu.data.block import BATCH_FORMATS
 
-        if batch_format not in _FORMATS:
-            raise ValueError(f"batch_format must be one of {_FORMATS}, "
+        if batch_format not in BATCH_FORMATS:
+            raise ValueError(f"batch_format must be one of {BATCH_FORMATS}, "
                              f"got {batch_format!r}")
         if batch_format != "numpy":
             import inspect

@@ -10,4 +10,11 @@ from ray_tpu.rl.models import (  # noqa: F401
     init_mlp_policy,
     mlp_forward,
 )
+from ray_tpu.rl.multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rl.ppo import PPO, PPOConfig, compute_gae  # noqa: F401
+from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401

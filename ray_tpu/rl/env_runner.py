@@ -69,18 +69,41 @@ class EnvRunner:
 
         from ray_tpu.rl.models import (
             build_policy,
+            build_squashed_gaussian_actor,
+            make_continuous_sample_fn,
             make_egreedy_sample_fn,
             make_sample_fn,
         )
 
-        n_actions = int(self.envs.single_action_space.n)
-        _unused_init, forward = build_policy(self.obs.shape[1:], n_actions)
+        space = self.envs.single_action_space
         self._policy_mode = policy_mode
         self._epsilon = 1.0
-        if policy_mode == "epsilon_greedy":
-            self._sample_fn = jax.jit(make_egreedy_sample_fn(forward))
+        self._action_dim = None
+        if policy_mode == "continuous":
+            # Box actions: the policy emits [-1, 1]^d, rescaled to the
+            # env's bounds at step time (reference: SAC's squashed actions
+            # + action-space normalization connector).
+            self._action_dim = int(np.prod(space.shape))
+            self._act_low = np.asarray(space.low, np.float32)
+            self._act_high = np.asarray(space.high, np.float32)
+            if not (np.isfinite(self._act_low).all()
+                    and np.isfinite(self._act_high).all()):
+                raise ValueError(
+                    f"continuous policy_mode needs finite action bounds to "
+                    f"rescale [-1, 1] actions; got low={self._act_low} "
+                    f"high={self._act_high}")
+            _init, actor_forward = build_squashed_gaussian_actor(
+                int(np.prod(self.obs.shape[1:])), self._action_dim)
+            self._sample_fn = jax.jit(
+                make_continuous_sample_fn(actor_forward))
         else:
-            self._sample_fn = jax.jit(make_sample_fn(forward))
+            n_actions = int(space.n)
+            _unused_init, forward = build_policy(self.obs.shape[1:],
+                                                 n_actions)
+            if policy_mode == "epsilon_greedy":
+                self._sample_fn = jax.jit(make_egreedy_sample_fn(forward))
+            else:
+                self._sample_fn = jax.jit(make_sample_fn(forward))
 
     def set_epsilon(self, eps: float) -> None:
         """Exploration rate for epsilon_greedy mode (DQN)."""
@@ -120,7 +143,10 @@ class EnvRunner:
         T, N = self.rollout_length, self.num_envs
         obs_dtype = self.obs.dtype
         obs_buf = np.zeros((T, N) + self.obs.shape[1:], obs_dtype)
-        act_buf = np.zeros((T, N), np.int64)
+        if self._action_dim is not None:
+            act_buf = np.zeros((T, N, self._action_dim), np.float32)
+        else:
+            act_buf = np.zeros((T, N), np.int64)
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
@@ -142,7 +168,15 @@ class EnvRunner:
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
             valid_buf[t] = 1.0 - self._prev_done.astype(np.float32)
-            obs, reward, terminated, truncated, _ = self.envs.step(action)
+            if self._action_dim is not None:
+                # Policy actions live in [-1, 1]; the env wants its bounds.
+                env_action = (self._act_low
+                              + (action + 1.0) * 0.5
+                              * (self._act_high - self._act_low))
+            else:
+                env_action = action
+            obs, reward, terminated, truncated, _ = self.envs.step(
+                env_action)
             done = np.logical_or(terminated, truncated)
             if self._stack is not None:
                 self._push_frames(obs, reset_mask=self._prev_done)
@@ -180,6 +214,8 @@ class EnvRunner:
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "terminateds": term_buf, "valids": valid_buf,
             "last_value": np.asarray(last_value, np.float32),
+            # Off-policy consumers build next_obs[T-1] from this.
+            "last_obs": self.obs.copy(),
             "weights_version": self._weights_version,
         }
 

@@ -165,6 +165,99 @@ def make_egreedy_sample_fn(forward):
     return sample_action
 
 
+# ------------------------------------------------ continuous control (SAC)
+
+
+def _mlp_init(key, sizes):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i in range(len(sizes) - 1):
+        params.append({
+            "w": jax.random.normal(
+                keys[i], (sizes[i], sizes[i + 1])) * math.sqrt(
+                    2.0 / sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],)),
+        })
+    return params
+
+
+def _mlp_apply(layers, x, final_linear=True):
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1 or not final_linear:
+            x = jax.nn.relu(x)
+    return x
+
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+def build_squashed_gaussian_actor(obs_dim: int, action_dim: int,
+                                  hidden: Sequence[int] = (256, 256)):
+    """Tanh-squashed diagonal Gaussian policy (SAC actor; reference:
+    ``rllib/algorithms/sac/sac_tf_policy.py`` SquashedGaussian
+    distribution). ``forward`` returns (mean, log_std); sampling and the
+    tanh-corrected log-prob live in :func:`squashed_sample`."""
+
+    def init(key):
+        return {"net": _mlp_init(key, [obs_dim, *hidden, 2 * action_dim])}
+
+    def forward(params, obs):
+        out = _mlp_apply(params["net"],
+                         obs.reshape(obs.shape[0], -1).astype(jnp.float32))
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    return init, forward
+
+
+def squashed_sample(mean, log_std, key):
+    """Sample a tanh-squashed Gaussian action and its log-prob (with the
+    change-of-variables correction, numerically stable form)."""
+    std = jnp.exp(log_std)
+    noise = jax.random.normal(key, mean.shape)
+    pre_tanh = mean + std * noise
+    action = jnp.tanh(pre_tanh)
+    logp_gauss = -0.5 * (noise ** 2 + 2 * log_std
+                         + math.log(2 * math.pi)).sum(-1)
+    # log(1 - tanh(x)^2) = 2 * (log 2 - x - softplus(-2x))
+    correction = (2.0 * (math.log(2.0) - pre_tanh
+                         - jax.nn.softplus(-2.0 * pre_tanh))).sum(-1)
+    return action, logp_gauss - correction
+
+
+def build_twin_q(obs_dim: int, action_dim: int,
+                 hidden: Sequence[int] = (256, 256)):
+    """Two independent Q(s, a) heads in one pytree (clipped double-Q;
+    reference: SAC's twin critics)."""
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        sizes = [obs_dim + action_dim, *hidden, 1]
+        return {"q1": _mlp_init(k1, sizes), "q2": _mlp_init(k2, sizes)}
+
+    def forward(params, obs, action):
+        x = jnp.concatenate(
+            [obs.reshape(obs.shape[0], -1).astype(jnp.float32), action],
+            axis=-1)
+        return (_mlp_apply(params["q1"], x)[..., 0],
+                _mlp_apply(params["q2"], x)[..., 0])
+
+    return init, forward
+
+
+def make_continuous_sample_fn(actor_forward):
+    """EnvRunner-facing sampler for continuous policies: (action in
+    [-1, 1]^d, logp, value placeholder)."""
+
+    def sample(params, obs, key):
+        mean, log_std = actor_forward(params, obs)
+        action, logp = squashed_sample(mean, log_std, key)
+        return action, logp, jnp.zeros(action.shape[0])
+
+    return sample
+
+
 # ------------------------------------------------- backward-compat surface
 
 def init_mlp_policy(key: jax.Array, obs_dim: int, num_actions: int,

@@ -92,6 +92,45 @@ def compute_gae(rollout: Dict[str, np.ndarray], gamma: float,
     return {"advantages": adv, "returns": returns}
 
 
+def make_ppo_update(forward, optimizer, clip_eps: float, vf_coeff: float,
+                    entropy_coeff: float):
+    """The clipped-surrogate PPO update as one jittable function (shared by
+    single- and multi-agent trainers; reference: PPOTorchLearner
+    compute_loss_for_module)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits, values = forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    def update(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = loss
+        return params, opt_state, aux
+
+    return update
+
+
 class PPO:
     def __init__(self, config: PPOConfig):
         import jax
@@ -116,44 +155,9 @@ class PPO:
     # ------------------------------------------------------------- losses
 
     def _make_update(self):
-        import jax
-        import jax.numpy as jnp
-        import optax
-
         cfg = self.config
-
-        forward = self._forward
-
-        def loss_fn(params, batch):
-            logits, values = forward(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["advantages"]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            surr = jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv)
-            pi_loss = -surr.mean()
-            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
-            entropy = -jnp.mean(
-                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
-            total = (pi_loss + cfg.vf_coeff * vf_loss
-                     - cfg.entropy_coeff * entropy)
-            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state,
-                                                       params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        return update
+        return make_ppo_update(self._forward, self.optimizer, cfg.clip_eps,
+                               cfg.vf_coeff, cfg.entropy_coeff)
 
     # ------------------------------------------------------------- train
 

@@ -1,0 +1,245 @@
+"""SAC: off-policy continuous control (soft actor-critic).
+
+Analogue of the reference's SAC (``rllib/algorithms/sac/sac.py`` +
+``sac_tf_policy.py``): squashed-Gaussian actor, twin Q critics with target
+networks (clipped double-Q), and automatic entropy-temperature tuning
+against the -|A| target. EnvRunner actors (CPU hosts) collect short
+rollouts with the current actor; transitions land in a uniform replay
+buffer; the learner runs jitted gradient steps (actor + critics + alpha in
+one fused XLA program) and polyak-averages the targets.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.common import ConfigBuilderMixin, make_env_runners, stop_runners
+from ray_tpu.rl.models import (
+    build_squashed_gaussian_actor,
+    build_twin_q,
+    squashed_sample,
+)
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+@dataclass
+class SACConfig(ConfigBuilderMixin):
+    env: str = "Pendulum-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 4
+    rollout_length: int = 32
+    policy_mode: str = "continuous"  # consumed by make_env_runners
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005               # polyak target rate
+    batch_size: int = 256
+    buffer_capacity: int = 200_000
+    updates_per_iteration: int = 64
+    warmup_steps: int = 1_000        # random-ish exploration before learning
+    hidden: tuple = (256, 256)
+    seed: int = 0
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        import gymnasium as gym
+        import jax
+        import optax
+
+        self.config = config
+        self._iteration = 0
+        self._total_env_steps = 0
+
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = int(np.prod(probe.action_space.shape))
+        probe.close()
+
+        k = jax.random.split(jax.random.key(config.seed), 3)
+        actor_init, self._actor_fwd = build_squashed_gaussian_actor(
+            obs_dim, action_dim, config.hidden)
+        critic_init, self._critic_fwd = build_twin_q(
+            obs_dim, action_dim, config.hidden)
+        self.actor = actor_init(k[0])
+        self.critic = critic_init(k[1])
+        self.target_critic = jax.tree.map(lambda x: x, self.critic)
+        # Auto-tuned temperature, optimized in log space (always > 0).
+        self.log_alpha = np.zeros(())
+        self._target_entropy = -float(action_dim)
+
+        self._actor_opt = optax.adam(config.actor_lr)
+        self._critic_opt = optax.adam(config.critic_lr)
+        self._alpha_opt = optax.adam(config.alpha_lr)
+        self.actor_opt_state = self._actor_opt.init(self.actor)
+        self.critic_opt_state = self._critic_opt.init(self.critic)
+        self.alpha_opt_state = self._alpha_opt.init(self.log_alpha)
+        self._update = jax.jit(self._make_update())
+        self._key = jax.random.key(config.seed + 1)
+
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.runners = make_env_runners(config)
+        self._broadcast_weights()
+
+    # ------------------------------------------------------------- learner
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        actor_fwd, critic_fwd = self._actor_fwd, self._critic_fwd
+
+        def critic_loss_fn(critic, actor, target_critic, log_alpha, batch,
+                           key):
+            mean, log_std = actor_fwd(actor, batch["next_obs"])
+            next_a, next_logp = squashed_sample(mean, log_std, key)
+            tq1, tq2 = critic_fwd(target_critic, batch["next_obs"], next_a)
+            alpha = jnp.exp(log_alpha)
+            target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target_q = jax.lax.stop_gradient(
+                batch["rewards"]
+                + cfg.gamma * (1.0 - batch["terminateds"]) * target_v)
+            q1, q2 = critic_fwd(critic, batch["obs"], batch["actions"])
+            return ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+        def actor_loss_fn(actor, critic, log_alpha, batch, key):
+            mean, log_std = actor_fwd(actor, batch["obs"])
+            a, logp = squashed_sample(mean, log_std, key)
+            q1, q2 = critic_fwd(critic, batch["obs"], a)
+            alpha = jnp.exp(log_alpha)
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+        def update(actor, critic, target_critic, log_alpha, opt_states,
+                   batch, key):
+            actor_os, critic_os, alpha_os = opt_states
+            k1, k2 = jax.random.split(key)
+            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+                critic, actor, target_critic, log_alpha, batch, k1)
+            updates, critic_os = self._critic_opt.update(c_grads, critic_os,
+                                                        critic)
+            critic = optax.apply_updates(critic, updates)
+
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True)(actor, critic, log_alpha,
+                                             batch, k2)
+            updates, actor_os = self._actor_opt.update(a_grads, actor_os,
+                                                      actor)
+            actor = optax.apply_updates(actor, updates)
+
+            # Temperature: push policy entropy toward -|A|.
+            alpha_grad = -(jnp.exp(log_alpha)
+                           * jax.lax.stop_gradient(
+                               logp + self._target_entropy).mean())
+            updates, alpha_os = self._alpha_opt.update(alpha_grad, alpha_os,
+                                                      log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, updates)
+
+            target_critic = jax.tree.map(
+                lambda t, c: (1.0 - cfg.tau) * t + cfg.tau * c,
+                target_critic, critic)
+            aux = {"critic_loss": c_loss, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha),
+                   "entropy": -logp.mean()}
+            return (actor, critic, target_critic, log_alpha,
+                    (actor_os, critic_os, alpha_os), aux)
+
+        return update
+
+    # --------------------------------------------------------------- train
+
+    def _broadcast_weights(self) -> None:
+        import jax
+
+        ref = ray_tpu.put(jax.device_get(self.actor))
+        ray_tpu.get([r.set_weights.remote(ref, self._iteration)
+                     for r in self.runners])
+
+    def _rollout_to_transitions(self, ro: Dict[str, np.ndarray]
+                                ) -> Dict[str, np.ndarray]:
+        """(T, N) rollout -> flat transition batch. next_obs[t] = obs[t+1]
+        (last row uses the runner's live obs). Only synthetic autoreset
+        rows drop. Boundary semantics under gymnasium NEXT_STEP autoreset:
+        the done step itself returns the episode's TRUE final observation
+        (the reset obs appears one step later, which ``valids`` masks), so
+        truncation rows keep bootstrapping through next_obs — 'truncation
+        is not termination' — and terminated rows mask the next value via
+        the (1 - terminateds) factor in the target."""
+        T, N = ro["rewards"].shape
+        next_obs = np.concatenate([ro["obs"][1:], ro["last_obs"][None]], 0)
+        flat = {
+            "obs": ro["obs"].reshape((T * N,) + ro["obs"].shape[2:]),
+            "actions": ro["actions"].reshape(
+                (T * N,) + ro["actions"].shape[2:]),
+            "rewards": ro["rewards"].reshape(-1).astype(np.float32),
+            "next_obs": next_obs.reshape((T * N,) + ro["obs"].shape[2:]),
+            "terminateds": ro["terminateds"].reshape(-1).astype(np.float32),
+        }
+        keep = ro["valids"].reshape(-1) > 0.5
+        return {k: v[keep] for k, v in flat.items()}
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        t0 = time.monotonic()
+        rollouts = ray_tpu.get([r.sample.remote() for r in self.runners])
+        sample_time = time.monotonic() - t0
+        n_new = 0
+        for ro in rollouts:
+            batch = self._rollout_to_transitions(ro)
+            n_new += len(batch["rewards"])
+            if len(batch["rewards"]):
+                self.buffer.add(batch)
+        self._total_env_steps += n_new
+
+        t1 = time.monotonic()
+        aux = {}
+        if self._total_env_steps >= cfg.warmup_steps:
+            for _ in range(cfg.updates_per_iteration):
+                batch, _idx, _w = self.buffer.sample(cfg.batch_size)
+                self._key, sub = jax.random.split(self._key)
+                (self.actor, self.critic, self.target_critic,
+                 self.log_alpha,
+                 (self.actor_opt_state, self.critic_opt_state,
+                  self.alpha_opt_state), aux) = self._update(
+                    self.actor, self.critic, self.target_critic,
+                    self.log_alpha,
+                    (self.actor_opt_state, self.critic_opt_state,
+                     self.alpha_opt_state), batch, sub)
+        learn_time = time.monotonic() - t1
+
+        self._broadcast_weights()
+        stats = ray_tpu.get([r.episode_stats.remote()
+                             for r in self.runners])
+        episode_returns = [s["episode_return_mean"] for s in stats
+                           if s.get("episodes")]
+        self._iteration += 1
+        metrics = {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._total_env_steps,
+            "env_steps_this_iter": n_new,
+            "env_steps_per_sec": n_new / max(1e-9,
+                                             sample_time + learn_time),
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(learn_time, 3),
+            "buffer_size": len(self.buffer),
+            **{k: float(v) for k, v in jax.device_get(aux).items()},
+        }
+        if episode_returns:
+            metrics["episode_return_mean"] = float(np.mean(episode_returns))
+        return metrics
+
+    def stop(self) -> None:
+        stop_runners(self.runners)
