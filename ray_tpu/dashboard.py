@@ -1,10 +1,13 @@
-"""Minimal cluster dashboard: HTTP JSON API + one-page HTML view.
+"""Cluster dashboard: HTTP JSON API + HTML views with logs, drill-down
+and metric history.
 
-Analogue of the reference's dashboard head (``dashboard/head.py:81``)
-reduced to the load-bearing surface: live nodes/actors/jobs/deployments
-over a JSON API (the same controller RPCs the state CLI uses), a
-Prometheus metrics endpoint, and a self-refreshing HTML overview — no
-frontend build, one stdlib process.
+Analogue of the reference's dashboard head (``dashboard/head.py:81``) +
+its log module (``dashboard/modules/log``), state drill-down pages and
+metrics module (``dashboard/modules/metrics`` — Grafana replaced by an
+in-process time-series ring rendered as inline SVG sparklines) — no
+frontend build, one stdlib process. Live logs ride the same pubsub windows
+the driver's log streaming uses; task/actor detail pages assemble from the
+controller's task-event buffer and actor table.
 
     python -m ray_tpu.dashboard [--address host:port] [--port 8265]
 """
@@ -13,8 +16,10 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 _PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <meta http-equiv="refresh" content="5">
@@ -23,12 +28,109 @@ _PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  table{border-collapse:collapse;margin:1em 0}
  td,th{border:1px solid #ccc;padding:4px 10px;text-align:left}
  th{background:#eee} h2{margin-top:1.5em}
+ pre{background:#111;color:#ddd;padding:1em;overflow-x:auto}
+ svg{background:#fff;border:1px solid #ccc;margin-right:8px}
 </style></head><body>
-<h1>ray_tpu cluster</h1><div id="content">%s</div>
+<h1><a href="/" style="text-decoration:none">ray_tpu cluster</a></h1>
+<div id="content">%s</div>
 <p><a href="/api/nodes">/api/nodes</a> <a href="/api/actors">/api/actors</a>
 <a href="/api/jobs">/api/jobs</a> <a href="/api/tasks">/api/tasks</a>
-<a href="/api/memory">/api/memory</a>
+<a href="/api/memory">/api/memory</a> <a href="/api/logs">/api/logs</a>
+<a href="/api/history">/api/history</a> <a href="/logs">logs</a>
 <a href="/metrics">/metrics</a></p></body></html>"""
+
+
+class _HistoryRing:
+    """In-memory time series (reference: the metrics module's Grafana
+    backing store, scoped down): one bounded ring of (ts, value) per
+    series, sampled by a daemon thread from the controller's cluster
+    state + pushed metrics (so a training run's reported gauges — loss,
+    MFU — chart alongside CPU/store/task throughput)."""
+
+    def __init__(self, client, capacity: int = 360, period_s: float = 2.0):
+        self._client = client
+        self._capacity = capacity
+        self._period = period_s
+        self._series: Dict[str, List[Tuple[float, float]]] = {}
+        self._lock = threading.Lock()
+        self._last_sample_ts: Optional[float] = None
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="dash-history", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _push(self, name: str, value: float, now: float) -> None:
+        ring = self._series.setdefault(name, [])
+        ring.append((now, float(value)))
+        del ring[:-self._capacity]
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._period):
+            try:
+                self.sample_once()
+            except Exception:
+                pass
+
+    def sample_once(self) -> None:
+        now = time.time()
+        nodes = self._client.call("list_nodes", timeout=5.0)
+        alive = [n for n in nodes if n["alive"]]
+        with self._lock:
+            self._push("nodes_alive", len(alive), now)
+            cpu_total = sum(n["resources"].get("CPU", 0) for n in alive)
+            cpu_free = sum(n["available"].get("CPU", 0) for n in alive)
+            if cpu_total:
+                self._push("cpu_utilization",
+                           1.0 - cpu_free / cpu_total, now)
+            self._push("lease_queue_len",
+                       sum(n["queue_len"] for n in alive), now)
+        # Task throughput by COMPLETION TIME, not buffer position: the
+        # event ring saturates under load, so counting events in a fixed
+        # window would flatline exactly when the cluster is busy.
+        events = self._client.call("list_task_events", 2000, timeout=5.0)
+        since = self._last_sample_ts
+        finished = sum(
+            1 for e in events
+            if e.get("state") == "FINISHED" and (e.get("end_ts") or 0) >
+            (since or 0))
+        metrics = self._client.call("list_metrics", timeout=5.0)
+        with self._lock:
+            if since is not None:
+                self._push("tasks_finished_per_s",
+                           finished / max(1e-9, now - since), now)
+            self._last_sample_ts = now
+            # Pushed user/system gauges (util.metrics): latest value per
+            # metric name, e.g. a trainer reporting loss or MFU.
+            for _src, snapshot in metrics.items():
+                for m in snapshot:
+                    if m.get("kind") == "gauge":
+                        self._push(f"metric:{m['name']}",
+                                   m.get("value", 0.0), now)
+
+    def snapshot(self) -> Dict[str, List[Tuple[float, float]]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._series.items()}
+
+
+def _sparkline(points: List[Tuple[float, float]], width: int = 220,
+               height: int = 40) -> str:
+    """Inline SVG sparkline for one series."""
+    if len(points) < 2:
+        return "<svg width='%d' height='%d'></svg>" % (width, height)
+    vals = [v for _t, v in points]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    n = len(vals)
+    coords = " ".join(
+        f"{i * (width - 4) / (n - 1) + 2:.1f},"
+        f"{height - 4 - (v - lo) / span * (height - 8) + 2:.1f}"
+        for i, v in enumerate(vals))
+    return (f"<svg width='{width}' height='{height}'>"
+            f"<polyline points='{coords}' fill='none' stroke='#36c' "
+            f"stroke-width='1.5'/></svg>")
 
 
 def _table(rows, columns) -> str:
@@ -42,7 +144,8 @@ def _table(rows, columns) -> str:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    client = None  # RpcClient to the controller (set by start())
+    client = None   # RpcClient to the controller (set by start())
+    history = None  # _HistoryRing (set by start())
 
     def _send(self, payload: bytes, ctype: str = "application/json",
               code: int = 200) -> None:
@@ -53,10 +156,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
 
     def do_GET(self):  # noqa: N802 (stdlib API)
+        parsed = urlparse(self.path)
+        path = parsed.path
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         try:
-            if self.path == "/api/nodes":
+            if path == "/api/nodes":
                 self._send(json.dumps(self.client.call("list_nodes")).encode())
-            elif self.path == "/api/actors":
+            elif path == "/api/actors":
                 actors = self.client.call("list_actors")
                 for a in actors:
                     a["actor_id"] = a["actor_id"].hex()
@@ -64,22 +170,126 @@ class _Handler(BaseHTTPRequestHandler):
                                     if a.get("node_id") else None)
                     a.pop("addr", None)
                 self._send(json.dumps(actors).encode())
-            elif self.path == "/api/jobs":
+            elif path == "/api/jobs":
                 self._send(json.dumps(self.client.call("list_jobs")).encode())
-            elif self.path == "/api/tasks":
+            elif path == "/api/tasks":
                 self._send(json.dumps(
-                    self.client.call("list_task_events", 500)).encode())
-            elif self.path == "/api/memory":
+                    self.client.call("list_task_events",
+                                     int(query.get("limit", 500)))).encode())
+            elif path == "/api/memory":
                 self._send(json.dumps(self._memory()).encode())
-            elif self.path == "/metrics":
+            elif path == "/api/logs":
+                self._send(json.dumps(self._logs(query)).encode())
+            elif path == "/api/history":
+                self._send(json.dumps(self.history.snapshot()).encode())
+            elif path == "/metrics":
                 self._send(self.client.call("metrics_text").encode(),
                            "text/plain")
-            elif self.path in ("/", "/index.html"):
+            elif path == "/logs":
+                self._send(self._render_logs(query).encode(), "text/html")
+            elif path.startswith("/task/"):
+                self._send(self._render_task(path[len("/task/"):]).encode(),
+                           "text/html")
+            elif path.startswith("/actor/"):
+                self._send(
+                    self._render_actor(path[len("/actor/"):]).encode(),
+                    "text/html")
+            elif path in ("/", "/index.html"):
                 self._send(self._render().encode(), "text/html")
             else:
                 self._send(b'{"error": "not found"}', code=404)
         except Exception as e:  # noqa: BLE001
             self._send(json.dumps({"error": str(e)}).encode(), code=500)
+
+    # --------------------------------------------------------------- logs
+
+    def _logs(self, query: Dict[str, str]) -> Dict:
+        """Live log windows per node from the pubsub hub (the same windows
+        the driver's log streaming consumes); filter with ?node= and
+        ?worker= (tag prefix)."""
+        from ray_tpu.core.log_monitor import LOG_CHANNEL
+
+        snapshot = self.client.call("psub_snapshot", LOG_CHANNEL)
+        out = {}
+        want_node = query.get("node")
+        want_worker = query.get("worker")
+        for node_hex, (_version, value) in snapshot.items():
+            if want_node and not node_hex.startswith(want_node):
+                continue
+            window = value.get("window", [])
+            if want_worker:
+                window = [(tag, line) for tag, line in window
+                          if want_worker in tag]
+            out[node_hex] = {"end": value.get("end", 0), "lines": window}
+        return out
+
+    def _render_logs(self, query: Dict[str, str]) -> str:
+        logs = self._logs(query)
+        html = ["<h2>live worker logs</h2>",
+                "<p>filter: /logs?node=&lt;hex&gt;&amp;worker=&lt;tag&gt;"
+                "</p>"]
+        if not logs:
+            html.append("<p>(no log lines published yet)</p>")
+        for node_hex, data in sorted(logs.items()):
+            html.append(f"<h2>node {node_hex[:16]} "
+                        f"({data['end']} lines total)</h2><pre>")
+            for tag, line in data["lines"][-200:]:
+                html.append(f"[{_esc(tag)}] {_esc(line)}")
+            html.append("</pre>")
+        return _PAGE % "\n".join(html)
+
+    # ---------------------------------------------------------- drill-down
+
+    def _render_task(self, task_hex: str) -> str:
+        events = self.client.call("list_task_events", 10000)
+        mine = [e for e in events
+                if e.get("task_id", "").startswith(task_hex)]
+        if not mine:
+            return _PAGE % f"<p>no events for task {_esc(task_hex)}</p>"
+        rows = []
+        for e in mine:
+            lat = ""
+            if e.get("lease_ts") and e.get("submitted_ts"):
+                lat = f"{(e['lease_ts'] - e['submitted_ts']) * 1000:.1f}ms"
+            dur = ""
+            if e.get("end_ts") and e.get("lease_ts"):
+                dur = f"{(e['end_ts'] - e['lease_ts']) * 1000:.1f}ms"
+            rows.append({
+                "state": e.get("state"), "desc": _esc(e.get("desc", "")),
+                "sched_latency": lat, "run_time": dur,
+                "worker": (e.get("worker") or "")[:12],
+                "error": _esc(str(e.get("error", ""))[:200]),
+            })
+        return _PAGE % (f"<h2>task {_esc(task_hex[:16])}</h2>"
+                        + _table(rows, ["state", "desc", "sched_latency",
+                                        "run_time", "worker", "error"]))
+
+    def _render_actor(self, actor_hex: str) -> str:
+        actors = self.client.call("list_actors")
+        rec = next((a for a in actors
+                    if a["actor_id"].hex().startswith(actor_hex)), None)
+        if rec is None:
+            return _PAGE % f"<p>no actor {_esc(actor_hex)}</p>"
+        info = rec["info"]
+        detail = [
+            ("actor_id", rec["actor_id"].hex()),
+            ("class", _esc(str(info.get("class_name", "")))),
+            ("name", _esc(str(info.get("name") or ""))),
+            ("state", rec["state"]),
+            ("restarts", rec["num_restarts"]),
+            ("incarnation", rec["incarnation"]),
+            ("node", rec["node_id"].hex()[:16] if rec.get("node_id")
+             else ""),
+            ("resources", _esc(str(info.get("resources", "")))),
+            ("death_cause", _esc(str(rec.get("death_cause") or ""))),
+        ]
+        html = (f"<h2>actor {rec['actor_id'].hex()[:16]}</h2>"
+                + _table([dict(detail)], [k for k, _v in detail]))
+        if rec.get("node_id"):
+            node_hex = rec["node_id"].hex()
+            html += (f"<p><a href='/logs?node={node_hex}'>worker logs on "
+                     f"this node</a></p>")
+        return _PAGE % html
 
     def _memory(self, nodes=None):
         """Per-node object-store usage via the shared node-info poll
@@ -111,7 +321,9 @@ class _Handler(BaseHTTPRequestHandler):
             n["addr"] = f"{n['addr'][0]}:{n['addr'][1]}"
             n["node_id"] = n["node_id"][:16]
         actors = self.client.call("list_actors")
-        arows = [{"actor_id": a["actor_id"].hex()[:16],
+        arows = [{"actor_id":
+                  f"<a href='/actor/{a['actor_id'].hex()}'>"
+                  f"{a['actor_id'].hex()[:16]}</a>",
                   "class": a["info"].get("class_name", ""),
                   "name": a["info"].get("name") or "",
                   "state": a["state"],
@@ -141,20 +353,47 @@ class _Handler(BaseHTTPRequestHandler):
                 })
         html += "<h2>object store</h2>" + _table(
             mem, ["node_id", "store", "spilled", "workers", "oom_kills"])
+        # Recent tasks with drill-down links.
+        events = self.client.call("list_task_events", 20)
+        trows = [{
+            "task": f"<a href='/task/{e.get('task_id', '')}'>"
+                    f"{e.get('task_id', '')[:12]}</a>",
+            "desc": _esc(str(e.get("desc", ""))[:40]),
+            "state": e.get("state"),
+        } for e in reversed(events)]
+        html += "<h2>recent tasks</h2>" + _table(
+            trows, ["task", "desc", "state"])
+        # Metric history sparklines.
+        spark = []
+        for name, points in sorted(self.history.snapshot().items()):
+            cur = points[-1][1] if points else 0.0
+            spark.append(
+                f"<div>{_sparkline(points)} {_esc(name)} = {cur:.3g}</div>")
+        if spark:
+            html += "<h2>history (last ~12 min)</h2>" + "".join(spark)
+        html += "<p><a href='/logs'>live worker logs</a></p>"
         return _PAGE % html
 
     def log_message(self, *args):  # silence
         pass
 
 
+def _esc(s: str) -> str:
+    return (str(s).replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
 def start(controller_addr: Tuple[str, int], host: str = "127.0.0.1",
           port: int = 0) -> Tuple[ThreadingHTTPServer, Tuple[str, int]]:
     """Start the dashboard server (non-blocking); returns (server, addr)."""
-    from ray_tpu.core.rpc import RpcClient
+    from ray_tpu.core.rpc import ReconnectingClient
 
+    client = ReconnectingClient(tuple(controller_addr))
+    history = _HistoryRing(client)
     handler = type("BoundHandler", (_Handler,),
-                   {"client": RpcClient(tuple(controller_addr))})
+                   {"client": client, "history": history})
     server = ThreadingHTTPServer((host, port), handler)
+    server._history = history  # stopped with the server by callers
     threading.Thread(target=server.serve_forever, name="dashboard",
                      daemon=True).start()
     return server, server.server_address

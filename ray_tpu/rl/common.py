@@ -62,6 +62,42 @@ def make_env_runners(config) -> List[Any]:
     ]
 
 
+def rollout_to_transitions(ro, done_key: str = "terminateds",
+                           action_dtype=None):
+    """(T, N) rollout -> flat off-policy transition batch (obs, actions,
+    rewards, next_obs, <done_key>) shared by DQN and SAC.
+
+    next_obs[t] = obs[t+1]; the final row's successor is the runner's
+    ``last_obs`` (rollouts that predate that field drop the final row
+    instead). Synthetic autoreset rows (valids==0) are not experience.
+    The done column is TERMINATED only: a time-limit truncation keeps
+    bootstrapping through its true final observation (under NEXT_STEP
+    autoreset the done step returns it; the reset obs lands on the
+    following, masked row)."""
+    import numpy as np
+
+    T = ro["rewards"].shape[0]
+    if "last_obs" in ro:
+        next_obs = np.concatenate([ro["obs"][1:], ro["last_obs"][None]], 0)
+        keep = ro["valids"] > 0.5
+        rows = slice(None)
+    else:
+        next_obs = ro["obs"][1:]
+        keep = ro["valids"][:T - 1] > 0.5
+        rows = slice(0, T - 1)
+    term = ro.get("terminateds", ro["dones"])
+    actions = ro["actions"][rows][keep]
+    if action_dtype is not None:
+        actions = actions.astype(action_dtype)
+    return {
+        "obs": ro["obs"][rows][keep],
+        "actions": actions,
+        "rewards": ro["rewards"][rows][keep].astype(np.float32),
+        "next_obs": next_obs[keep],
+        done_key: term[rows][keep].astype(np.float32),
+    }
+
+
 def stop_runners(runners) -> None:
     for runner in runners:
         try:

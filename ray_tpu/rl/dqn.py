@@ -70,25 +70,13 @@ class DQNConfig(ConfigBuilderMixin):
 
 
 def rollout_to_transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    """(T, N) rollout -> flat transition batch (obs, action, reward,
-    next_obs, done). Row t pairs with row t+1's observation; the last row
-    has no successor and synthetic autoreset rows (valids==0) are not
-    experience — both are dropped."""
-    T = ro["rewards"].shape[0]
-    next_obs = ro["obs"][1:]
-    keep = ro["valids"][:T - 1] > 0.5
-    # Bootstrap cutoff is TERMINATION only — a time-limit truncation must
-    # keep gamma * maxQ(next_obs) in the target (rllib's terminateds vs
-    # truncateds distinction). Older rollouts without the split fall back
-    # to dones.
-    term = ro.get("terminateds", ro["dones"])
-    return {
-        "obs": ro["obs"][:T - 1][keep],
-        "actions": ro["actions"][:T - 1][keep].astype(np.int32),
-        "rewards": ro["rewards"][:T - 1][keep].astype(np.float32),
-        "next_obs": next_obs[keep],
-        "dones": term[:T - 1][keep].astype(np.float32),
-    }
+    """(T, N) rollout -> flat DQN transition batch; see the shared helper
+    (``common.rollout_to_transitions``) for the boundary semantics. With
+    ``last_obs`` present (current runners), the final row keeps its
+    successor instead of being dropped."""
+    from ray_tpu.rl.common import rollout_to_transitions as shared
+
+    return shared(ro, done_key="dones", action_dtype=np.int32)
 
 
 class DQN:

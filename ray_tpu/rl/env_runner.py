@@ -84,14 +84,17 @@ class EnvRunner:
             # env's bounds at step time (reference: SAC's squashed actions
             # + action-space normalization connector).
             self._action_dim = int(np.prod(space.shape))
-            self._act_low = np.asarray(space.low, np.float32)
-            self._act_high = np.asarray(space.high, np.float32)
+            self._action_shape = tuple(space.shape)
+            # Flattened bounds: the policy works in (N, prod(shape)); the
+            # env action reshapes back to (N,) + space.shape at step time.
+            self._act_low = np.asarray(space.low, np.float32).reshape(-1)
+            self._act_high = np.asarray(space.high, np.float32).reshape(-1)
             if not (np.isfinite(self._act_low).all()
                     and np.isfinite(self._act_high).all()):
                 raise ValueError(
                     f"continuous policy_mode needs finite action bounds to "
-                    f"rescale [-1, 1] actions; got low={self._act_low} "
-                    f"high={self._act_high}")
+                    f"rescale [-1, 1] actions; got low={space.low} "
+                    f"high={space.high}")
             _init, actor_forward = build_squashed_gaussian_actor(
                 int(np.prod(self.obs.shape[1:])), self._action_dim)
             self._sample_fn = jax.jit(
@@ -169,10 +172,12 @@ class EnvRunner:
             val_buf[t] = np.asarray(value)
             valid_buf[t] = 1.0 - self._prev_done.astype(np.float32)
             if self._action_dim is not None:
-                # Policy actions live in [-1, 1]; the env wants its bounds.
+                # Policy actions live in [-1, 1]; the env wants its bounds
+                # and its native action shape.
                 env_action = (self._act_low
                               + (action + 1.0) * 0.5
-                              * (self._act_high - self._act_low))
+                              * (self._act_high - self._act_low)
+                              ).reshape((len(action),) + self._action_shape)
             else:
                 env_action = action
             obs, reward, terminated, truncated, _ = self.envs.step(

@@ -146,6 +146,10 @@ class MultiAgentEnvRunner:
                 "rewards": []}
             for a in self.env.possible_agents}
         returns = {a: 0.0 for a in self.env.possible_agents}
+        # Rewards arriving before an agent's first action of the episode
+        # (turn-based: the opener's move can pay/penalize the responder)
+        # buffer here and fold into that agent's first transition.
+        pending = {a: 0.0 for a in self.env.possible_agents}
         done = False
         while not done:
             actions = {}
@@ -163,12 +167,18 @@ class MultiAgentEnvRunner:
             for agent, r in rewards.items():
                 returns[agent] += float(r)
                 if agent in actions:
-                    buf[agent]["rewards"].append(float(r))
+                    buf[agent]["rewards"].append(
+                        float(r) + pending.pop(agent, 0.0))
+                    pending[agent] = 0.0
                 elif buf[agent]["rewards"]:
                     # Turn-based envs reward idle agents for earlier moves
                     # (e.g. the opponent's reply): credit the agent's LAST
                     # transition so trajectories stay rectangular.
                     buf[agent]["rewards"][-1] += float(r)
+                else:
+                    # Reward before the agent's first action: hold it for
+                    # the first transition rather than dropping it.
+                    pending[agent] = pending.get(agent, 0.0) + float(r)
             done = terms.get("__all__", False) or truncs.get("__all__",
                                                              False)
         self._completed.append(returns)

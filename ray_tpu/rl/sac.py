@@ -168,26 +168,12 @@ class SAC:
 
     def _rollout_to_transitions(self, ro: Dict[str, np.ndarray]
                                 ) -> Dict[str, np.ndarray]:
-        """(T, N) rollout -> flat transition batch. next_obs[t] = obs[t+1]
-        (last row uses the runner's live obs). Only synthetic autoreset
-        rows drop. Boundary semantics under gymnasium NEXT_STEP autoreset:
-        the done step itself returns the episode's TRUE final observation
-        (the reset obs appears one step later, which ``valids`` masks), so
-        truncation rows keep bootstrapping through next_obs — 'truncation
-        is not termination' — and terminated rows mask the next value via
-        the (1 - terminateds) factor in the target."""
-        T, N = ro["rewards"].shape
-        next_obs = np.concatenate([ro["obs"][1:], ro["last_obs"][None]], 0)
-        flat = {
-            "obs": ro["obs"].reshape((T * N,) + ro["obs"].shape[2:]),
-            "actions": ro["actions"].reshape(
-                (T * N,) + ro["actions"].shape[2:]),
-            "rewards": ro["rewards"].reshape(-1).astype(np.float32),
-            "next_obs": next_obs.reshape((T * N,) + ro["obs"].shape[2:]),
-            "terminateds": ro["terminateds"].reshape(-1).astype(np.float32),
-        }
-        keep = ro["valids"].reshape(-1) > 0.5
-        return {k: v[keep] for k, v in flat.items()}
+        """See ``common.rollout_to_transitions`` for boundary semantics
+        (truncation bootstraps through the true final obs; terminated rows
+        mask the next value via (1 - terminateds) in the target)."""
+        from ray_tpu.rl.common import rollout_to_transitions
+
+        return rollout_to_transitions(ro, done_key="terminateds")
 
     def train(self) -> Dict[str, Any]:
         import jax

@@ -281,3 +281,83 @@ def test_runtime_env_rejects_unknown_keys(ray_start_regular):
         f.options(runtime_env={"conda": {"deps": []}}).remote()
     with pytest.raises(ValueError, match="pip"):
         f.options(runtime_env={"pip": "not-a-list"}).remote()
+
+
+@pytest.mark.timeout_s(240)
+def test_dashboard_logs_history_drilldown(ray_start_regular):
+    """Dashboard v2 (VERDICT r2 #7): during a workload the dashboard
+    serves live worker logs, task/actor drill-down pages, and metric
+    history sparklines."""
+    import urllib.request
+
+    from ray_tpu import dashboard
+    from ray_tpu.util.metrics import Gauge
+
+    core = ray_start_regular
+    server, (host, port) = dashboard.start(core.controller_addr)
+    base = f"http://{host}:{port}"
+    try:
+        @ray_tpu.remote
+        def chatty(i):
+            print(f"chatty-line-{i}")
+            return i
+
+        @ray_tpu.remote
+        class Watched:
+            def ping(self):
+                return "pong"
+
+        actor = Watched.options(name="watched").remote()
+        ray_tpu.get(actor.ping.remote(), timeout=60)
+        ray_tpu.get([chatty.remote(i) for i in range(8)], timeout=120)
+        gauge = Gauge("train_loss", "probe gauge")
+        gauge.set(1.25)
+
+        # Live logs reach the dashboard via the pubsub windows.
+        deadline = time.monotonic() + 60
+        while True:
+            logs = json.loads(urllib.request.urlopen(
+                f"{base}/api/logs", timeout=10).read())
+            lines = [ln for d in logs.values() for _t, ln in d["lines"]]
+            if any("chatty-line-" in ln for ln in lines):
+                break
+            assert time.monotonic() < deadline, logs
+            time.sleep(0.5)
+        page = urllib.request.urlopen(f"{base}/logs",
+                                      timeout=10).read().decode()
+        assert "chatty-line-" in page
+
+        # Task drill-down: pick a finished task id from the events.
+        events = json.loads(urllib.request.urlopen(
+            f"{base}/api/tasks?limit=100", timeout=10).read())
+        done = next(e for e in events if e.get("state") == "FINISHED"
+                    and "chatty" in e.get("desc", ""))
+        tpage = urllib.request.urlopen(
+            f"{base}/task/{done['task_id']}", timeout=10).read().decode()
+        assert "chatty" in tpage and "sched_latency" in tpage
+
+        # Actor drill-down.
+        actors = json.loads(urllib.request.urlopen(
+            f"{base}/api/actors", timeout=10).read())
+        rec = next(a for a in actors if a["info"].get("name") == "watched")
+        apage = urllib.request.urlopen(
+            f"{base}/actor/{rec['actor_id']}", timeout=10).read().decode()
+        assert "watched" in apage and "ALIVE" in apage
+
+        # History: the sampler has ticked and the gauge flows through.
+        deadline = time.monotonic() + 60
+        while True:
+            server._history.sample_once()
+            hist = json.loads(urllib.request.urlopen(
+                f"{base}/api/history", timeout=10).read())
+            if ("nodes_alive" in hist and len(hist["nodes_alive"]) >= 2
+                    and "metric:train_loss" in hist):
+                break
+            assert time.monotonic() < deadline, list(hist)
+            time.sleep(1.0)
+        front = urllib.request.urlopen(base + "/",
+                                       timeout=10).read().decode()
+        assert "svg" in front and "history" in front
+    finally:
+        server._history.stop()
+        server.shutdown()
