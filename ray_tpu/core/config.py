@@ -52,6 +52,13 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "TCP connect attempts (50ms apart) before an RPC endpoint is dead."),
     "log_to_driver": (bool, True,
         "Forward worker stdout/stderr lines to the driver process."),
+    "dag_channels_enabled": (bool, True,
+        "Upgrade same-host compiled-DAG edges to mutable shared-memory "
+        "channels (reference: experimental_mutable_object_manager.h); "
+        "disabled, every edge uses the RPC push path."),
+    "dag_channel_capacity_bytes": (int, 8 * 1024 * 1024,
+        "Slot size of one compiled-DAG channel edge; larger items fall "
+        "back to the RPC push for that item."),
     "event_buffer_max": (int, 10000,
         "Max buffered task state-transition events per worker (reference: "
         "TaskEventBuffer, task_event_buffer.h:206)."),

@@ -122,10 +122,25 @@ def _bind_function(remote_fn: RemoteFunction, *args, **kwargs) -> DAGNode:
     return DAGNode("task", remote_fn, args, kwargs)
 
 
+class _PipeError:
+    """A stage failure traveling the pipeline as data: downstream stages
+    pass it through untouched and the driver resolves the item's Future
+    with the error (without this, one raising item would wedge the
+    channel protocol — the ack must happen no matter what the user fn
+    did)."""
+
+    def __init__(self, desc: str):
+        self.desc = desc
+
+
 class _PipeStage:
     """Actor hosting one compiled pipeline stage: executes its function and
-    pushes the result straight to the next stage (no driver hop), or queues
-    it for the driver when it is the last stage."""
+    hands the result to the next stage with NO driver hop — through a
+    mutable shared-memory channel when the stages share a host (reference:
+    ``shared_memory_channel.py:169`` — allocation-free slot rewrite per
+    item), falling back to a direct actor push (RPC) for cross-node edges
+    and payloads larger than the slot. The last stage queues results for
+    the driver."""
 
     def __init__(self, fn_blob: bytes, const_args: tuple,
                  const_kwargs: dict, arg_template: List[Any]):
@@ -136,13 +151,83 @@ class _PipeStage:
         self._const_kwargs = const_kwargs
         self._arg_template = arg_template  # positions: "__dag__" = dataflow
         self._next = None
+        self._out_chan = None
+        self._in_chan = None
+        self._drain = None
+        self._stop = threading.Event()
         import queue as q
 
+        self._in_q: "q.Queue" = q.Queue()  # RPC-fallback inbox (channeled)
         self._out: "q.Queue" = q.Queue()
 
     def set_next(self, next_handle) -> bool:
         self._next = next_handle
         return True
+
+    def node_hex(self) -> str:
+        from ray_tpu.core.runtime import get_core_worker
+
+        return get_core_worker().node_id.hex()
+
+    # ------------------------------------------------------------ channels
+
+    def listen_channel(self, path: str, capacity: int) -> bool:
+        """Reader side: create the edge's channel and consume items on a
+        drain thread (one consumer — channel items and RPC-fallback pushes
+        are serialized through it, so the stage fn never runs twice
+        concurrently)."""
+        from ray_tpu.core.channel import MutableChannel
+
+        self._in_chan = MutableChannel(path, create=True, capacity=capacity)
+        self._drain = threading.Thread(target=self._drain_loop,
+                                       name="pipe-drain", daemon=True)
+        self._drain.start()
+        return True
+
+    def attach_out_channel(self, path: str) -> bool:
+        """Writer side: open the downstream edge's channel (reader created
+        it first)."""
+        from ray_tpu.core.channel import MutableChannel
+
+        self._out_chan = MutableChannel(path)
+        return True
+
+    def _drain_loop(self) -> None:
+        import queue as q
+
+        from ray_tpu.core import serialization
+        from ray_tpu.core.channel import ChannelClosed, ChannelTimeout
+
+        while not self._stop.is_set():
+            view = None
+            try:
+                view = self._in_chan.read(timeout=0.05)
+            except ChannelTimeout:
+                pass
+            except (ChannelClosed, ValueError):
+                return  # torn down (ValueError: mmap closed mid-read)
+            if view is not None:
+                # Zero-copy deserialize is safe only when the result is
+                # re-serialized synchronously before ack (out-channel
+                # write); terminal stages queue the result past the ack,
+                # so they take one defensive copy. The ack ALWAYS happens
+                # — errors travel the pipeline as _PipeError items.
+                try:
+                    zero_copy = self._out_chan is not None
+                    frame = view if zero_copy else bytes(view)
+                    seq, value = serialization.deserialize(frame)
+                    self._process(seq, value, from_slot=zero_copy)
+                finally:
+                    try:
+                        self._in_chan.ack()
+                    except (ChannelClosed, ValueError):
+                        return
+                continue
+            try:
+                seq, value = self._in_q.get_nowait()
+            except q.Empty:
+                continue
+            self._process(seq, value, from_slot=False)
 
     def _invoke(self, value):
         args = [value if a == "__dag__" else a for a in self._const_args]
@@ -150,15 +235,51 @@ class _PipeStage:
                   for k, v in self._const_kwargs.items()}
         return self._fn(*args, **kwargs)
 
-    def push(self, seq: int, value) -> None:
-        result = self._invoke(value)
+    def _process(self, seq: int, value, from_slot: bool = False) -> None:
+        import traceback
+
+        from ray_tpu.core import serialization
+        from ray_tpu.core.channel import ChannelClosed
+
+        if isinstance(value, _PipeError):
+            result = value  # failed upstream: pass the error through
+        else:
+            try:
+                result = self._invoke(value)
+            except BaseException:  # noqa: BLE001 — must reach the driver
+                result = _PipeError(traceback.format_exc())
+        if self._out_chan is not None:
+            # One build_frame serves both outcomes: written into the slot
+            # when it fits, or materialized as the detached copy for the
+            # RPC fallback (the async push serializes after this frame's
+            # ack, so nothing may alias the input slot).
+            total, write_fn = serialization.build_frame((seq, result))
+            if total <= self._out_chan.capacity:
+                try:
+                    # Full slot = backpressure from a slow consumer, not
+                    # a failure: wait without a deadline (close() breaks
+                    # the wait at teardown).
+                    self._out_chan.write_frame(total, write_fn,
+                                               timeout=None)
+                    return
+                except ChannelClosed:
+                    return  # tearing down; drop the item
+            if from_slot:
+                buf = bytearray(total)
+                write_fn(buf)
+                seq, result = serialization.deserialize(buf)
         if self._next is not None:
-            # Direct stage-to-stage dataflow (the channel of
-            # shared_memory_channel.py:169, realized as an ordered
-            # actor-to-actor call whose large payloads ride the shm store).
             self._next.push.remote(seq, result)
         else:
             self._out.put((seq, result))
+
+    def push(self, seq: int, value) -> None:
+        if self._drain is not None:
+            # Channeled stage: route through the single consumer so the
+            # stage fn stays serialized.
+            self._in_q.put((seq, value))
+            return
+        self._process(seq, value)
 
     def pop(self, timeout: float = 60.0):
         import queue as q
@@ -167,6 +288,16 @@ class _PipeStage:
             return self._out.get(timeout=timeout)
         except q.Empty:
             return None
+
+    def close_channels(self) -> None:
+        self._stop.set()
+        for chan in (self._in_chan, self._out_chan):
+            if chan is not None:
+                chan.close()
+        if self._in_chan is not None:
+            # The reader CREATED the file on ITS host — unlink here, not
+            # on the driver (which may be a different machine).
+            self._in_chan.unlink()
 
     def ping(self) -> str:
         return "pong"
@@ -210,10 +341,32 @@ class CompiledDAG:
                                                 else node.fn)
             self._stages.append(stage_cls.options(**options).remote(
                 blob, args, kwargs, []))
-        # Wire stage i -> i+1 (direct pushes).
+        # Wire stage i -> i+1 (direct pushes — the universal fallback).
         wires = [self._stages[i].set_next.remote(self._stages[i + 1])
                  for i in range(len(self._stages) - 1)]
         ray_tpu.get(wires + [self._stages[-1].ping.remote()], timeout=120.0)
+        # Upgrade same-host edges to mutable shm channels (reader creates,
+        # then the writer attaches; cross-node edges keep the RPC path).
+        self._channel_paths: List[str] = []
+        from ray_tpu.core.config import config
+
+        if config.dag_channels_enabled and len(self._stages) > 1:
+            import uuid as _uuid
+
+            from ray_tpu.core.channel import channel_path
+
+            nodes = ray_tpu.get([s.node_hex.remote() for s in self._stages],
+                                timeout=60.0)
+            run_id = _uuid.uuid4().hex[:12]
+            for i in range(len(self._stages) - 1):
+                if nodes[i] != nodes[i + 1]:
+                    continue
+                path = channel_path(f"{run_id}-e{i}")
+                ray_tpu.get(self._stages[i + 1].listen_channel.remote(
+                    path, config.dag_channel_capacity_bytes), timeout=60.0)
+                ray_tpu.get(self._stages[i].attach_out_channel.remote(path),
+                            timeout=60.0)
+                self._channel_paths.append(path)
 
         self._seq = 0
         self._lock = threading.Lock()
@@ -250,14 +403,30 @@ class CompiledDAG:
                 fut = self._futures.pop(seq, None)
             self._in_flight.release()
             if fut is not None:
-                fut.set_result(result)
+                if isinstance(result, _PipeError):
+                    fut.set_exception(ray_tpu.RayTpuError(
+                        f"pipeline stage failed:\n{result.desc}"))
+                else:
+                    fut.set_result(result)
 
     def teardown(self) -> None:
         self._stop.set()
         for stage in self._stages:
             try:
+                stage.close_channels.remote()
+            except Exception:
+                pass
+        for stage in self._stages:
+            try:
                 ray_tpu.kill(stage)
             except Exception:
+                pass
+        import os as _os
+
+        for path in getattr(self, "_channel_paths", []):
+            try:
+                _os.unlink(path)
+            except OSError:
                 pass
 
 

@@ -125,3 +125,143 @@ def test_stage_boundaries_balanced():
     assert stage_boundaries(8, 2) == [(0, 4), (4, 8)]
     assert stage_boundaries(7, 3) == [(0, 3), (3, 5), (5, 7)]
     assert stage_boundaries(2, 2) == [(0, 1), (1, 2)]
+
+
+# --------------------------------------------- mutable shm channels
+# (VERDICT r2 #8; reference: experimental_mutable_object_manager.h,
+# shared_memory_channel.py:169)
+
+
+def test_mutable_channel_protocol(tmp_path):
+    """Single-slot write/read/ack handshake with zero-copy payloads."""
+    import numpy as np
+
+    from ray_tpu.core import serialization
+    from ray_tpu.core.channel import ChannelTimeout, MutableChannel
+
+    path = str(tmp_path / "edge.chan")
+    reader = MutableChannel(path, create=True, capacity=1 << 20)
+    writer = MutableChannel(path)
+
+    arr = np.arange(1000, dtype=np.float64)
+    assert writer.write((7, arr))
+    view = reader.read(timeout=5.0)
+    seq, got = serialization.deserialize(view)
+    assert seq == 7
+    np.testing.assert_array_equal(got, arr)
+    # Writer blocks until ack: a second write times out while unacked.
+    with pytest.raises(ChannelTimeout):
+        writer.write((8, arr), timeout=0.3)
+    del got, view
+    reader.ack()
+    assert writer.write((8, arr * 2))
+    _seq2, got2 = serialization.deserialize(bytes(reader.read(timeout=5.0)))
+    reader.ack()
+    np.testing.assert_array_equal(got2, arr * 2)
+    # Oversized payloads are refused (caller falls back to RPC).
+    assert not writer.write((9, np.zeros(1 << 20)))
+    writer.close()
+    reader.close()
+
+
+@pytest.mark.timeout_s(240)
+def test_compiled_dag_channels_correct_under_load(ray_start_regular):
+    """Many items through a 3-stage channeled pipeline: every result
+    correct and matched to its sequence despite bounded in-flight."""
+    import numpy as np
+
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def scale(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def shift(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def total(x):
+        return float(np.sum(x))
+
+    with InputNode() as inp:
+        dag = total.bind(shift.bind(scale.bind(inp)))
+    compiled = dag.experimental_compile(max_in_flight=4)
+    try:
+        futs = [compiled.execute(np.full(1000, i, np.float64))
+                for i in range(40)]
+        for i, fut in enumerate(futs):
+            assert fut.result(timeout=120) == 1000 * (2 * i + 1)
+        # Same-host stages really did get channel edges.
+        assert len(compiled._channel_paths) == 2
+    finally:
+        compiled.teardown()
+    import os
+
+    assert not any(os.path.exists(p) for p in compiled._channel_paths)
+
+
+@pytest.mark.timeout_s(240)
+def test_compiled_dag_oversized_items_fall_back(ray_start_regular):
+    """Items larger than the channel slot ride the RPC fallback and still
+    arrive correctly (mixed with small channeled items)."""
+    import numpy as np
+
+    from ray_tpu.core.config import config
+    from ray_tpu.dag import InputNode
+
+    old = config.dag_channel_capacity_bytes
+    config.dag_channel_capacity_bytes = 64 * 1024
+    try:
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def head(x):
+            return float(x[0])
+
+        with InputNode() as inp:
+            dag = head.bind(double.bind(inp))
+        compiled = dag.experimental_compile(max_in_flight=2)
+        try:
+            sizes = [100, 50_000, 100, 50_000, 100]  # floats: 400B..400KB
+            futs = [compiled.execute(np.full(n, i + 1, np.float64))
+                    for i, n in enumerate(sizes)]
+            for i, fut in enumerate(futs):
+                assert fut.result(timeout=120) == 2.0 * (i + 1)
+        finally:
+            compiled.teardown()
+    finally:
+        config.dag_channel_capacity_bytes = old
+
+
+@pytest.mark.timeout_s(240)
+def test_compiled_dag_stage_error_reaches_future(ray_start_regular):
+    """A raising stage fn resolves that item's Future with the error and
+    the pipeline keeps processing later items (the ack still happens)."""
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def maybe_fail(x):
+        if x == 13:
+            raise ValueError("unlucky")
+        return x * 2
+
+    @ray_tpu.remote
+    def plus(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = plus.bind(maybe_fail.bind(inp))
+    compiled = dag.experimental_compile(max_in_flight=2)
+    try:
+        ok1 = compiled.execute(1)
+        bad = compiled.execute(13)
+        ok2 = compiled.execute(2)
+        assert ok1.result(timeout=120) == 3
+        with pytest.raises(Exception, match="unlucky"):
+            bad.result(timeout=120)
+        assert ok2.result(timeout=120) == 5
+    finally:
+        compiled.teardown()
