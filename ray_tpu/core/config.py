@@ -32,6 +32,10 @@ _FLAG_DEFS: Dict[str, tuple] = {
     "heartbeat_period_s": (float, 1.0,
         "Node -> controller liveness heartbeat period (reference: raylet "
         "report period / GcsHealthCheckManager)."),
+    "heartbeat_full_refresh_beats": (int, 10,
+        "Delta heartbeats: unchanged availability ships as a liveness-only "
+        "beat, with a full payload at least every this many beats "
+        "(reference: RaySyncer versioned deltas, ray_syncer.h:88)."),
     "health_check_failure_threshold": (int, 5,
         "Missed heartbeats before the controller declares a node dead "
         "(reference: health_check_failure_threshold, ray_config_def.h:846)."),
@@ -153,6 +157,22 @@ class _Config:
             return self.__dict__["_values"][name]
         except KeyError:
             raise AttributeError(name) from None
+
+    def __setattr__(self, name: str, value) -> None:
+        """Direct assignment writes the flag store. Without this, a
+        ``config.flag = x`` would create an instance attribute that
+        permanently SHADOWS the store — later ``update()`` calls would
+        write values no reader ever sees (a real cross-test corruption)."""
+        if name.startswith("_"):
+            super().__setattr__(name, value)
+            return
+        values = self.__dict__.get("_values")
+        if values is None or name not in values:
+            raise AttributeError(f"unknown config flag {name!r}")
+        typ = _FLAG_DEFS[name][0]
+        if typ is bool and isinstance(value, str):
+            value = value.lower() in ("1", "true", "yes")
+        values[name] = typ(value)
 
     def update(self, overrides: Dict[str, Any]) -> None:
         """Apply ``_system_config`` style overrides (validated by name/type)."""

@@ -230,6 +230,19 @@ class Controller:
         # the shared .tmp path would corrupt the snapshot).
         with self._save_lock:
             blob = pickle.dumps(self._snapshot_state())
+            if "://" in self._persist_path:
+                # External store (reference: GCS-on-Redis FT,
+                # redis_store_client.h:33 — here any pyarrow filesystem:
+                # s3://, gs://, mock://; survives head-HOST loss, not just
+                # head-process loss). Same atomic discipline as the local
+                # path: write a temp object, then move — a crash mid-write
+                # must never truncate the only snapshot.
+                fs, path = self._external_fs()
+                tmp = f"{path}.tmp-{os.getpid()}"
+                with fs.open_output_stream(tmp) as f:
+                    f.write(blob)
+                fs.move(tmp, path)
+                return
             tmp = self._persist_path + ".tmp"
             os.makedirs(os.path.dirname(self._persist_path) or ".",
                         exist_ok=True)
@@ -237,14 +250,41 @@ class Controller:
                 f.write(blob)
             os.replace(tmp, self._persist_path)
 
+    def _external_fs(self):
+        from pyarrow import fs as pafs
+
+        return pafs.FileSystem.from_uri(self._persist_path)
+
     def _restore_state(self) -> None:
         import os
         import pickle
 
+        if "://" in self._persist_path:
+            import sys
+
+            from pyarrow.lib import ArrowIOError
+
+            try:
+                fs, path = self._external_fs()
+                with fs.open_input_stream(path) as f:
+                    state = pickle.loads(f.read())
+            except (ArrowIOError, OSError):
+                return  # no snapshot yet
+            except (pickle.UnpicklingError, EOFError, ValueError) as e:
+                # A corrupt snapshot must not brick the replacement head:
+                # starting empty (nodes re-register) beats not starting.
+                print(f"controller: ignoring corrupt snapshot "
+                      f"{self._persist_path}: {e!r}", file=sys.stderr)
+                return
+            self._apply_restored(state)
+            return
         if not os.path.exists(self._persist_path):
             return
         with open(self._persist_path, "rb") as f:
             state = pickle.load(f)
+        self._apply_restored(state)
+
+    def _apply_restored(self, state: Dict[str, Any]) -> None:
         with self._lock:
             self._kv = dict(state.get("kv", {}))
             self._jobs = dict(state.get("jobs", {}))
@@ -317,17 +357,23 @@ class Controller:
                 rec.alive = False
         self._on_node_dead(node_id)
 
-    def heartbeat(self, node_id_bytes: bytes, available: Dict[str, float],
+    def heartbeat(self, node_id_bytes: bytes,
+                  available: Optional[Dict[str, float]],
                   queue_len: int) -> Dict[str, bool]:
         """Returns ``known=False`` when this controller has no record of the
         node — the signal for a live raylet to re-register after a head
         restart (node membership is not persisted; reference: raylets
-        re-registering with a restarted GCS, conftest.py:532)."""
+        re-registering with a restarted GCS, conftest.py:532).
+
+        ``available=None`` is a liveness-only delta beat (the node's view
+        is unchanged); the record keeps its last payload (reference:
+        RaySyncer's versioned delta stream vs full snapshots)."""
         with self._lock:
             rec = self._nodes.get(NodeID(node_id_bytes))
             if rec is None:
                 return {"known": False}
-            rec.available = dict(available)
+            if available is not None:
+                rec.available = dict(available)
             rec.queue_len = queue_len
             rec.last_heartbeat = time.monotonic()
             rec.alive = True

@@ -411,11 +411,19 @@ class CompiledDAG:
 
     def teardown(self) -> None:
         self._stop.set()
+        close_refs = []
         for stage in self._stages:
             try:
-                stage.close_channels.remote()
+                close_refs.append(stage.close_channels.remote())
             except Exception:
                 pass
+        # Await the closes (bounded): a kill landing first would skip the
+        # reader-side unlink and leak slot files on the stages' hosts.
+        try:
+            ray_tpu.wait(close_refs, num_returns=len(close_refs),
+                         timeout=10.0)
+        except Exception:
+            pass
         for stage in self._stages:
             try:
                 ray_tpu.kill(stage)

@@ -62,9 +62,11 @@ def delete(name: str, timeout: float = 30.0) -> None:
     ray_tpu.get(controller.delete.remote(name), timeout=timeout)
 
 
-def shutdown() -> None:
-    """Tear down all deployments AND the controller actor."""
-    global _http_server
+def shutdown(drain_timeout_s: float = 10.0) -> None:
+    """Tear down all deployments AND the controller actor. The HTTP proxy
+    drains FIRST (stop accepting, let in-flight requests finish against
+    still-live replicas — reference: proxy draining on serve shutdown)."""
+    stop_http(drain_timeout_s)
     try:
         controller = get_or_create_controller()
         ray_tpu.get(controller.shutdown.remote(), timeout=30.0)
@@ -72,9 +74,6 @@ def shutdown() -> None:
     except Exception:
         pass
     _Router.reset_all()
-    if _http_server is not None:
-        _http_server.shutdown()
-        _http_server = None
 
 
 def _resolve_route(path: str) -> Optional[str]:
@@ -105,8 +104,46 @@ def _resolve_route(path: str) -> Optional[str]:
 _routes_cache = None
 
 
+class _InFlight:
+    """Proxy request accounting for graceful draining."""
+
+    def __init__(self):
+        self.count = 0
+        self.cond = threading.Condition()
+
+    def __enter__(self):
+        with self.cond:
+            self.count += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self.cond:
+            self.count -= 1
+            self.cond.notify_all()
+
+    def drain(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while self.count > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cond.wait(min(remaining, 1.0))
+        return True
+
+
+_in_flight = _InFlight()
+_STREAM_END = object()
+
+
 class _ProxyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # chunked transfer needs 1.1
+
     def do_POST(self):  # noqa: N802 (stdlib API)
+        with _in_flight:
+            self._handle()
+
+    def _handle(self) -> None:
         parts = self.path.strip("/").split("/")
         # Route table first (supports custom route_prefix); fall back to
         # the first path segment as the app name.
@@ -114,9 +151,14 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b"null"
         model_id = self.headers.get("serve_multiplexed_model_id", "")
+        streaming = (self.headers.get("x-serve-stream", "")
+                     or self.headers.get("X-Serve-Stream", ""))
         try:
             payload = json.loads(body)
             handle = DeploymentHandle(name, multiplexed_model_id=model_id)
+            if streaming:
+                self._stream_response(handle, payload, name)
+                return
             result = handle.remote(payload).result(timeout=70)
             data = json.dumps(result).encode()
             self.send_response(200)
@@ -129,6 +171,48 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self.send_error(500, str(e))
 
+    def _stream_response(self, handle, payload, name: str) -> None:
+        """Chunked transfer encoding, one JSON line per yielded item
+        (reference: proxy.py streaming/chunked responses). The generator
+        is pulled incrementally — chunks reach the client as the replica
+        produces them.
+
+        Errors BEFORE the first item become real HTTP errors (the
+        generator is primed before any header ships); a mid-stream error
+        can't rewrite the status line, so it becomes an error record in
+        the stream and the connection closes (never a second response on
+        a keep-alive socket)."""
+        stream = handle.stream(payload)
+        try:
+            first = next(stream, _STREAM_END)
+        except KeyError:
+            self.send_error(404, f"no deployment {name!r}")
+            return
+        except Exception as e:  # noqa: BLE001
+            self.send_error(500, str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Connection", "close")
+        self.end_headers()
+
+        def chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+
+        try:
+            if first is not _STREAM_END:
+                chunk(json.dumps(first).encode() + b"\n")
+                for item in stream:
+                    chunk(json.dumps(item).encode() + b"\n")
+        except Exception as e:  # noqa: BLE001 — headers already sent
+            chunk(json.dumps(
+                {"__serve_stream_error__": str(e)}).encode() + b"\n")
+        finally:
+            self.wfile.write(b"0\r\n\r\n")
+            self.close_connection = True
+
     def log_message(self, *args):  # silence
         pass
 
@@ -140,3 +224,14 @@ def start_http(host: str = "127.0.0.1", port: int = 0) -> tuple:
     threading.Thread(target=_http_server.serve_forever, name="serve-http",
                      daemon=True).start()
     return _http_server.server_address
+
+
+def stop_http(drain_timeout_s: float = 10.0) -> None:
+    """Stop accepting, then wait for in-flight requests to finish."""
+    global _http_server
+    if _http_server is None:
+        return
+    _http_server.shutdown()  # accept loop stops; handler threads continue
+    _in_flight.drain(drain_timeout_s)
+    _http_server.server_close()
+    _http_server = None

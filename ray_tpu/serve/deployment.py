@@ -287,6 +287,37 @@ class _Router:
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
 
+    def stream(self, method: str, args: tuple, kwargs: dict,
+               model_id: str = "", chunk_items: int = 16):
+        """Generator of streamed items from one replica: the replica's
+        generator suspends between pulls (consumer-paced). The replica's
+        in-flight slot and this router's count are held for the stream's
+        lifetime (autoscaling sees streams as load)."""
+        self.wait_ready()
+        replica = self._pick(model_id)
+        if replica is None:
+            raise RuntimeError(
+                f"deployment {self.name!r} has no replicas")
+        handle = replica["handle"]
+        sid = None
+        try:
+            sid = ray_tpu.get(handle.start_stream.remote(
+                method, args, kwargs, model_id), timeout=70.0)
+            while True:
+                items, done = ray_tpu.get(handle.next_chunks.remote(
+                    sid, chunk_items), timeout=70.0)
+                yield from items
+                if done:
+                    sid = None
+                    return
+        finally:
+            if sid is not None:  # consumer bailed early: free the slot
+                try:
+                    handle.cancel_stream.remote(sid)
+                except Exception:
+                    pass
+            self._release(replica)
+
     def stop(self) -> None:
         self._stop.set()
         self._pool.shutdown(wait=False)
@@ -320,6 +351,12 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> Future:
         return _Router.get(self._name).submit(
+            self._method, args, kwargs, self._model_id)
+
+    def stream(self, *args, **kwargs):
+        """Iterate a generator-returning deployment method incrementally
+        (reference: handle streaming / chunked HTTP responses)."""
+        return _Router.get(self._name).stream(
             self._method, args, kwargs, self._model_id)
 
     def __getattr__(self, name):

@@ -110,6 +110,79 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    # ------------------------------------------------- streaming sessions
+    #
+    # A generator-returning callable streams INCREMENTALLY: the consumer
+    # pulls batches with next_chunks (actor calls), so the generator is
+    # suspended between pulls and production is backpressured by the
+    # consumer (reference: proxy.py's streaming responses over
+    # ASGI receive/send; here the handle is the transport).
+
+    def start_stream(self, method: str, args: tuple, kwargs: dict,
+                     multiplexed_model_id: str = "") -> str:
+        import uuid
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        _current_model_id.value = multiplexed_model_id
+        try:
+            target = (self._instance if method == "__call__"
+                      else getattr(self._instance, method))
+            result = target(*args, **kwargs)
+            iterator = iter(result)
+        except BaseException:
+            with self._lock:
+                self._ongoing -= 1
+            raise
+        finally:
+            _current_model_id.value = ""
+        sid = uuid.uuid4().hex[:16]
+        self._streams = getattr(self, "_streams", {})
+        self._streams[sid] = (iterator, multiplexed_model_id)
+        return sid
+
+    def next_chunks(self, stream_id: str, max_items: int = 16,
+                    deadline_s: float = 2.0):
+        """Pull up to ``max_items``, returning EARLY with whatever arrived
+        once ``deadline_s`` elapses — a slow-but-healthy producer must
+        stream partial batches, not stall the consumer's RPC timeout until
+        the full batch exists. Returns (items, done); the stream's ongoing
+        slot frees when the iterator is exhausted."""
+        entry = getattr(self, "_streams", {}).get(stream_id)
+        if entry is None:
+            raise KeyError(f"unknown stream {stream_id}")
+        iterator, model_id = entry
+        items = []
+        done = False
+        deadline = time.monotonic() + deadline_s
+        _current_model_id.value = model_id  # generator body resumes here
+        try:
+            for _ in range(max_items):
+                items.append(next(iterator))
+                if time.monotonic() > deadline:
+                    break
+        except StopIteration:
+            done = True
+        except BaseException:
+            self.cancel_stream(stream_id)
+            raise
+        finally:
+            _current_model_id.value = ""
+        if done:
+            self.cancel_stream(stream_id)
+        return items, done
+
+    def cancel_stream(self, stream_id: str) -> None:
+        entry = getattr(self, "_streams", {}).pop(stream_id, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except Exception:
+                pass
+            with self._lock:
+                self._ongoing -= 1
+
     def stats(self) -> Dict[str, Any]:
         models = loaded_model_ids(self._instance)
         with self._lock:
