@@ -27,6 +27,10 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "How long a task submission waits for a worker lease before erroring."),
     "worker_start_timeout_s": (float, 60.0,
         "How long the worker pool waits for a forked worker to register."),
+    "worker_forkserver_enabled": (bool, True,
+        "Fork default-env CPU workers from a pre-imported per-node template "
+        "process (~10 ms) instead of spawning a fresh interpreter (~150 ms+) "
+        "(reference: prestarted worker pool, worker_pool.h:357)."),
     "idle_worker_keep_s": (float, 300.0,
         "Idle workers beyond the soft pool limit are reaped after this long."),
     "heartbeat_period_s": (float, 1.0,

@@ -50,6 +50,9 @@ class NodeRecord:
         self.queue_len = 0
         self.last_heartbeat = time.monotonic()
         self.alive = True
+        # Last applied heartbeat seq; -1 = none yet. Re-registration resets
+        # it so a restarted sender's fresh counter is accepted.
+        self.sync_seq = -1
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -359,7 +362,7 @@ class Controller:
 
     def heartbeat(self, node_id_bytes: bytes,
                   available: Optional[Dict[str, float]],
-                  queue_len: int) -> Dict[str, bool]:
+                  queue_len: int, seq: Optional[int] = None) -> Dict[str, bool]:
         """Returns ``known=False`` when this controller has no record of the
         node — the signal for a live raylet to re-register after a head
         restart (node membership is not persisted; reference: raylets
@@ -367,17 +370,28 @@ class Controller:
 
         ``available=None`` is a liveness-only delta beat (the node's view
         is unchanged); the record keeps its last payload (reference:
-        RaySyncer's versioned delta stream vs full snapshots)."""
+        RaySyncer's versioned delta stream vs full snapshots).
+
+        ``seq`` is the node's monotonic sync version (reference: versioned
+        NodeState snapshots, ray_syncer.h:88). A beat whose seq is not
+        newer than the last applied one is dropped — a delayed full beat
+        racing a newer delta can no longer regress availability until the
+        periodic refresh. Beats still count for liveness either way;
+        ``seq=None`` (unversioned caller) always applies."""
         with self._lock:
             rec = self._nodes.get(NodeID(node_id_bytes))
             if rec is None:
                 return {"known": False}
+            rec.last_heartbeat = time.monotonic()
+            rec.alive = True
+            if seq is not None and seq <= rec.sync_seq:
+                return {"known": True, "applied": False}
+            if seq is not None:
+                rec.sync_seq = seq
             if available is not None:
                 rec.available = dict(available)
             rec.queue_len = queue_len
-            rec.last_heartbeat = time.monotonic()
-            rec.alive = True
-            return {"known": True}
+            return {"known": True, "applied": True}
 
     def list_nodes(self) -> List[Dict[str, Any]]:
         with self._lock:

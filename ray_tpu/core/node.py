@@ -20,6 +20,10 @@ inline RPC methods so they always make progress while lease calls wait.
 from __future__ import annotations
 
 import os
+import pickle
+import select
+import signal
+import struct
 import subprocess
 import sys
 import threading
@@ -77,6 +81,126 @@ def _kill_and_reap(proc: subprocess.Popen, force: bool) -> None:
         proc.wait(timeout=5.0)
     except (subprocess.TimeoutExpired, OSError):
         pass
+
+
+class _ForkserverError(Exception):
+    """Template process unavailable/failed — callers fall back to spawn."""
+
+
+class _PendingProc:
+    """Placeholder proc while a forkserver child's pid reply is in flight.
+    The handle must already be in the worker table (the warm child can hit
+    ``register_worker`` within ms of ``os.fork``), and the reaper may look
+    at it before the real ``_ForkedProc`` is swapped in."""
+
+    pid = -1
+    returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        return None
+
+    def terminate(self) -> None:
+        pass
+
+    def kill(self) -> None:
+        pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        raise subprocess.TimeoutExpired("pending-forked-worker", timeout or 0)
+
+
+class _ForkedProc:
+    """``subprocess.Popen``-shaped handle for a forkserver child.
+
+    The worker is the FORKSERVER's child, not ours, so ``waitpid`` is not
+    available here. Liveness and signalling go through a pidfd
+    (``pidfd_open`` works for non-children; the fd pins the process
+    identity, so PID reuse can neither fake liveness nor misdirect a
+    kill — a recycled PID would otherwise leak the dead worker's lease
+    forever). Fallback when pidfds are unavailable: /proc scraping (the
+    forkserver reaps children via SIGCHLD, so a dead worker's /proc entry
+    disappears; zombie state means the forkserver itself died first).
+    Exit codes are unknown either way — any "gone" is reported as 1,
+    which every caller treats the same as a crash."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._pidfd: Optional[int] = None
+        # poll()/_signal()/__del__ race from reaper, lease, and
+        # memory-monitor threads; the lock keeps the close-and-None
+        # transition atomic so no thread touches a stale fd number.
+        self._fd_lock = threading.Lock()
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except (AttributeError, OSError):
+            # Already exited (ESRCH) or pre-5.3 kernel: poll() decides via
+            # /proc below.
+            pass
+
+    def poll(self) -> Optional[int]:
+        with self._fd_lock:
+            if self.returncode is not None:
+                return self.returncode
+            if self._pidfd is not None:
+                # A pidfd becomes readable exactly when the process exits.
+                # select.poll, not select.select: pidfds allocated past
+                # FD_SETSIZE (1024 — easily reached by a worker surge in a
+                # multi-node driver) would blow up select().
+                p = select.poll()
+                p.register(self._pidfd, select.POLLIN)
+                if p.poll(0):
+                    self.returncode = 1
+                    os.close(self._pidfd)
+                    self._pidfd = None
+                return self.returncode
+        try:
+            with open(f"/proc/{self.pid}/stat", "rb") as f:
+                stat = f.read()
+            # Field 3, after the parenthesised comm (which may hold spaces).
+            state = stat.rsplit(b")", 1)[1].split()[0]
+        except (OSError, IndexError):
+            self.returncode = 1
+            return self.returncode
+        if state == b"Z":
+            self.returncode = 1
+            return self.returncode
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+    def _signal(self, sig: int) -> None:
+        try:
+            with self._fd_lock:
+                if self._pidfd is not None:
+                    signal.pidfd_send_signal(self._pidfd, sig)
+                elif self.returncode is None:
+                    os.kill(self.pid, sig)
+                # else: already observed dead — a raw os.kill here could
+                # hit an unrelated process that recycled the PID.
+        except OSError:
+            pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def __del__(self):
+        with self._fd_lock:
+            if self._pidfd is not None:
+                try:
+                    os.close(self._pidfd)
+                except OSError:
+                    pass
+                self._pidfd = None
 
 
 class _LeaseWaiter:
@@ -153,6 +277,11 @@ class Node:
         self._general_queue_len = 0  # waiters on the general (non-PG) pool
         self._death_causes: Dict[bytes, str] = {}
         self._stopped = threading.Event()
+        # Worker forkserver (lazy): one pre-imported template process that
+        # os.fork()s default-env CPU workers in ~10 ms (worker_pool.h:357
+        # PrestartWorkers-era economics on a 1-core box).
+        self._fs_lock = threading.Lock()
+        self._fs_proc: Optional[subprocess.Popen] = None
 
         self._server = RpcServer(
             handlers={
@@ -168,8 +297,10 @@ class Node:
                 "free_shm_object": self.free_shm_object,
                 "worker_death_cause": self.worker_death_cause,
                 "list_workers": self.list_workers,
+                "prestart_workers": self.prestart_workers,
                 "get_info": self.get_info,
                 "ping": lambda: "pong",
+                "worker_ping": self.worker_ping,
             },
             host=host,
             name="node",
@@ -223,9 +354,11 @@ class Node:
     ) -> Dict[str, Any]:
         """Block until resources are free, then hand out a pooled or freshly
         forked worker. Returns {worker_id, addr} or {error}. ``dedicated``
-        leases always fork: actor workers must never drain the task pool
-        (the reference worker pool likewise matches leases to pooled workers
-        only for normal tasks; actors hold their worker for life).
+        leases (actors) claim a matching warm pooled worker when available
+        and fork otherwise — the worker holds the actor for life either way
+        (reference: leases matched from pooled/prestarted workers,
+        worker_pool.h:357; the forkserver refills the pool fast enough that
+        actors can no longer starve the task pool).
         ``runtime_env`` (env_vars / working_dir) selects — or forks — a
         worker built with that environment (reference: the per-node
         runtime-env agent building envs for the worker pool,
@@ -268,9 +401,21 @@ class Node:
         env_hash = _runtime_env_hash(runtime_env)
         try:
             if dedicated:
-                handle = self._fork_worker(dedicated=True,
-                                           needs_tpu=needs_tpu,
-                                           runtime_env=runtime_env)
+                # Actors claim a warm pooled worker ONLY when the
+                # forkserver can refill that kind in ~10 ms (default-env
+                # CPU workers); TPU / custom-env workers cost seconds to
+                # respawn, so handing those to an actor for life would
+                # starve the task pool — they always fork (reference:
+                # leases matched from prestarted workers, worker_pool.h:357).
+                handle = None
+                if (config.worker_forkserver_enabled and not needs_tpu
+                        and not env_hash):
+                    handle = self._take_idle_worker(needs_tpu, env_hash,
+                                                    claim_dedicated=True)
+                if handle is None:
+                    handle = self._fork_worker(dedicated=True,
+                                               needs_tpu=needs_tpu,
+                                               runtime_env=runtime_env)
             else:
                 handle = self._take_or_fork_worker(needs_tpu, runtime_env,
                                                    env_hash)
@@ -341,9 +486,9 @@ class Node:
             # this lease; crediting again here would double-count.
             self._drain_waiters_locked()
 
-    def _take_or_fork_worker(self, needs_tpu: bool = False,
-                             runtime_env: Optional[Dict[str, Any]] = None,
-                             env_hash: str = "") -> WorkerHandle:
+    def _take_idle_worker(self, needs_tpu: bool, env_hash: str,
+                          claim_dedicated: bool = False
+                          ) -> Optional[WorkerHandle]:
         with self._lock:
             kept: List[WorkerHandle] = []
             found = None
@@ -354,12 +499,24 @@ class Node:
                 elif (found is None and handle.tpu == needs_tpu
                         and handle.env_hash == env_hash):
                     handle.idle = False
+                    # Claimed-for-actor transition happens UNDER the lock:
+                    # the chaos kill hook picks pooled victims by this flag
+                    # and must never see a just-claimed actor worker as fair
+                    # game.
+                    if claim_dedicated:
+                        handle.dedicated = True
                     found = handle
                 else:
                     kept.append(handle)
             self._idle.extend(kept)
-            if found is not None:
-                return found
+            return found
+
+    def _take_or_fork_worker(self, needs_tpu: bool = False,
+                             runtime_env: Optional[Dict[str, Any]] = None,
+                             env_hash: str = "") -> WorkerHandle:
+        found = self._take_idle_worker(needs_tpu, env_hash)
+        if found is not None:
+            return found
         return self._fork_worker(needs_tpu=needs_tpu,
                                  runtime_env=runtime_env)
 
@@ -367,12 +524,21 @@ class Node:
                      needs_tpu: bool = False,
                      runtime_env: Optional[Dict[str, Any]] = None
                      ) -> WorkerHandle:
+        if (config.worker_forkserver_enabled and not needs_tpu
+                and not runtime_env):
+            try:
+                return self._fork_worker_fs(dedicated)
+            except _ForkserverError:
+                # Template unavailable/crashed: fall back to a fresh spawn.
+                # Post-fork failures (registration timeout, child death)
+                # propagate — they are worker failures, not template ones,
+                # and retrying them would double the caller's wait.
+                pass
         worker_id = WorkerID.from_random()
-        env = dict(os.environ)
-        env.update(self._extra_env)
         workdir = None
         python_exe = sys.executable
         env_paths: List[str] = []
+        extra_vars: Optional[Dict[str, str]] = None
         if runtime_env:
             # Full env build (working_dir + py_modules + pip venv); any
             # failure raises and becomes the lease error (reference: the
@@ -380,25 +546,13 @@ class Node:
             from ray_tpu.runtime_env import build_env
 
             built = build_env(runtime_env, self._controller)
-            env.update(built["env_vars"])
+            extra_vars = built["env_vars"]
             workdir = built["cwd"]
             env_paths = [p for p in built["pythonpath"] if p != workdir]
             if built["python"]:
                 python_exe = built["python"]
-        if not needs_tpu:
-            # CPU-only workers skip accelerator attach: site hooks keyed on
-            # these vars import jax (+PJRT registration) into EVERY python
-            # process, a ~2s startup tax per fork that pure-CPU task workers
-            # never need. TPU-resourced leases keep them (configurable).
-            for var in config.accel_env_vars.split(","):
-                if var:
-                    env.pop(var.strip(), None)
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        extra_paths = [pkg_root] + [p for p in sys.path if p]
-        inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
-        env["PYTHONPATH"] = os.pathsep.join(
-            dict.fromkeys(extra_paths + inherited))
+        env = self._spawn_env(strip_accel=not needs_tpu,
+                              extra_vars=extra_vars)
         front = ([workdir] if workdir else []) + env_paths
         if front:
             # working_dir + py_modules go FIRST so they shadow base-env
@@ -446,9 +600,15 @@ class Node:
         handle.env_hash = _runtime_env_hash(runtime_env)
         with self._lock:
             self._workers[worker_id] = handle
-        # Fail FAST if the process dies before registering (chaos kill, bad
-        # env): waiting out the full timeout would eat the caller's whole
-        # lease deadline and turn one crash into a task failure.
+        self._wait_registered(handle)
+        return handle
+
+    def _wait_registered(self, handle: WorkerHandle) -> None:
+        """Fail FAST if the process dies before registering (chaos kill, bad
+        env): waiting out the full timeout would eat the caller's whole
+        lease deadline and turn one crash into a task failure."""
+        proc = handle.proc
+        worker_id = handle.worker_id
         deadline = time.monotonic() + config.worker_start_timeout_s
         while not handle.registered.wait(0.2):
             if proc.poll() is not None:
@@ -463,7 +623,166 @@ class Node:
                     self._workers.pop(worker_id, None)
                 raise TimeoutError(
                     f"worker {worker_id.hex()} failed to register")
+
+    def _spawn_env(self, strip_accel: bool,
+                   extra_vars: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+        """Base environment for worker AND template processes: node extras,
+        optional accelerator-hook strip, user runtime-env vars, then repo +
+        sys.path merged onto PYTHONPATH.
+
+        ``strip_accel``: CPU-only workers skip accelerator attach — site
+        hooks keyed on these vars import jax (+PJRT registration) into
+        EVERY python process, a ~2s startup tax per fork that pure-CPU
+        task workers never need. TPU-resourced leases keep them.
+
+        ``extra_vars`` (runtime_env env_vars) land BEFORE the PYTHONPATH
+        merge, so a user-supplied PYTHONPATH joins the inherited tail
+        instead of clobbering the pkg-root entry the worker needs to
+        import ray_tpu; and AFTER the accel strip, so a runtime_env that
+        sets an accelerator var deliberately keeps it."""
+        env = dict(os.environ)
+        env.update(self._extra_env)
+        if strip_accel:
+            for var in config.accel_env_vars.split(","):
+                if var:
+                    env.pop(var.strip(), None)
+        if extra_vars:
+            env.update(extra_vars)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        extra_paths = [pkg_root] + [p for p in sys.path if p]
+        inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                     if p]
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(extra_paths + inherited))
+        return env
+
+    # ------------------------------------------------------- forkserver
+
+    def _fork_worker_fs(self, dedicated: bool) -> WorkerHandle:
+        """Fork a default-env CPU worker from the warm template process."""
+        worker_id = WorkerID.from_random()
+        req: Dict[str, Any] = {"worker_id": worker_id.hex(), "env": {},
+                               "stdout": None, "stderr": None}
+        if config.log_to_driver:
+            from ray_tpu.core.log_monitor import worker_log_paths
+
+            out_path, err_path = worker_log_paths(self.node_id.hex(),
+                                                  worker_id.hex())
+            req["stdout"], req["stderr"] = out_path, err_path
+            req["env"]["PYTHONUNBUFFERED"] = "1"
+        # Reserve the handle BEFORE forking: the warm child can reach
+        # register_worker within ms of os.fork() — before the pid reply is
+        # read — and an unknown worker_id would be rejected, killing it.
+        handle = WorkerHandle(worker_id, _PendingProc())
+        handle.dedicated = dedicated
+        with self._lock:
+            self._workers[worker_id] = handle
+        try:
+            handle.proc = _ForkedProc(self._forkserver_request(req))
+        except Exception:
+            with self._lock:
+                self._workers.pop(worker_id, None)
+            raise
+        self._wait_registered(handle)
         return handle
+
+    def _forkserver_request(self, req: Dict[str, Any]) -> int:
+        """One fork round-trip on the template's pipe. Serialized — forks
+        are ~10 ms, so a single in-flight request is not the bottleneck.
+        All failures surface as ``_ForkserverError`` (the caller's signal
+        to fall back to a fresh interpreter spawn)."""
+        with self._fs_lock:
+            try:
+                if self._fs_proc is None or self._fs_proc.poll() is not None:
+                    self._start_forkserver_locked()
+                proc = self._fs_proc
+                blob = pickle.dumps(req, protocol=5)
+                proc.stdin.write(struct.pack("!I", len(blob)) + blob)
+                proc.stdin.flush()
+                header = proc.stdout.read(4)
+                if len(header) < 4:
+                    raise RuntimeError("forkserver pipe closed")
+                (n,) = struct.unpack("!I", header)
+                reply = pickle.loads(proc.stdout.read(n))
+            except Exception as e:
+                if self._fs_proc is not None:
+                    _kill_and_reap(self._fs_proc, force=True)
+                    self._fs_proc = None
+                raise _ForkserverError(str(e)) from e
+            if "error" in reply:
+                raise _ForkserverError(reply["error"])
+            return reply["pid"]
+
+    def _start_forkserver_locked(self) -> None:
+        if self._stopped.is_set():
+            # A lease racing stop() must not respawn the template after
+            # stop() killed it — that would leak a process per stopped node.
+            raise RuntimeError("node is stopped")
+        env = self._spawn_env(strip_accel=True)
+        stderr: Any = subprocess.DEVNULL
+        if config.log_to_driver:
+            d = os.path.join(config.worker_log_dir, self.node_id.hex())
+            os.makedirs(d, exist_ok=True)
+            stderr = open(os.path.join(d, "forkserver.log"), "ab",
+                          buffering=0)
+        try:
+            self._fs_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.forkserver",
+                 "--node-host", self.address[0],
+                 "--node-port", str(self.address[1]),
+                 "--controller-host", self.controller_addr[0],
+                 "--controller-port", str(self.controller_addr[1]),
+                 "--node-id", self.node_id.hex()],
+                env=env,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=stderr,
+            )
+        finally:
+            if stderr is not subprocess.DEVNULL:
+                stderr.close()
+
+    def prestart_workers(self, count: int) -> int:
+        """Fork ``count`` default-env workers into the idle pool, in the
+        background (reference: ``PrestartWorkers``, worker_pool.h:357 —
+        fire-and-forget warm-up ahead of a burst of leases/actors)."""
+
+        def _prestart() -> None:
+            failures = 0
+            for _ in range(count):
+                if self._stopped.is_set():
+                    return
+                try:
+                    handle = self._fork_worker()
+                except Exception as e:
+                    # One bad fork must not abort the whole warm-up, but
+                    # persistent failure shouldn't hot-loop either.
+                    failures += 1
+                    print(f"prestart fork failed ({e}); "
+                          f"{failures} consecutive", file=sys.stderr)
+                    if failures >= 3:
+                        return
+                    continue
+                failures = 0
+                with self._lock:
+                    handle.idle = True
+                    handle.last_used = time.monotonic()
+                    self._idle.append(handle)
+
+        threading.Thread(target=_prestart, name="prestart-workers",
+                         daemon=True).start()
+        return count
+
+    def worker_ping(self, worker_id_bytes: bytes) -> Dict[str, bool]:
+        """Liveness ping that also answers "does this node still know me?".
+        A worker whose handle is gone from the table (lost forkserver pid
+        reply, reaper false positive, any future leak path) self-terminates
+        instead of orphaning — the table is the single source of truth."""
+        with self._lock:
+            known = WorkerID(worker_id_bytes) in self._workers
+        return {"known": known}
 
     def register_worker(self, worker_id_bytes: bytes, addr: Addr) -> Dict[str, Any]:
         worker_id = WorkerID(worker_id_bytes)
@@ -480,8 +799,8 @@ class Node:
                             timeout: Optional[float] = None,
                             runtime_env: Optional[Dict[str, Any]] = None
                             ) -> Dict[str, Any]:
-        """Lease a dedicated worker for an actor — always a fresh fork, so
-        actors can't drain the task worker pool."""
+        """Lease a dedicated worker for an actor — warm pooled worker when
+        one matches, else a ~10 ms forkserver fork."""
         return self.lease_worker(resources, bundle=bundle, timeout=timeout,
                                  dedicated=True, runtime_env=runtime_env)
 
@@ -554,6 +873,7 @@ class Node:
         touch."""
         last_sent = None
         beats_since_full = 0
+        seq = 0
         while not self._stopped.wait(config.heartbeat_period_s):
             try:
                 with self._lock:
@@ -566,9 +886,13 @@ class Node:
                     payload = None  # liveness-only delta
                 else:
                     payload = available
+                # Monotonic sync version: each beat snapshots the view at a
+                # strictly later point, so the controller can drop reordered
+                # (stale) beats (ray_syncer.h:88 versioned NodeState).
+                seq += 1
                 reply = self._controller.call(
                     "heartbeat", self.node_id.binary(), payload, queue_len,
-                    timeout=5.0)
+                    seq, timeout=5.0)
                 if payload is not None:
                     # Only a DELIVERED full beat counts as sent: a failed
                     # RPC must retry the payload next beat, or the
@@ -671,8 +995,11 @@ class Node:
         import signal
 
         with self._lock:
+            # pid > 0 excludes _PendingProc placeholders (pid -1):
+            # os.kill(-1, SIGKILL) would massacre every signallable process.
             victims = [h for h in self._workers.values()
-                       if not h.dedicated and h.proc.poll() is None]
+                       if not h.dedicated and h.proc.pid > 0
+                       and h.proc.poll() is None]
         if not victims:
             return False
         victim = rng.choice(victims)
@@ -737,6 +1064,10 @@ class Node:
             workers = list(self._workers.values())
         for handle in workers:
             _kill_and_reap(handle.proc, force=True)
+        with self._fs_lock:
+            if self._fs_proc is not None:
+                _kill_and_reap(self._fs_proc, force=True)
+                self._fs_proc = None
         try:
             self._controller.call("unregister_node", self.node_id.binary(),
                                   timeout=2.0)

@@ -14,28 +14,20 @@ import sys
 import time
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--node-host", required=True)
-    parser.add_argument("--node-port", type=int, required=True)
-    parser.add_argument("--controller-host", required=True)
-    parser.add_argument("--controller-port", type=int, required=True)
-    parser.add_argument("--node-id", required=True)
-    parser.add_argument("--worker-id", required=True)
-    args = parser.parse_args()
-
+def run(node_addr, controller_addr, node_id_hex: str,
+        worker_id_hex: str) -> int:
+    """Embed a CoreWorker and serve until shutdown. Shared by the spawned
+    entrypoint below and by forkserver children (``core/forkserver.py``),
+    which skip interpreter+import startup entirely."""
     from ray_tpu.core.ids import NodeID, WorkerID
     from ray_tpu.core.rpc import RpcClient, RpcError
     from ray_tpu.core.runtime import CoreWorker, set_core_worker
-
-    node_addr = (args.node_host, args.node_port)
-    controller_addr = (args.controller_host, args.controller_port)
     core = CoreWorker(
         mode="worker",
         controller_addr=controller_addr,
         node_addr=node_addr,
-        node_id=NodeID.from_hex(args.node_id),
-        worker_id=WorkerID.from_hex(args.worker_id),
+        node_id=NodeID.from_hex(node_id_hex),
+        worker_id=WorkerID.from_hex(worker_id_hex),
     )
     set_core_worker(core)
 
@@ -46,15 +38,34 @@ def main() -> int:
         print(f"worker registration failed: {reply}", file=sys.stderr)
         return 1
 
-    # Serve until shutdown; exit if the node supervisor disappears.
+    # Serve until shutdown; exit if the node supervisor disappears OR has
+    # forgotten us (orphan protection both ways — a worker missing from the
+    # node's table can never be reaped, so it must exit itself).
     while not core._shutdown.is_set():
         time.sleep(2.0)
         try:
-            node_client.call("ping", timeout=5.0)
+            reply = node_client.call("worker_ping", core.worker_id.binary(),
+                                     timeout=5.0)
+            if not reply.get("known", True):
+                break
         except (RpcError, TimeoutError):
             break
     core.shutdown()
     return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-host", required=True)
+    parser.add_argument("--node-port", type=int, required=True)
+    parser.add_argument("--controller-host", required=True)
+    parser.add_argument("--controller-port", type=int, required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+    return run((args.node_host, args.node_port),
+               (args.controller_host, args.controller_port),
+               args.node_id, args.worker_id)
 
 
 if __name__ == "__main__":

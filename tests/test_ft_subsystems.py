@@ -366,3 +366,32 @@ def test_delta_heartbeats_preserve_availability():
         assert c.list_nodes()[0]["available"] == {"CPU": 8.0}
     finally:
         c.stop()
+
+
+def test_versioned_heartbeats_drop_reordered_beats():
+    """A delayed full beat must not overwrite a newer delta's view: beats
+    carry a per-node monotonic seq and the controller drops out-of-order
+    ones (reference: versioned NodeState snapshots, ray_syncer.h:88)."""
+    c = Controller()
+    try:
+        nid = b"v" * 16
+        c.register_node(nid, ("127.0.0.1", 1), {"CPU": 8.0}, {})
+        assert c.heartbeat(nid, {"CPU": 2.0}, 1, seq=5)["applied"]
+        # Stale full beat (older seq, e.g. delayed in the network): dropped.
+        r = c.heartbeat(nid, {"CPU": 8.0}, 0, seq=3)
+        assert r["known"] and not r["applied"]
+        rec = c.list_nodes()[0]
+        assert rec["available"] == {"CPU": 2.0} and rec["queue_len"] == 1
+        # Duplicate seq: dropped too.
+        assert not c.heartbeat(nid, {"CPU": 7.0}, 9, seq=5)["applied"]
+        # Newer seq applies; liveness was refreshed by the stale beats.
+        assert c.heartbeat(nid, {"CPU": 6.0}, 2, seq=6)["applied"]
+        assert c.list_nodes()[0]["available"] == {"CPU": 6.0}
+        # Re-registration (restarted head / fresh record) resets the seq
+        # floor so a restarted sender's small counter is accepted.
+        c.register_node(nid, ("127.0.0.1", 1), {"CPU": 8.0}, {})
+        assert c.heartbeat(nid, {"CPU": 5.0}, 0, seq=1)["applied"]
+        # Unversioned callers (legacy path) always apply.
+        assert c.heartbeat(nid, {"CPU": 4.0}, 0)["applied"]
+    finally:
+        c.stop()

@@ -141,3 +141,35 @@ def test_actor_wave_across_nodes(ray_start_cluster):
     assert len(set(pids)) == 16
     for a in actors:
         ray_tpu.kill(a)
+
+
+@pytest.mark.timeout_s(170)
+def test_actor_surge_forkserver(ray_start_regular):
+    """A burst of 100 actors — the Serve-replica-surge shape — must come up
+    at forkserver speed, not interpreter-spawn speed (reference: prestarted
+    worker pool, worker_pool.h:357; 40k-actor envelope row,
+    release/benchmarks/README.md:12). The bound is ~6x looser than the
+    measured rate (>50/s on an idle box) to tolerate CI load, but still
+    several times faster than the old fork wall (~4.7/s => 21s)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Replica:
+        def ping(self):
+            import os
+
+            return os.getpid()
+
+    # Warm the template (first fork starts the forkserver process).
+    warm = Replica.options(num_cpus=0.001).remote()
+    ray_tpu.get(warm.ping.remote(), timeout=60)
+    ray_tpu.kill(warm)
+
+    t0 = time.time()
+    actors = [Replica.options(num_cpus=0.001).remote() for _ in range(100)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    wall = time.time() - t0
+    assert len(set(pids)) == 100
+    assert wall < 12.0, f"100-actor surge took {wall:.1f}s (fork wall?)"
+    for a in actors:
+        ray_tpu.kill(a)
