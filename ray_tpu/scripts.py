@@ -421,7 +421,9 @@ def cmd_serve(args) -> int:
         handles = deploy_config(args.config)
         print(f"deployed {len(handles)} application(s)")
     elif args.action == "status":
-        print(_json.dumps(serve.status(), indent=2, default=str))
+        print(_json.dumps({"applications": serve.status(),
+                           "proxies": serve.proxy_status()},
+                          indent=2, default=str))
     elif args.action == "shutdown":
         serve.shutdown()
         print("serve shut down")

@@ -3,10 +3,13 @@
 from ray_tpu.serve.api import (  # noqa: F401
     delete,
     get_deployment_handle,
+    http_addresses,
+    proxy_status,
     run,
     shutdown,
     start_http,
     status,
+    stop_http,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.build import deploy_config  # noqa: F401

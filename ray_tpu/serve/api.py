@@ -1,26 +1,24 @@
-"""serve.run / status / delete / HTTP proxy.
+"""serve.run / status / delete + HTTP ingress management.
 
-Analogue of the reference's ``serve.run`` + proxy (``serve/api.py``,
-``serve/_private/proxy.py:761,1130``). All control-plane state lives in the
-ServeController ACTOR (``controller.py``) — this module is a thin client, so
-deployments survive the driver that created them; a later driver resolves
-the controller by name and keeps operating the same apps.
+Analogue of the reference's ``serve.run`` (``serve/api.py``). All
+control-plane state lives in the ServeController ACTOR (``controller.py``)
+— this module is a thin client, so deployments survive the driver that
+created them; a later driver resolves the controller by name and keeps
+operating the same apps. The HTTP data plane is per-node ProxyActors
+supervised by that controller (``proxy.py``; reference:
+``serve/_private/proxy.py:131``, ``proxy_state.py``) — NOT a server in the
+driver process, so ingress survives driver exit too.
 """
 
 from __future__ import annotations
 
-import json
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.core import serialization
 from ray_tpu.serve.controller import get_or_create_controller
 from ray_tpu.serve.deployment import Deployment, DeploymentHandle, _Router
-
-_http_server: Optional[ThreadingHTTPServer] = None
 
 
 def run(app: Deployment, name: Optional[str] = None,
@@ -36,7 +34,7 @@ def run(app: Deployment, name: Optional[str] = None,
         name, serialization.dumps_function(app.cls), app._init_args,
         app._init_kwargs, app.config_dict()), timeout=ready_timeout_s)
     # HTTP route: explicit prefix, or /<name> by default. Stored on the
-    # controller so proxies in ANY process resolve it.
+    # controller so proxies on ANY node resolve it.
     ray_tpu.get(controller.set_route.remote(
         route_prefix or f"/{name}", name), timeout=30.0)
     handle = DeploymentHandle(name)
@@ -57,181 +55,72 @@ def status(timeout: float = 30.0) -> Dict[str, Any]:
     return ray_tpu.get(controller.status.remote(), timeout=timeout)
 
 
+def proxy_status(timeout: float = 30.0) -> Dict[str, Any]:
+    """Per-node proxy health (node hex -> addr + consecutive failures)."""
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.proxy_status.remote(), timeout=timeout)
+
+
 def delete(name: str, timeout: float = 30.0) -> None:
     controller = get_or_create_controller()
     ray_tpu.get(controller.delete.remote(name), timeout=timeout)
 
 
 def shutdown(drain_timeout_s: float = 10.0) -> None:
-    """Tear down all deployments AND the controller actor. The HTTP proxy
-    drains FIRST (stop accepting, let in-flight requests finish against
+    """Tear down all deployments AND the controller actor. Proxies drain
+    FIRST (stop accepting, let in-flight requests finish against
     still-live replicas — reference: proxy draining on serve shutdown)."""
-    stop_http(drain_timeout_s)
     try:
         controller = get_or_create_controller()
-        ray_tpu.get(controller.shutdown.remote(), timeout=30.0)
+        ray_tpu.get(controller.shutdown.remote(drain_timeout_s),
+                    timeout=drain_timeout_s + 60.0)
         ray_tpu.kill(controller)
     except Exception:
         pass
     _Router.reset_all()
 
 
-def _resolve_route(path: str) -> Optional[str]:
-    """Longest-prefix route lookup against the controller's route table
-    (cached briefly; the proxy may live in any process)."""
-    global _routes_cache
-    now = time.monotonic()
-    if _routes_cache is None or now - _routes_cache[0] > 2.0:
-        try:
-            controller = get_or_create_controller()
-            routes = ray_tpu.get(controller.get_routes.remote(),
-                                 timeout=10.0)
-            _routes_cache = (now, routes)
-        except Exception:
-            routes = {} if _routes_cache is None else _routes_cache[1]
-    else:
-        routes = _routes_cache[1]
-    path = "/" + path.strip("/")
-    best = None
-    for prefix, name in routes.items():
-        if (prefix == "/" or path == prefix
-                or path.startswith(prefix + "/")):
-            if best is None or len(prefix) > len(best[0]):
-                best = (prefix, name)
-    return best[1] if best else None
+def start_http(host: str = "127.0.0.1", port: int = 0,
+               ready_timeout_s: float = 60.0) -> Tuple[str, int]:
+    """Enable per-node HTTP ingress (idempotent) and wait until every
+    alive node has a listening proxy. Returns ONE reachable (host, port)
+    — the proxy on this process's node when there is one, else the first
+    (back-compat with the single-address shape; ``http_addresses()`` is
+    the full per-node map). The wait polls CLIENT-side — the controller
+    actor runs calls serially, so it must never block in enable_http."""
+    controller = get_or_create_controller()
+    state = ray_tpu.get(controller.enable_http.remote(host, port),
+                        timeout=60.0)
+    deadline = time.monotonic() + ready_timeout_s
+    while not (state["addrs"] and state["want"]
+               and len(state["addrs"]) >= state["want"]):
+        if time.monotonic() > deadline:
+            if state["addrs"]:
+                break  # partial ingress beats none after the deadline
+            raise RuntimeError(f"no serve proxies came up: {state}")
+        time.sleep(0.2)
+        state = ray_tpu.get(controller.http_ready.remote(), timeout=30.0)
+    addrs = state["addrs"]
+    try:
+        from ray_tpu.core.runtime import get_core_worker
+
+        local = get_core_worker().node_id.hex()
+    except Exception:
+        local = None
+    addr = addrs.get(local) or next(iter(addrs.values()))
+    return tuple(addr)
 
 
-_routes_cache = None
-
-
-class _InFlight:
-    """Proxy request accounting for graceful draining."""
-
-    def __init__(self):
-        self.count = 0
-        self.cond = threading.Condition()
-
-    def __enter__(self):
-        with self.cond:
-            self.count += 1
-        return self
-
-    def __exit__(self, *exc):
-        with self.cond:
-            self.count -= 1
-            self.cond.notify_all()
-
-    def drain(self, timeout: float) -> bool:
-        deadline = time.monotonic() + timeout
-        with self.cond:
-            while self.count > 0:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return False
-                self.cond.wait(min(remaining, 1.0))
-        return True
-
-
-_in_flight = _InFlight()
-_STREAM_END = object()
-
-
-class _ProxyHandler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"  # chunked transfer needs 1.1
-
-    def do_POST(self):  # noqa: N802 (stdlib API)
-        with _in_flight:
-            self._handle()
-
-    def _handle(self) -> None:
-        parts = self.path.strip("/").split("/")
-        # Route table first (supports custom route_prefix); fall back to
-        # the first path segment as the app name.
-        name = _resolve_route(self.path) or parts[0]
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length) if length else b"null"
-        model_id = self.headers.get("serve_multiplexed_model_id", "")
-        streaming = (self.headers.get("x-serve-stream", "")
-                     or self.headers.get("X-Serve-Stream", ""))
-        try:
-            payload = json.loads(body)
-            handle = DeploymentHandle(name, multiplexed_model_id=model_id)
-            if streaming:
-                self._stream_response(handle, payload, name)
-                return
-            result = handle.remote(payload).result(timeout=70)
-            data = json.dumps(result).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-        except KeyError:
-            self.send_error(404, f"no deployment {name!r}")
-        except Exception as e:  # noqa: BLE001
-            self.send_error(500, str(e))
-
-    def _stream_response(self, handle, payload, name: str) -> None:
-        """Chunked transfer encoding, one JSON line per yielded item
-        (reference: proxy.py streaming/chunked responses). The generator
-        is pulled incrementally — chunks reach the client as the replica
-        produces them.
-
-        Errors BEFORE the first item become real HTTP errors (the
-        generator is primed before any header ships); a mid-stream error
-        can't rewrite the status line, so it becomes an error record in
-        the stream and the connection closes (never a second response on
-        a keep-alive socket)."""
-        stream = handle.stream(payload)
-        try:
-            first = next(stream, _STREAM_END)
-        except KeyError:
-            self.send_error(404, f"no deployment {name!r}")
-            return
-        except Exception as e:  # noqa: BLE001
-            self.send_error(500, str(e))
-            return
-        self.send_response(200)
-        self.send_header("Content-Type", "application/jsonlines")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.send_header("Connection", "close")
-        self.end_headers()
-
-        def chunk(data: bytes) -> None:
-            self.wfile.write(f"{len(data):x}\r\n".encode())
-            self.wfile.write(data + b"\r\n")
-
-        try:
-            if first is not _STREAM_END:
-                chunk(json.dumps(first).encode() + b"\n")
-                for item in stream:
-                    chunk(json.dumps(item).encode() + b"\n")
-        except Exception as e:  # noqa: BLE001 — headers already sent
-            chunk(json.dumps(
-                {"__serve_stream_error__": str(e)}).encode() + b"\n")
-        finally:
-            self.wfile.write(b"0\r\n\r\n")
-            self.close_connection = True
-
-    def log_message(self, *args):  # silence
-        pass
-
-
-def start_http(host: str = "127.0.0.1", port: int = 0) -> tuple:
-    """Start the HTTP proxy; returns (host, port)."""
-    global _http_server
-    _http_server = ThreadingHTTPServer((host, port), _ProxyHandler)
-    threading.Thread(target=_http_server.serve_forever, name="serve-http",
-                     daemon=True).start()
-    return _http_server.server_address
+def http_addresses() -> Dict[str, tuple]:
+    """Pure getter: node hex -> (host, port) of live proxies. Does NOT
+    enable ingress (``start_http`` does) — a getter that re-enabled HTTP
+    would silently undo ``stop_http``."""
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.http_addresses.remote(), timeout=30.0)
 
 
 def stop_http(drain_timeout_s: float = 10.0) -> None:
-    """Stop accepting, then wait for in-flight requests to finish."""
-    global _http_server
-    if _http_server is None:
-        return
-    _http_server.shutdown()  # accept loop stops; handler threads continue
-    _in_flight.drain(drain_timeout_s)
-    _http_server.server_close()
-    _http_server = None
+    """Drain and stop every proxy (ingress off; deployments stay up)."""
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.disable_http.remote(drain_timeout_s),
+                timeout=drain_timeout_s + 60.0)

