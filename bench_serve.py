@@ -186,7 +186,6 @@ def main() -> None:
     t0 = time.monotonic()
     stop = t0 + (15.0 if args.quick else 30.0)
     scale_times = {}
-    lock = threading.Lock()
 
     def pound():
         while time.monotonic() < stop:
@@ -200,9 +199,8 @@ def main() -> None:
         t.start()
     while time.monotonic() < stop:
         n_rep = serve.status()["scaler"]["replicas"]
-        with lock:
-            if n_rep not in scale_times:
-                scale_times[n_rep] = time.monotonic() - t0
+        if n_rep not in scale_times:
+            scale_times[n_rep] = time.monotonic() - t0
         if n_rep >= 4:
             break
         time.sleep(0.1)
@@ -211,7 +209,8 @@ def main() -> None:
     peak = max(scale_times)
     rows.append({
         "metric": "serve_autoscale_up",
-        "value": round(scale_times.get(2, float("nan")), 1), "unit": "s",
+        "value": (round(scale_times[2], 1) if 2 in scale_times else None),
+        "unit": "s",
         "note": f"time to 2nd replica under 12-client load; reached "
                 f"{peak} replicas ({ {k: round(v, 1) for k, v in sorted(scale_times.items())} }); "
                 f"CPU replicas — single chip hosts one TPU replica",

@@ -65,8 +65,13 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "channels (reference: experimental_mutable_object_manager.h); "
         "disabled, every edge uses the RPC push path."),
     "dag_channel_capacity_bytes": (int, 8 * 1024 * 1024,
-        "Slot size of one compiled-DAG channel edge; larger items fall "
-        "back to the RPC push for that item."),
+        "Per-slot size of one compiled-DAG channel edge; larger items "
+        "fall back to the RPC push for that item."),
+    "dag_channel_slots": (int, 3,
+        "Ring depth of compiled-DAG channels (1-4): the writer may run "
+        "this many items ahead of the reader's ack, overlapping stage "
+        "compute with handoff (reference: buffered shared-memory "
+        "channels, shared_memory_channel.py:169)."),
     "event_buffer_max": (int, 10000,
         "Max buffered task state-transition events per worker (reference: "
         "TaskEventBuffer, task_event_buffer.h:206)."),

@@ -171,14 +171,17 @@ class _PipeStage:
 
     # ------------------------------------------------------------ channels
 
-    def listen_channel(self, path: str, capacity: int) -> bool:
+    def listen_channel(self, path: str, capacity: int,
+                       nslots: Optional[int] = None) -> bool:
         """Reader side: create the edge's channel and consume items on a
         drain thread (one consumer — channel items and RPC-fallback pushes
         are serialized through it, so the stage fn never runs twice
-        concurrently)."""
+        concurrently). ``nslots`` comes from the DRIVER's config so one
+        process controls the ring depth of the whole pipeline."""
         from ray_tpu.core.channel import MutableChannel
 
-        self._in_chan = MutableChannel(path, create=True, capacity=capacity)
+        self._in_chan = MutableChannel(path, create=True, capacity=capacity,
+                                       nslots=nslots)
         self._drain = threading.Thread(target=self._drain_loop,
                                        name="pipe-drain", daemon=True)
         self._drain.start()
@@ -363,7 +366,8 @@ class CompiledDAG:
                     continue
                 path = channel_path(f"{run_id}-e{i}")
                 ray_tpu.get(self._stages[i + 1].listen_channel.remote(
-                    path, config.dag_channel_capacity_bytes), timeout=60.0)
+                    path, config.dag_channel_capacity_bytes,
+                    config.dag_channel_slots), timeout=60.0)
                 ray_tpu.get(self._stages[i].attach_out_channel.remote(path),
                             timeout=60.0)
                 self._channel_paths.append(path)

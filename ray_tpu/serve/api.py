@@ -70,13 +70,22 @@ def shutdown(drain_timeout_s: float = 10.0) -> None:
     """Tear down all deployments AND the controller actor. Proxies drain
     FIRST (stop accepting, let in-flight requests finish against
     still-live replicas — reference: proxy draining on serve shutdown)."""
+    controller = None
     try:
         controller = get_or_create_controller()
         ray_tpu.get(controller.shutdown.remote(drain_timeout_s),
                     timeout=drain_timeout_s + 60.0)
-        ray_tpu.kill(controller)
     except Exception:
         pass
+    finally:
+        # Kill even when the graceful path timed out: a surviving named
+        # controller whose _stop is set would be resolved by the next
+        # serve.run as a zombie that never reconciles anything.
+        if controller is not None:
+            try:
+                ray_tpu.kill(controller)
+            except Exception:
+                pass
     _Router.reset_all()
 
 
@@ -120,7 +129,14 @@ def http_addresses() -> Dict[str, tuple]:
 
 
 def stop_http(drain_timeout_s: float = 10.0) -> None:
-    """Drain and stop every proxy (ingress off; deployments stay up)."""
-    controller = get_or_create_controller()
+    """Drain and stop every proxy (ingress off; deployments stay up).
+    No-op when no controller exists — defensive cleanup must not SPAWN a
+    control plane just to tell it to stop."""
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
     ray_tpu.get(controller.disable_http.remote(drain_timeout_s),
                 timeout=drain_timeout_s + 60.0)

@@ -261,7 +261,9 @@ class ServeController:
         fixed port works like the reference's :8000)."""
         with self._lock:
             self._http_cfg = {"host": host, "port": port}
-        self._reconcile_proxies()
+        # Convergence belongs to the 1 Hz _proxy_loop thread — doing it
+        # here would hold this serially-executed actor (and thus every
+        # deploy/status/get_routes call) hostage to slow proxy starts.
         return self.http_ready()
 
     def http_ready(self) -> Dict[str, Any]:
@@ -276,10 +278,15 @@ class ServeController:
             self._http_cfg = None
             proxies = list(self._proxies.values())
             self._proxies.clear()
-        for proxy in proxies:
+        # Drain all proxies CONCURRENTLY: serial drains would make this
+        # call's latency scale with node count past the caller's timeout.
+        drains = [(p, p.handle.drain.remote(drain_timeout_s))
+                  for p in proxies]
+        deadline = time.monotonic() + drain_timeout_s + 10.0
+        for proxy, ref in drains:
             try:
-                ray_tpu.get(proxy.handle.drain.remote(drain_timeout_s),
-                            timeout=drain_timeout_s + 10.0)
+                ray_tpu.get(ref, timeout=max(0.1,
+                                             deadline - time.monotonic()))
             except Exception:
                 pass
             try:
