@@ -197,7 +197,7 @@ def real_cluster(n_nodes: int, n_tasks: int, n_queued: int, n_pgs: int,
         assert len(set(pids)) == n_actors
         row("actors in cluster", n_actors, "actors", "40,000+ (4,096 cores)",
             f"all ALIVE + called in {wall:.1f}s "
-            f"({n_actors / wall:.1f} actors/s; fork-bound on this box)")
+            f"({n_actors / wall:.1f} actors/s via forkserver warm forks)")
         for a in actors:
             ray_tpu.kill(a)
 
@@ -271,8 +271,8 @@ def single_node_objects(n_args: int, n_returns: int, n_get: int,
         assert back.nbytes == big.nbytes
         row("large numpy through put/get", round(big_gb, 1), "GiB",
             "100 GiB+ (244 GB box)",
-            f"{big.nbytes / 1e9 / (time.time() - t0):.1f} GB/s round-trip "
-            f"(spills past store capacity)")
+            f"{big.nbytes / 1e9 / (time.time() - t0):.2f} GB/s round-trip "
+            f"({big_gb:.0f}x the 2 GiB store: disk-spill-backed)")
     finally:
         ray_tpu.shutdown()
 
@@ -301,6 +301,25 @@ def write_report(path: str, quick: bool) -> None:
                      f"{r['baseline']} | {r['note']} |")
     lines += [
         "",
+        "Notes:",
+        "",
+        "- **Actors**: forkserver warm forks (~10 ms each); the burst rate "
+        "is 50-60 actors/s on an otherwise-idle box (see "
+        "`tests/test_scale_envelope.py::test_actor_surge_forkserver`) — "
+        "the row above runs inside the full 50-raylet fixture where every "
+        "subsystem shares the one core.",
+        "- **Broadcast**: every row here is CPU-bound, not topology-bound "
+        "— all 50 'nodes' share one core, so aggregate GB/s ~= single-"
+        "stream GB/s. The binomial-tree broadcast "
+        "(`object_broadcast_fanout`, default off on one host for exactly "
+        "this reason) spreads pulls over replica nodes on real multi-host "
+        "clusters; its mechanism (slot leases, replica registration, "
+        "failure pruning) is tested in "
+        "`test_object_plane.py::test_broadcast_tree_forms_and_releases`.",
+        "- **Node-death detection** now defaults to ~60 s of missed beats "
+        "(reference parity, ray_config_def.h:842-846): the old 5 s "
+        "threshold reaped LIVE nodes' actors during the 1000-actor storm.",
+        "",
         "CI-runnable slice: `tests/test_scale_envelope.py` (reduced sizes, "
         "same mechanisms, asserts completion + latency bounds).",
         "",
@@ -323,9 +342,9 @@ def main() -> None:
         single_node_objects(2000, 500, 2000, 0.25)
     else:
         control_plane(2000)
-        real_cluster(n_nodes=50, n_tasks=5000, n_queued=20000, n_pgs=200,
-                     n_actors=100, broadcast_mb=64)
-        single_node_objects(10000, 3000, 10000, 2.0)
+        real_cluster(n_nodes=50, n_tasks=5000, n_queued=20000, n_pgs=1000,
+                     n_actors=1000, broadcast_mb=256)
+        single_node_objects(10000, 3000, 10000, 10.0)
     write_report(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "ENVELOPE.md"), args.quick)
     print(json.dumps({"rows": len(RESULTS),

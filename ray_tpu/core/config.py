@@ -40,9 +40,13 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "Delta heartbeats: unchanged availability ships as a liveness-only "
         "beat, with a full payload at least every this many beats "
         "(reference: RaySyncer versioned deltas, ray_syncer.h:88)."),
-    "health_check_failure_threshold": (int, 5,
-        "Missed heartbeats before the controller declares a node dead "
-        "(reference: health_check_failure_threshold, ray_config_def.h:846)."),
+    "health_check_failure_threshold": (int, 60,
+        "Missed heartbeats before the controller declares a node dead. "
+        "Reference parity: ~60s of failed checks before death (period 3s "
+        "x timeout 10s x threshold 5, ray_config_def.h:842-846). The old "
+        "5s default proved trigger-happy — a 1000-actor surge starves "
+        "heartbeat threads past it and a LIVE node's actors get reaped. "
+        "Chaos tests that want fast detection override this."),
     "scheduler_spread_threshold": (float, 0.5,
         "Hybrid policy: prefer the local/first node until its utilization "
         "crosses this fraction, then spread (reference: "
@@ -72,6 +76,22 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "this many items ahead of the reader's ack, overlapping stage "
         "compute with handoff (reference: buffered shared-memory "
         "channels, shared_memory_channel.py:169)."),
+    "object_broadcast_min_bytes": (int, 8 * 1024 * 1024,
+        "Objects at least this big use tree broadcast: the owner caps "
+        "concurrent pulls per source and pullers re-register their copy "
+        "as a new source (reference: push dedup, push_manager.h:30 — "
+        "here generalized to a binomial distribution tree)."),
+    "object_broadcast_fanout": (int, 0,
+        "Max concurrent pulls served per source copy of a broadcast "
+        "object; further pullers wait for a replica to come up. 0 "
+        "(default) disables the tree: on a SINGLE host (incl. the "
+        "multi-node-in-one-machine fixture) every source shares one "
+        "CPU/NIC, so gating adds rounds without adding bandwidth — set "
+        "to 2 on real multi-host clusters where each replica node "
+        "contributes its own NIC."),
+    "object_pull_slot_lease_s": (float, 300.0,
+        "A broadcast pull slot auto-expires after this long (crashed "
+        "puller must not wedge the object's distribution tree)."),
     "event_buffer_max": (int, 10000,
         "Max buffered task state-transition events per worker (reference: "
         "TaskEventBuffer, task_event_buffer.h:206)."),

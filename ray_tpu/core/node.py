@@ -978,15 +978,20 @@ class Node:
         if view is not None:
             try:
                 total = len(view.data)
-                return total, bytes(view.data[offset:offset + length])
+                # One defensive copy (the view is released before the RPC
+                # reply ships), wrapped as a PickleBuffer so the transport
+                # sends it out-of-band — no further pickle copy on either
+                # end (PEP 574 framing in rpc.py).
+                chunk = bytes(view.data[offset:offset + length])
             finally:
                 view.release()
+            return total, pickle.PickleBuffer(chunk)
         path = spill_file(self.node_id, oid_bytes)
         try:
             total = os.path.getsize(path)
             with open(path, "rb") as f:
                 f.seek(offset)
-                return total, f.read(length)
+                return total, pickle.PickleBuffer(f.read(length))
         except OSError:
             return None
 

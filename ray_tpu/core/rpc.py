@@ -44,27 +44,91 @@ def dumps(obj: Any) -> bytes:
         return cloudpickle.dumps(obj, protocol=5)
 
 
-def loads(data: bytes) -> Any:
+def loads(data) -> Any:
     return pickle.loads(data)
 
 
-def send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+# Out-of-band frame layout (PEP 574): MAGIC | u32 meta_len | u32 n_bufs |
+# u64 sizes[n] | meta | raw buffers. Large buffer-protocol payloads (numpy
+# chunks, actor args) skip the pickle byte-copy on BOTH ends: the sender
+# scatter-writes the raw buffers, the receiver reconstructs zero-copy
+# views into the single recv buffer. \xff can never begin a plain pickle
+# (those start with \x80 PROTO), so the magic is unambiguous.
+_OOB_MAGIC = b"\xffRTB1"
 
 
-def recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = io.BytesIO()
-    remaining = n
-    while remaining:
-        chunk = sock.recv(min(remaining, 4 << 20))
-        if not chunk:
+def dumps_parts(obj: Any) -> list:
+    """Serialize to a list of send buffers (scatter-gather). Falls back to
+    one in-band pickle part for cloudpickle payloads and non-contiguous
+    buffers."""
+    bufs: list = []
+    try:
+        meta = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+        raws = [b.raw() for b in bufs]
+    except Exception:
+        return [dumps(obj)]
+    if not raws:
+        return [meta]
+    head = b"".join([_OOB_MAGIC,
+                     struct.pack("<II", len(meta), len(raws)),
+                     struct.pack(f"<{len(raws)}Q",
+                                 *(r.nbytes for r in raws)),
+                     meta])
+    return [head] + raws
+
+
+def loads_frame(frame) -> Any:
+    view = memoryview(frame)
+    if bytes(view[:len(_OOB_MAGIC)]) != _OOB_MAGIC:
+        return pickle.loads(view)
+    off = len(_OOB_MAGIC)
+    meta_len, n = struct.unpack_from("<II", view, off)
+    off += 8
+    sizes = struct.unpack_from(f"<{n}Q", view, off)
+    off += 8 * n
+    meta = view[off:off + meta_len]
+    off += meta_len
+    buffers = []
+    for s in sizes:
+        buffers.append(view[off:off + s])
+        off += s
+    return pickle.loads(meta, buffers=buffers)
+
+
+def send_frame(sock: socket.socket, payload) -> None:
+    if isinstance(payload, (bytes, bytearray)):
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+        return
+    # Scatter path: length header, then parts in order. Small parts
+    # coalesce into one syscall; big buffers go straight from their
+    # backing memory (an mmap'd store chunk never lands in a pickle copy).
+    total = sum(memoryview(p).nbytes for p in payload)
+    head = bytearray(_LEN.pack(total))
+    for p in payload:
+        if memoryview(p).nbytes < 65536 and len(head) < (1 << 20):
+            head += p
+        else:
+            if head:
+                sock.sendall(head)
+                head = bytearray()
+            sock.sendall(p)
+    if head:
+        sock.sendall(head)
+
+
+def recv_exact(sock: socket.socket, n: int) -> memoryview:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, 8 << 20))
+        if r == 0:
             raise ConnectionError("socket closed mid-frame")
-        buf.write(chunk)
-        remaining -= len(chunk)
-    return buf.getvalue()
+        got += r
+    return view
 
 
-def recv_frame(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket) -> memoryview:
     header = recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     return recv_exact(sock, length)
@@ -140,7 +204,7 @@ class RpcServer:
         try:
             while not self._stopped.is_set():
                 frame = recv_frame(conn)
-                msg = loads(frame)
+                msg = loads_frame(frame)
                 if msg.get("method") in self._inline:
                     self._handle(conn, send_lock, msg)
                 else:
@@ -171,7 +235,7 @@ class RpcServer:
         if req_id is None:
             return
         try:
-            payload = dumps(reply)
+            payload = dumps_parts(reply)
         except Exception as e:
             payload = dumps({"id": req_id, "ok": False,
                              "error": RpcError(f"unpicklable reply: {e!r}")})
@@ -226,7 +290,7 @@ class RpcClient:
     def _read_loop(self) -> None:
         try:
             while True:
-                msg = loads(recv_frame(self._sock))
+                msg = loads_frame(recv_frame(self._sock))
                 with self._pending_lock:
                     call = self._pending.pop(msg["id"], None)
                 if call is not None:
@@ -250,8 +314,8 @@ class RpcClient:
         call = _PendingCall()
         with self._pending_lock:
             self._pending[req_id] = call
-        payload = dumps({"id": req_id, "method": method,
-                         "args": args, "kwargs": kwargs})
+        payload = dumps_parts({"id": req_id, "method": method,
+                               "args": args, "kwargs": kwargs})
         try:
             with self._send_lock:
                 send_frame(self._sock, payload)
@@ -269,8 +333,8 @@ class RpcClient:
 
     def notify(self, method: str, *args, **kwargs) -> None:
         """Fire-and-forget one-way message."""
-        payload = dumps({"id": None, "method": method,
-                         "args": args, "kwargs": kwargs})
+        payload = dumps_parts({"id": None, "method": method,
+                               "args": args, "kwargs": kwargs})
         try:
             with self._send_lock:
                 send_frame(self._sock, payload)

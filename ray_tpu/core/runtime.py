@@ -134,6 +134,11 @@ class CoreWorker:
         slots = max(1, config.max_pull_bytes_in_flight
                     // config.object_transfer_chunk_bytes)
         self._pull_slots = threading.BoundedSemaphore(slots)
+        # Owner-side broadcast trees (reference: push_manager.h:30 push
+        # dedup, generalized): per big object, the set of replica
+        # locations and the leased pull slots per source.
+        self._bcast: Dict[bytes, Dict[str, Any]] = {}
+        self._bcast_cond = threading.Condition()
 
         self.server = RpcServer(
             handlers={
@@ -141,6 +146,8 @@ class CoreWorker:
                 "wait_object": self._handle_wait_object,
                 "peek_object": self._handle_peek_object,
                 "free_object": self._handle_free_object,
+                "pull_done": self._handle_pull_done,
+                "pull_failed": self._handle_pull_failed,
                 "ref_update": self._handle_ref_update,
                 "reconstruct_object": self._handle_reconstruct,
                 "push_task": self._handle_push_task,
@@ -153,7 +160,12 @@ class CoreWorker:
             },
             name=f"{mode}-core",
             max_workers=128,
-            inline_methods={"peek_object", "free_object", "ref_update"},
+            inline_methods={"peek_object", "free_object", "ref_update",
+                            # Broadcast slot releases must make progress
+                            # while the pool is saturated with blocked
+                            # get_object long-polls — else each tree round
+                            # stalls a full long-poll window.
+                            "pull_done", "pull_failed"},
         )
         self.addr: Addr = self.server.addr
         self.submitter = TaskSubmitter(self)
@@ -208,7 +220,9 @@ class CoreWorker:
                 # live object (ObjectLostError on a later get).
                 self.store._entry(oid).shm_pin = store.seal(
                     oid.binary(), pin=True)
-                return self._shm_locator(oid)
+                loc = self._shm_locator(oid)
+                loc["total"] = total  # lets the owner pick broadcast mode
+                return loc
         except OSError:
             pass
         return self._try_spill(oid, total, write)
@@ -236,6 +250,7 @@ class CoreWorker:
             os.rename(tmp, path)
             loc = self._shm_locator(oid)
             loc["spill"] = path
+            loc["total"] = total
             return loc
         except OSError:
             return None
@@ -275,39 +290,108 @@ class CoreWorker:
         self.store.put_serialized(cache_oid, payload)
         return payload
 
-    def _pull_remote(self, locator: Dict[str, Any],
-                     cache_oid: ObjectID) -> bytes:
-        """Chunked node-to-node pull (reference: ObjectManager 64 MiB chunk
-        pulls, object_manager.h:117, gated by the PullManager memory budget,
-        pull_manager.h:52 — here a bounded semaphore of chunk slots)."""
+    def _pull_remote_replicate(self, locator: Dict[str, Any],
+                               cache_oid: ObjectID):
+        """Broadcast-tree pull: fetch the object's chunks STRAIGHT into a
+        buffer in THIS node's store (one copy on this host), seal it
+        UNPINNED (LRU-evictable — replicas are cache, not primaries) and
+        serve a zero-copy view. Returns (frame, new_locator|None); falls
+        back to a plain in-process pull when the store has no room."""
+        total = locator.get("total", 0)
+        store = buf = None
+        try:
+            from ray_tpu.core.node import shm_store_path
+
+            store = self._open_shm(shm_store_path(self.node_id))
+            buf = store.create_buffer(cache_oid.binary(), total)
+        except OSError:
+            buf = None
+        if buf is None:
+            payload = self._pull_remote(locator, cache_oid)
+            self.store.put_serialized(cache_oid, payload)
+            return payload, None
+        try:
+            self._pull_remote_into(locator, cache_oid, buf, total)
+        except BaseException:
+            try:
+                store.seal(cache_oid.binary(), pin=False)
+                store.delete(cache_oid.binary())
+            except Exception:
+                pass
+            raise
+        store.seal(cache_oid.binary(), pin=False)
+        view = store.get_view(cache_oid.binary())
+        if view is None:  # evicted before we could even view it
+            payload = self._pull_remote(locator, cache_oid)
+            self.store.put_serialized(cache_oid, payload)
+            return payload, None
+        entry = self.store._entry(cache_oid)
+        entry.shm_view = view
+        loc = self._shm_locator(cache_oid)
+        loc["total"] = total
+        return view.data.toreadonly(), loc
+
+    def _pull_remote_into(self, locator: Dict[str, Any],
+                          cache_oid: ObjectID, buf, total: int,
+                          start: int = 0) -> None:
+        """Chunked pull written at-offset into ``buf`` from ``start``
+        (disjoint ranges; parallel chunk threads never overlap), gated by
+        the pull-slot memory budget (reference: ObjectManager 64 MiB chunk
+        pulls, object_manager.h:117 / pull_manager.h:52). The remaining
+        chunks fan out on a dedicated pool (NOT _io_pool: multi-ref get()
+        already saturates that pool, and fanning out from inside it would
+        deadlock)."""
         node_client = self.clients.get(tuple(locator["node_addr"]))
         chunk = config.object_transfer_chunk_bytes
         oid = locator["oid"]
 
-        def fetch(offset: int):
+        def fetch(offset: int) -> None:
             with self._pull_slots:
                 got = node_client.call("read_shm_chunk", oid, offset, chunk)
             if got is None:
                 raise ObjectLostError(
                     f"object {cache_oid.hex()} evicted from remote store "
                     f"mid-pull at offset {offset}")
-            return got
+            rtotal, data = got
+            if rtotal != total:
+                raise ObjectLostError(
+                    f"object {cache_oid.hex()} size changed mid-pull")
+            buf[offset:offset + len(data)] = data
 
         try:
-            total, data = fetch(0)
-            if total <= len(data):
-                return data
-            offsets = list(range(len(data), total, chunk))
-            # Remaining chunks pull in parallel on a dedicated pool (NOT
-            # _io_pool: multi-ref get() already saturates that pool, and
-            # fanning out from inside it would deadlock), gated by the
-            # chunk-slot budget.
-            rest = list(self._chunk_pool().map(lambda off: fetch(off)[1],
-                                               offsets))
-            return b"".join([data] + rest)
+            offsets = list(range(start, total, chunk))
+            if offsets:
+                list(self._chunk_pool().map(fetch, offsets))
         except (RpcError, RemoteCallError, TimeoutError) as e:
             raise ObjectLostError(
                 f"node holding {cache_oid.hex()} unreachable: {e}") from e
+
+    def _pull_remote(self, locator: Dict[str, Any],
+                     cache_oid: ObjectID) -> bytes:
+        """Chunked node-to-node pull into process memory. One chunk learns
+        the size, the rest delegate to ``_pull_remote_into`` (same
+        admission control and error mapping as the replicating path)."""
+        node_client = self.clients.get(tuple(locator["node_addr"]))
+        chunk = config.object_transfer_chunk_bytes
+        try:
+            with self._pull_slots:
+                got = node_client.call("read_shm_chunk", locator["oid"], 0,
+                                       chunk)
+        except (RpcError, RemoteCallError, TimeoutError) as e:
+            raise ObjectLostError(
+                f"node holding {cache_oid.hex()} unreachable: {e}") from e
+        if got is None:
+            raise ObjectLostError(
+                f"object {cache_oid.hex()} evicted from remote store "
+                f"mid-pull at offset 0")
+        total, data = got
+        if total <= len(data):
+            return bytes(data)
+        buf = bytearray(total)
+        buf[:len(data)] = data
+        self._pull_remote_into(locator, cache_oid, buf, total,
+                               start=len(data))
+        return bytes(buf)
 
     # ------------------------------------------------------------ put/get
 
@@ -405,6 +489,7 @@ class CoreWorker:
         # Borrower path: long-poll the owner, then resolve/cache locally.
         owner = self.clients.get(ref.owner_addr)
         recon_asked = 0
+        src_fails = 0
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             step = 5.0 if deadline is None else min(5.0, deadline - time.monotonic())
@@ -413,6 +498,7 @@ class CoreWorker:
                 raise GetTimeoutError(f"object {ref.hex()} not ready in time")
             try:
                 result = owner.call("get_object", ref.id.binary(), step,
+                                    self.node_id.binary(),
                                     timeout=step + 10.0)
             except RemoteCallError as e:
                 # The owner re-raised a stored error (put_error): surface the
@@ -429,12 +515,35 @@ class CoreWorker:
                 self.store.put_serialized(ref.id, payload)
                 return payload
             if kind == "shm":
+                # src_key present = the owner leased us a broadcast pull
+                # slot on that source (tree distribution); we must report
+                # done/failed so the slot frees and our replica joins the
+                # tree.
+                src_key = payload.pop("src_key", None)
+                remote = payload["node_id"] != self.node_id.binary()
                 try:
-                    frame = self._resolve_shm(payload, ref.id)
+                    if src_key is not None and remote:
+                        frame, new_loc = self._pull_remote_replicate(
+                            payload, ref.id)
+                    else:
+                        frame = self._resolve_shm(payload, ref.id)
+                        new_loc = None
                 except ObjectLostError:
-                    # The store copy is gone (evicted or node death). Ask
-                    # the owner to reconstruct it, then retry the long-poll.
                     self.store.drop(ref.id)
+                    if src_key is not None:
+                        try:
+                            owner.notify("pull_failed", ref.id.binary(),
+                                         src_key, payload["node_id"])
+                        except Exception:
+                            pass
+                        src_fails += 1
+                        if src_fails <= 3:
+                            # A broadcast tree has alternative sources:
+                            # the owner pruned the bad one, re-poll for
+                            # another copy before escalating to lineage
+                            # reconstruction. (Without a tree there is
+                            # only the dead primary — reconstruct NOW.)
+                            continue
                     recon_asked += 1
                     if recon_asked > config.reconstruction_max_attempts:
                         raise
@@ -447,7 +556,13 @@ class CoreWorker:
                             f"owner of {ref.hex()} unreachable for "
                             f"reconstruction") from None
                     continue
-                self.store.put_shm_ref(ref.id, payload)
+                if src_key is not None:
+                    try:
+                        owner.notify("pull_done", ref.id.binary(), src_key,
+                                     new_loc)
+                    except Exception:
+                        pass
+                self.store.put_shm_ref(ref.id, new_loc or payload)
                 return frame
             raise ObjectLostError(f"unknown get_object reply kind {kind!r}")
 
@@ -504,12 +619,22 @@ class CoreWorker:
 
     # ------------------------------------------------- owned-object server
 
-    def _handle_get_object(self, oid_bytes: bytes, timeout: float):
+    def _handle_get_object(self, oid_bytes: bytes, timeout: float,
+                           borrower_node: Optional[bytes] = None):
         """Long-poll: returns ("inline", frame) / ("shm", locator), or None
         on timeout. Owners hand out shm locators rather than bytes so the
         borrower can read node-locally (owner-based object directory,
-        ownership_based_object_directory.h)."""
+        ownership_based_object_directory.h).
+
+        Big objects (>= object_broadcast_min_bytes) distribute as a
+        binomial TREE: the owner caps concurrent pulls per source copy
+        (object_broadcast_fanout) and pullers that finish register their
+        node's copy as a new source (``pull_done``), so N-node broadcast
+        costs O(log N) serial transfer rounds instead of N pulls off one
+        node (reference envelope: 1 GiB -> 50+ nodes,
+        release/benchmarks/README.md:20; push dedup push_manager.h:30)."""
         oid = ObjectID(oid_bytes)
+        deadline = time.monotonic() + timeout
         try:
             entry = self.store.wait_ready(oid, timeout)
         except Exception as e:
@@ -517,11 +642,85 @@ class CoreWorker:
             if isinstance(e, GetTimeoutError):
                 return None
             raise
-        if entry.shm_ref is not None:
-            return ("shm", entry.shm_ref)
-        if entry.data is None:
-            raise ObjectLostError(f"object {oid.hex()} has no data")
-        return ("inline", entry.data)
+        primary = entry.shm_ref
+        if primary is None:
+            if entry.data is None:
+                raise ObjectLostError(f"object {oid.hex()} has no data")
+            return ("inline", entry.data)
+        total = primary.get("total", 0)
+        if (config.object_broadcast_fanout <= 0
+                or total < config.object_broadcast_min_bytes):
+            return ("shm", primary)
+        return self._assign_pull_source(oid_bytes, primary, borrower_node,
+                                        deadline)
+
+    def _assign_pull_source(self, oid_bytes: bytes, primary: Dict[str, Any],
+                            borrower_node: Optional[bytes],
+                            deadline: float):
+        """Pick a source copy with a free pull slot, blocking (within the
+        long-poll window) until one frees. Same-node copies need no slot —
+        they are zero-copy local reads."""
+        fanout = max(1, config.object_broadcast_fanout)
+        lease = config.object_pull_slot_lease_s
+        with self._bcast_cond:
+            track = self._bcast.setdefault(
+                oid_bytes, {"secondaries": {}, "slots": {}})
+            while True:
+                locs = {primary["node_id"]: primary}
+                locs.update(track["secondaries"])
+                if borrower_node is not None and borrower_node in locs:
+                    return ("shm", locs[borrower_node])  # local: no slot
+                now = time.monotonic()
+                best_key, best_load = None, None
+                for key, loc in locs.items():
+                    live = [t for t in track["slots"].get(key, [])
+                            if t > now]
+                    track["slots"][key] = live
+                    if len(live) < fanout and (best_load is None
+                                               or len(live) < best_load):
+                        best_key, best_load = key, len(live)
+                if best_key is not None:
+                    track["slots"].setdefault(best_key, []).append(
+                        now + lease)
+                    loc = dict(locs[best_key])
+                    loc["src_key"] = best_key
+                    return ("shm", loc)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None  # borrower re-polls
+                self._bcast_cond.wait(min(remaining, 1.0))
+
+    def _handle_pull_done(self, oid_bytes: bytes, src_key: bytes,
+                          new_locator: Optional[Dict[str, Any]]) -> None:
+        """A puller finished: release its source slot and (when it managed
+        to replicate into its node's store) add that copy as a new source."""
+        with self._bcast_cond:
+            track = self._bcast.get(oid_bytes)
+            if track is None:
+                return
+            slots = track["slots"].get(src_key)
+            if slots:
+                slots.pop()
+            if new_locator is not None:
+                track["secondaries"][new_locator["node_id"]] = new_locator
+            self._bcast_cond.notify_all()
+
+    def _handle_pull_failed(self, oid_bytes: bytes,
+                            src_key: Optional[bytes],
+                            bad_key: bytes) -> None:
+        """A source failed mid-pull/read: release the leased slot (when one
+        was leased — local reads lease none) and forget the secondary (a
+        dead PRIMARY is the reconstruction path's business)."""
+        with self._bcast_cond:
+            track = self._bcast.get(oid_bytes)
+            if track is None:
+                return
+            if src_key is not None:
+                slots = track["slots"].get(src_key)
+                if slots:
+                    slots.pop()
+            track["secondaries"].pop(bad_key, None)
+            self._bcast_cond.notify_all()
 
     def _handle_wait_object(self, oid_bytes: bytes, timeout: float) -> bool:
         try:
@@ -595,6 +794,17 @@ class CoreWorker:
                     "free_shm_object", locator["oid"])
             except Exception:
                 pass
+        with self._bcast_cond:
+            track = self._bcast.pop(oid.binary(), None)
+        if track:
+            # Secondary copies are unpinned (LRU-evictable), but free them
+            # eagerly anyway — a freed object's replicas are pure waste.
+            for loc in track["secondaries"].values():
+                try:
+                    self.clients.get(tuple(loc["node_addr"])).notify(
+                        "free_shm_object", loc["oid"])
+                except Exception:
+                    pass
         with self._lineage_lock:
             self._lineage.pop(oid, None)
 

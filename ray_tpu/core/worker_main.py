@@ -41,15 +41,23 @@ def run(node_addr, controller_addr, node_id_hex: str,
     # Serve until shutdown; exit if the node supervisor disappears OR has
     # forgotten us (orphan protection both ways — a worker missing from the
     # node's table can never be reaped, so it must exit itself).
+    # "Disappeared" requires CONSECUTIVE misses: a single slow ping under
+    # load (e.g. a 1000-actor storm starving the node's reader threads)
+    # must not make healthy workers mass-suicide — that cascaded into
+    # dead actors at envelope scale. known=False stays authoritative.
+    misses = 0
     while not core._shutdown.is_set():
         time.sleep(2.0)
         try:
             reply = node_client.call("worker_ping", core.worker_id.binary(),
-                                     timeout=5.0)
+                                     timeout=10.0)
             if not reply.get("known", True):
                 break
+            misses = 0
         except (RpcError, TimeoutError):
-            break
+            misses += 1
+            if misses >= 5:
+                break
     core.shutdown()
     return 0
 

@@ -767,10 +767,15 @@ class Dataset:
         """Write one parquet file per block via tasks (reference:
         ``Dataset.write_parquet``); returns the written paths."""
         def write_one(block: Block, out_path: str) -> str:
-            import pyarrow as pa
             import pyarrow.parquet as pq
 
-            pq.write_table(pa.table(dict(block)), out_path)
+            # Tensor-aware conversion (block.py to_arrow): ndim>1 columns
+            # become FixedSizeList with shape metadata, so e.g. stacked
+            # observations round-trip through parquet (plain pa.table()
+            # rejects multi-dimensional numpy columns).
+            from ray_tpu.data.block import to_arrow
+
+            pq.write_table(to_arrow(block), out_path)
             return out_path
 
         return self._write_blocks(path, "parquet", write_one)

@@ -57,9 +57,11 @@ def read_parquet(paths) -> Dataset:
     def reader(path: str):
         import pyarrow.parquet as pq
 
-        table = pq.read_table(path)
-        return {name: table[name].to_numpy(zero_copy_only=False)
-                for name in table.column_names}
+        from ray_tpu.data.block import from_arrow
+
+        # Tensor-aware: FixedSizeList columns with tensor_shape metadata
+        # (written by write_parquet) come back as n-d numpy columns.
+        return from_arrow(pq.read_table(path))
 
     return _read_files(paths, reader)
 

@@ -1,6 +1,13 @@
 """ray_tpu.rl: reinforcement learning at scale (reference: RLlib)."""
 
 from ray_tpu.rl.bc import BC, BCConfig, collect_dataset  # noqa: F401
+from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rl.offline import (  # noqa: F401
+    dataset_to_buffer,
+    load_transitions,
+    rollouts_to_dataset,
+    save_transitions,
+)
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
 from ray_tpu.rl.replay import ReplayBuffer, SumTree  # noqa: F401

@@ -1,0 +1,287 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Analogue of the reference's CQL (``rllib/algorithms/cql/cql.py`` — SAC
+plus a conservative critic regularizer, trained from offline data with no
+environment interaction). The critic loss adds
+
+    alpha_cql * ( logsumexp_a Q(s, a) - Q(s, a_data) )
+
+with the logsumexp estimated over uniform-random actions plus current- and
+next-policy actions (the CQL(H) importance-sampled estimator), which
+pushes Q down on out-of-distribution actions so the squashed-Gaussian
+actor can't exploit over-estimated values the dataset never visited.
+
+Data comes from the offline pipeline (``rl/offline.py``): a transitions
+Dataset (however produced — recorded runners, parquet logs) is staged into
+a ReplayBuffer and the learner runs jitted SAC-style updates with the
+conservative term. ``evaluate`` rolls the mean action in a real env.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.common import ConfigBuilderMixin
+from ray_tpu.rl.models import (
+    build_squashed_gaussian_actor,
+    build_twin_q,
+    squashed_sample,
+)
+
+
+@dataclass
+class CQLConfig(ConfigBuilderMixin):
+    env: str = "Pendulum-v1"             # for specs + evaluation only
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    batch_size: int = 256
+    updates_per_iteration: int = 200
+    cql_alpha: float = 1.0               # conservative penalty weight
+    cql_n_actions: int = 4               # sampled actions per source
+    bc_iters: int = 1000                 # actor warm-starts as pure BC
+    initial_alpha: float = 0.2           # entropy temperature at start
+    fixed_alpha: bool = False            # offline: auto-tuning can run away
+    hidden: tuple = (256, 256)
+    seed: int = 0
+
+    def build(self, dataset=None) -> "CQL":
+        return CQL(self, dataset)
+
+    def training(self, **kwargs) -> "CQLConfig":
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        return self
+
+
+class CQL:
+    """Offline learner over a transitions Dataset (no EnvRunners)."""
+
+    def __init__(self, config: CQLConfig, dataset=None):
+        import gymnasium as gym
+        import jax
+        import optax
+
+        self.config = config
+        self._iteration = 0
+        self._updates_done = 0
+        self.buffer = None
+        if dataset is not None:
+            self.set_dataset(dataset)
+
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        self._action_dim = int(np.prod(probe.action_space.shape))
+        self._action_shape = probe.action_space.shape
+        # Stored actions live in [-1, 1] (EnvRunner convention); rescale
+        # to env bounds only at evaluation time.
+        self._act_low = np.asarray(probe.action_space.low,
+                                   np.float32).reshape(-1)
+        self._act_high = np.asarray(probe.action_space.high,
+                                    np.float32).reshape(-1)
+        probe.close()
+
+        k = jax.random.split(jax.random.key(config.seed), 2)
+        actor_init, self._actor_fwd = build_squashed_gaussian_actor(
+            obs_dim, self._action_dim, config.hidden)
+        critic_init, self._critic_fwd = build_twin_q(
+            obs_dim, self._action_dim, config.hidden)
+        self.actor = actor_init(k[0])
+        self.critic = critic_init(k[1])
+        self.target_critic = jax.tree.map(lambda x: x, self.critic)
+        self.log_alpha = np.log(config.initial_alpha) * np.ones(())
+        self._target_entropy = -float(self._action_dim)
+
+        self._actor_opt = optax.adam(config.actor_lr)
+        self._critic_opt = optax.adam(config.critic_lr)
+        self._alpha_opt = optax.adam(config.alpha_lr)
+        self.actor_opt_state = self._actor_opt.init(self.actor)
+        self.critic_opt_state = self._critic_opt.init(self.critic)
+        self.alpha_opt_state = self._alpha_opt.init(self.log_alpha)
+        self._update = jax.jit(self._make_update())
+        self._key = jax.random.key(config.seed + 1)
+
+    def set_dataset(self, dataset) -> None:
+        from ray_tpu.rl.offline import dataset_to_buffer
+
+        self.buffer = dataset_to_buffer(dataset, seed=self.config.seed)
+
+    # ------------------------------------------------------------- learner
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        actor_fwd, critic_fwd = self._actor_fwd, self._critic_fwd
+        n_act = cfg.cql_n_actions
+
+        def q_on_actions(critic, obs, actions):
+            """Q1/Q2 for (B, K, A) action sets -> (B, K) each."""
+            B, K = actions.shape[0], actions.shape[1]
+            obs_rep = jnp.repeat(obs, K, axis=0)
+            flat = actions.reshape(B * K, -1)
+            q1, q2 = critic_fwd(critic, obs_rep, flat)
+            return q1.reshape(B, K), q2.reshape(B, K)
+
+        def critic_loss_fn(critic, actor, target_critic, log_alpha, batch,
+                           key):
+            k_next, k_rand, k_pi, k_npi = jax.random.split(key, 4)
+            # Standard SAC TD target.
+            mean, log_std = actor_fwd(actor, batch["next_obs"])
+            next_a, next_logp = squashed_sample(mean, log_std, k_next)
+            tq1, tq2 = critic_fwd(target_critic, batch["next_obs"], next_a)
+            alpha = jnp.exp(log_alpha)
+            target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target_q = jax.lax.stop_gradient(
+                batch["rewards"]
+                + cfg.gamma * (1.0 - batch["terminateds"]) * target_v)
+            q1, q2 = critic_fwd(critic, batch["obs"], batch["actions"])
+            td = ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+            # Conservative term: logsumexp over random + policy actions
+            # (CQL(H)), pushing down OOD Q while holding up data Q.
+            B = batch["obs"].shape[0]
+            rand_a = jax.random.uniform(
+                k_rand, (B, n_act, batch["actions"].shape[-1]),
+                minval=-1.0, maxval=1.0)
+            pi_mean, pi_ls = actor_fwd(actor, batch["obs"])
+            pi_a, _ = squashed_sample(
+                jnp.repeat(pi_mean, n_act, 0),
+                jnp.repeat(pi_ls, n_act, 0), k_pi)
+            npi_mean, npi_ls = actor_fwd(actor, batch["next_obs"])
+            npi_a, _ = squashed_sample(
+                jnp.repeat(npi_mean, n_act, 0),
+                jnp.repeat(npi_ls, n_act, 0), k_npi)
+            cat = jnp.concatenate(
+                [rand_a, pi_a.reshape(B, n_act, -1),
+                 npi_a.reshape(B, n_act, -1)], axis=1)
+            cq1, cq2 = q_on_actions(critic, batch["obs"], cat)
+            gap = (jax.scipy.special.logsumexp(cq1, axis=1) - q1
+                   + jax.scipy.special.logsumexp(cq2, axis=1) - q2)
+            return td + cfg.cql_alpha * gap.mean(), (td, gap.mean())
+
+        def actor_loss_fn(actor, critic, log_alpha, batch, key, bc):
+            mean, log_std = actor_fwd(actor, batch["obs"])
+            a, logp = squashed_sample(mean, log_std, key)
+            q1, q2 = critic_fwd(critic, batch["obs"], a)
+            alpha = jnp.exp(log_alpha)
+            sac_loss = (alpha * logp - jnp.minimum(q1, q2)).mean()
+            # BC warm-start (reference: cql.py bc_iters): maximize the
+            # squashed-Gaussian log-density of the DATA action — the
+            # change-of-variables pair of squashed_sample.
+            data_a = jnp.clip(batch["actions"], -0.999, 0.999)
+            pre = jnp.arctanh(data_a)
+            std = jnp.exp(log_std)
+            base = (-0.5 * ((pre - mean) / std) ** 2 - log_std
+                    - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+            squash = jnp.log(1.0 - data_a ** 2 + 1e-6).sum(-1)
+            bc_loss = (alpha * logp - (base - squash)).mean()
+            return jnp.where(bc, bc_loss, sac_loss), logp
+
+        def update(actor, critic, target_critic, log_alpha, opt_states,
+                   batch, key, bc):
+            actor_os, critic_os, alpha_os = opt_states
+            k1, k2 = jax.random.split(key)
+            (c_loss, (td, gap)), c_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(
+                critic, actor, target_critic, log_alpha, batch, k1)
+            updates, critic_os = self._critic_opt.update(
+                c_grads, critic_os, critic)
+            critic = optax.apply_updates(critic, updates)
+
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True)(actor, critic, log_alpha,
+                                             batch, k2, bc)
+            updates, actor_os = self._actor_opt.update(a_grads, actor_os,
+                                                       actor)
+            actor = optax.apply_updates(actor, updates)
+
+            if not cfg.fixed_alpha:
+                alpha_grad = -(jnp.exp(log_alpha)
+                               * jax.lax.stop_gradient(
+                                   logp + self._target_entropy).mean())
+                updates, alpha_os = self._alpha_opt.update(
+                    alpha_grad, alpha_os, log_alpha)
+                log_alpha = optax.apply_updates(log_alpha, updates)
+
+            target_critic = jax.tree.map(
+                lambda t, c: (1.0 - cfg.tau) * t + cfg.tau * c,
+                target_critic, critic)
+            aux = {"critic_loss": c_loss, "td_loss": td,
+                   "cql_gap": gap, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha)}
+            return (actor, critic, target_critic, log_alpha,
+                    (actor_os, critic_os, alpha_os), aux)
+
+        return update
+
+    # --------------------------------------------------------------- train
+
+    def train(self, dataset=None) -> Dict[str, Any]:
+        import jax
+
+        if dataset is not None:
+            self.set_dataset(dataset)
+        if self.buffer is None:
+            raise ValueError("CQL needs a transitions dataset "
+                             "(CQLConfig.build(dataset))")
+        cfg = self.config
+        t0 = time.monotonic()
+        aux = {}
+        for _ in range(cfg.updates_per_iteration):
+            batch, _idx, _w = self.buffer.sample(cfg.batch_size)
+            self._key, sub = jax.random.split(self._key)
+            bc = self._updates_done < cfg.bc_iters
+            (self.actor, self.critic, self.target_critic, self.log_alpha,
+             (self.actor_opt_state, self.critic_opt_state,
+              self.alpha_opt_state), aux) = self._update(
+                self.actor, self.critic, self.target_critic,
+                self.log_alpha,
+                (self.actor_opt_state, self.critic_opt_state,
+                 self.alpha_opt_state), batch, sub, bc)
+            self._updates_done += 1
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "updates": cfg.updates_per_iteration,
+            "learn_time_s": round(time.monotonic() - t0, 3),
+            "buffer_size": len(self.buffer),
+            **{k: float(v) for k, v in jax.device_get(aux).items()},
+        }
+
+    def evaluate(self, num_episodes: int = 8,
+                 seed: Optional[int] = None) -> Dict[str, Any]:
+        """Mean-action rollouts in the real env (no exploration noise)."""
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        env = gym.make(self.config.env, **self.config.env_config)
+        fwd = jax.jit(self._actor_fwd)
+        base_seed = self.config.seed if seed is None else seed
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=base_seed + ep)
+            done, total = False, 0.0
+            while not done:
+                mean, _ = fwd(self.actor, jnp.asarray(obs)[None])
+                squashed = np.asarray(jnp.tanh(mean[0]))
+                action = (self._act_low + (squashed + 1.0) * 0.5
+                          * (self._act_high - self._act_low)
+                          ).reshape(self._action_shape)
+                obs, reward, term, trunc, _ = env.step(action)
+                total += float(reward)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "episodes": num_episodes}

@@ -70,9 +70,19 @@ def shutdown(drain_timeout_s: float = 10.0) -> None:
     """Tear down all deployments AND the controller actor. Proxies drain
     FIRST (stop accepting, let in-flight requests finish against
     still-live replicas — reference: proxy draining on serve shutdown)."""
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
     controller = None
     try:
-        controller = get_or_create_controller()
+        # Lookup, not get_or_create: tearing down serve that was never
+        # started must not SPAWN a control plane.
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        controller = None
+    if controller is None:
+        _Router.reset_all()
+        return
+    try:
         ray_tpu.get(controller.shutdown.remote(drain_timeout_s),
                     timeout=drain_timeout_s + 60.0)
     except Exception:

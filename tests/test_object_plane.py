@@ -140,3 +140,55 @@ def test_manual_free_propagates(ray_start_cluster):
         time.sleep(0.05)
     with pytest.raises(ObjectFreedError):
         ray_tpu.get(ref, timeout=5)
+
+
+def test_broadcast_tree_forms_and_releases(ray_start_cluster):
+    """Tree broadcast (opt-in: object_broadcast_fanout>0): the owner leases
+    pull slots per source, finished pullers register their node's replica
+    as a new source, and all slots drain after the wave (VERDICT r3 #4;
+    reference: 1 GiB -> 50+ nodes row, release/benchmarks/README.md:20)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core.config import config
+    from ray_tpu.core.runtime import get_core_worker
+
+    cluster = ray_start_cluster
+    for _ in range(6):
+        cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(30)
+    ray_tpu.init(address=cluster.address)
+
+    old_fanout = config.object_broadcast_fanout
+    config.object_broadcast_fanout = 2
+    try:
+        @ray_tpu.remote
+        def warm(x):
+            return x
+
+        ray_tpu.get([warm.remote(i) for i in range(12)], timeout=120)
+
+        @ray_tpu.remote
+        def fetch(arr):
+            return int(arr.sum())
+
+        blob = np.ones(16 * 1024 * 1024, dtype=np.uint8)  # >= min_bytes
+        ref = ray_tpu.put(blob)
+        out = ray_tpu.get(
+            [fetch.options(scheduling_strategy="spread").remote(ref)
+             for _ in range(6)], timeout=300)
+        assert out == [blob.nbytes] * 6
+
+        core = get_core_worker()
+        with core._bcast_cond:
+            track = core._bcast.get(ref.id.binary())
+            assert track is not None
+            # Pullers replicated: the tree has secondary sources.
+            assert len(track["secondaries"]) >= 1, track
+            # All leased slots released (pull_done) or expired.
+            now = __import__("time").monotonic()
+            live = sum(len([t for t in slots if t > now])
+                       for slots in track["slots"].values())
+            assert live == 0, track["slots"]
+    finally:
+        config.object_broadcast_fanout = old_fanout
