@@ -18,7 +18,6 @@ pattern of ``src/ray/rpc/client_call.h``.
 
 from __future__ import annotations
 
-import io
 import pickle
 import socket
 import struct

@@ -72,6 +72,24 @@ def _dump_stacks() -> str:
     return dump_stacks()
 
 
+def _profile_cpu(duration_s: float = 3.0, hz: float = 100.0):
+    from ray_tpu.util.profiling import sample_stacks
+
+    return sample_stacks(duration_s, hz)
+
+
+def _profile_heap(top_n: int = 25):
+    from ray_tpu.util.profiling import heap_profile
+
+    return heap_profile(top_n)
+
+
+def _profile_heap_stop():
+    from ray_tpu.util.profiling import stop_heap_profile
+
+    return stop_heap_profile()
+
+
 def get_core_worker() -> "CoreWorker":
     if _core_worker is None:
         raise RayTpuError(
@@ -156,6 +174,11 @@ class CoreWorker:
                 "push_actor_task": self._handle_push_actor_task,
                 "shutdown_worker": self._handle_shutdown,
                 "dump_stacks": _dump_stacks,
+                # On-demand profiling (reference: profile_manager.py:79
+                # py-spy CPU + :190 memray heap — native equivalents).
+                "profile_cpu": _profile_cpu,
+                "profile_heap": _profile_heap,
+                "profile_heap_stop": _profile_heap_stop,
                 "ping": lambda: "pong",
             },
             name=f"{mode}-core",

@@ -194,6 +194,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     self._render_actor(path[len("/actor/"):]).encode(),
                     "text/html")
+            elif path.startswith("/worker/") and path.endswith("/flame"):
+                worker_hex = path[len("/worker/"):-len("/flame")]
+                self._send(self._render_flame(worker_hex, query).encode(),
+                           "image/svg+xml")
+            elif path.startswith("/worker/") and path.endswith("/heap"):
+                worker_hex = path[len("/worker/"):-len("/heap")]
+                self._send(json.dumps(
+                    self._worker_call(worker_hex, "profile_heap", 25,
+                                      timeout=30.0)).encode())
+            elif path == "/workers":
+                self._send(self._render_workers().encode(), "text/html")
             elif path in ("/", "/index.html"):
                 self._send(self._render().encode(), "text/html")
             else:
@@ -237,6 +248,70 @@ class _Handler(BaseHTTPRequestHandler):
                 html.append(f"[{_esc(tag)}] {_esc(line)}")
             html.append("</pre>")
         return _PAGE % "\n".join(html)
+
+    # ---------------------------------------------------------- profiling
+
+    def _find_worker(self, worker_hex: str):
+        from ray_tpu.util.profiling import list_cluster_workers
+
+        matches = list_cluster_workers(self.client, prefix=worker_hex)
+        return matches[0] if matches else None
+
+    def _call_worker(self, worker, method: str, *args,
+                     timeout: float = 30.0):
+        from ray_tpu.core.rpc import RpcClient
+
+        wc = RpcClient(tuple(worker["addr"]))
+        try:
+            return wc.call(method, *args, timeout=timeout)
+        finally:
+            wc.close()
+
+    def _worker_call(self, worker_hex: str, method: str, *args,
+                     timeout: float = 30.0):
+        w = self._find_worker(worker_hex)
+        if w is None:
+            return {"error": f"no live worker {worker_hex}"}
+        return self._call_worker(w, method, *args, timeout=timeout)
+
+    def _render_flame(self, worker_hex: str, query: Dict[str, str]) -> str:
+        """CPU flamegraph of a live worker, rendered inline (reference:
+        the dashboard attaching py-spy to any worker,
+        profile_manager.py:79 — here the worker samples itself)."""
+        from ray_tpu.util.profiling import flamegraph_svg
+
+        duration = min(30.0, float(query.get("duration", 3.0)))
+        w = self._find_worker(worker_hex)
+        if w is None:
+            return flamegraph_svg({}, title=f"no worker {worker_hex}")
+        try:
+            folded = self._call_worker(w, "profile_cpu", duration, 100.0,
+                                       timeout=duration + 30.0)
+        except Exception as e:
+            return flamegraph_svg({}, title=f"profiling failed: {e}")
+        return flamegraph_svg(
+            folded, title=f"worker {w['worker_id'][:8]} pid={w['pid']} "
+                          f"({duration:.0f}s @ 100Hz)")
+
+    def _render_workers(self) -> str:
+        """Live workers with profile links (flamegraph + heap)."""
+        from ray_tpu.util.profiling import list_cluster_workers
+
+        rows = []
+        for w in list_cluster_workers(self.client):
+            wid = w["worker_id"]
+            rows.append({
+                "worker": wid[:12], "node": w["node_id"][:12],
+                "pid": w["pid"],
+                "state": "idle" if w["idle"] else
+                         ("actor" if w["dedicated"] else "busy"),
+                "profile": (f"<a href='/worker/{wid}/flame?duration=3'>"
+                            f"flame</a> "
+                            f"<a href='/worker/{wid}/heap'>heap</a>"),
+            })
+        return _PAGE % ("<h2>workers</h2>"
+                        + _table(rows, ["worker", "node", "pid", "state",
+                                        "profile"]))
 
     # ---------------------------------------------------------- drill-down
 
@@ -371,7 +446,8 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<div>{_sparkline(points)} {_esc(name)} = {cur:.3g}</div>")
         if spark:
             html += "<h2>history (last ~12 min)</h2>" + "".join(spark)
-        html += "<p><a href='/logs'>live worker logs</a></p>"
+        html += ("<p><a href='/logs'>live worker logs</a> · "
+                 "<a href='/workers'>workers + profiling</a></p>")
         return _PAGE % html
 
     def log_message(self, *args):  # silence

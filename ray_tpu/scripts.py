@@ -281,6 +281,52 @@ def cmd_stacks(args) -> None:
         node_client.close()
 
 
+def cmd_profile(args) -> int:
+    """On-demand profiling of one live worker: CPU flamegraph (sampling
+    profiler -> folded stacks -> self-contained SVG) or heap snapshot
+    (tracemalloc top sites + growth since last call). Reference: the
+    dashboard reporter shelling out to py-spy/memray per worker
+    (``profile_manager.py:79,190``)."""
+    from ray_tpu.core.rpc import RpcClient
+    from ray_tpu.util.profiling import list_cluster_workers
+
+    client = _client(args)
+    matches = list_cluster_workers(client, prefix=args.worker)
+    target = matches[0] if matches else None
+    if target is None:
+        print(f"no live worker matches {args.worker!r} "
+              f"(see `ray_tpu stacks` for ids)")
+        return 1
+    wc = RpcClient(tuple(target["addr"]))
+    try:
+        if args.heap_stop:
+            print(wc.call("profile_heap_stop", timeout=30.0))
+            return 0
+        if args.heap:
+            import json as _json
+
+            out = wc.call("profile_heap", 25, timeout=30.0)
+            print(_json.dumps(out, indent=2))
+            return 0
+        folded = wc.call("profile_cpu", args.duration, 100.0,
+                         timeout=args.duration + 30.0)
+    finally:
+        wc.close()
+    from ray_tpu.util.profiling import flamegraph_svg
+
+    svg = flamegraph_svg(
+        folded, title=f"worker {target['worker_id'][:8]} "
+                      f"pid={target['pid']}")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(svg)
+        print(f"wrote {args.out} ({sum(folded.values())} samples)")
+    else:
+        for stack, n in sorted(folded.items(), key=lambda kv: -kv[1])[:30]:
+            print(f"{n:6d}  {stack}")
+    return 0
+
+
 def cmd_job(args) -> int:
     """Job submission CLI (reference: ``ray job submit/status/logs/stop``,
     ``dashboard/modules/job/cli.py``)."""
@@ -445,6 +491,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
     sub.add_parser("stacks")
+    p_prof = sub.add_parser("profile")
+    p_prof.add_argument("worker", help="worker id (hex prefix ok)")
+    p_prof.add_argument("--duration", type=float, default=3.0)
+    p_prof.add_argument("--heap", action="store_true")
+    p_prof.add_argument("--heap-stop", action="store_true",
+                        help="turn allocation tracing back off")
+    p_prof.add_argument("--out", default=None,
+                        help="write SVG flamegraph here (default: print "
+                             "folded stacks)")
     sub.add_parser("memory")
     p_start = sub.add_parser("start")
     p_start.add_argument("--head", action="store_true")
@@ -493,6 +548,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd_timeline(args)
     elif args.command == "stacks":
         cmd_stacks(args)
+    elif args.command == "profile":
+        return cmd_profile(args)
     elif args.command == "memory":
         cmd_memory(args)
     elif args.command == "start":

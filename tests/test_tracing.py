@@ -98,3 +98,112 @@ def test_worker_stack_dump_rpc(ray_start_regular):
         time.sleep(0.2)
     assert "napper" in dump, dump[-2000:]
     assert ray_tpu.get(ref, timeout=60) == 1
+
+
+# ------------------------------------------------- on-demand profiling
+# (VERDICT r3 #7; reference: dashboard reporter attaching py-spy/memray
+# to live workers, profile_manager.py:79,190)
+
+
+def test_profile_cpu_flamegraph_of_live_worker(ray_start_regular):
+    """Sample a busy worker's stacks over RPC and render a flamegraph:
+    the hot function must dominate the samples and appear in the SVG."""
+    import ray_tpu
+    from ray_tpu.core.rpc import RpcClient
+    from ray_tpu.core.runtime import get_core_worker
+    from ray_tpu.util.profiling import flamegraph_svg
+
+    @ray_tpu.remote
+    def burn(seconds):
+        import time as _t
+
+        def hot_loop(deadline):
+            x = 0
+            while _t.monotonic() < deadline:
+                x += 1
+            return x
+
+        return hot_loop(_t.monotonic() + seconds)
+
+    ref = burn.remote(4.0)
+    time.sleep(0.5)  # let the task start
+    core = get_core_worker()
+    nodes = core.controller.call("list_nodes")
+    workers = []
+    for n in nodes:
+        nc = RpcClient(tuple(n["addr"]))
+        workers += nc.call("list_workers")
+        nc.close()
+    busy = [w for w in workers if not w["idle"]]
+    assert busy, workers
+    wc = RpcClient(tuple(busy[0]["addr"]))
+    folded = wc.call("profile_cpu", 1.5, 100.0, timeout=30.0)
+    wc.close()
+    assert sum(folded.values()) > 50  # ~100Hz x 1.5s, load-tolerant
+    hot = [s for s in folded if "hot_loop" in s]
+    assert hot, list(folded)[:5]
+    # Wall-clock sampling counts IDLE threads too (the worker runs ~8
+    # service threads parked in waits, like py-spy's all-thread view), so
+    # the bar is "the hot function is a major stack", not ">50% of all".
+    assert sum(folded[s] for s in hot) > 0.08 * sum(folded.values())
+    svg = flamegraph_svg(folded)
+    assert svg.startswith("<svg") and "hot_loop" in svg
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+
+def test_profile_heap_growth(ray_start_regular):
+    """Heap profiling over RPC: first call arms tracemalloc, later calls
+    report the allocations made in between."""
+    import ray_tpu
+    from ray_tpu.core.actor import ActorHandle  # noqa: F401
+    from ray_tpu.core.rpc import RpcClient
+
+    @ray_tpu.remote
+    class Hoarder:
+        def __init__(self):
+            self.stuff = []
+
+        def grab(self, n):
+            self.stuff.append(bytearray(n))
+            return len(self.stuff)
+
+        def addr(self):
+            from ray_tpu.core.runtime import get_core_worker
+
+            return get_core_worker().addr
+
+    h = Hoarder.remote()
+    addr = ray_tpu.get(h.addr.remote(), timeout=60)
+    wc = RpcClient(tuple(addr))
+    first = wc.call("profile_heap", 10, timeout=30.0)
+    assert first["started"] is True
+    ray_tpu.get([h.grab.remote(512 * 1024) for _ in range(4)], timeout=60)
+    second = wc.call("profile_heap", 10, timeout=30.0)
+    wc.close()
+    assert second["started"] is False
+    assert second["traced_current_kb"] > 1500  # the 4 x 512KB grabs
+    assert second["top"], second
+
+
+def test_profile_heap_stop(ray_start_regular):
+    """Heap tracing can be turned back off (a diagnostic probe must not
+    slow the worker forever)."""
+    import ray_tpu
+    from ray_tpu.core.rpc import RpcClient
+
+    @ray_tpu.remote
+    class A:
+        def addr(self):
+            from ray_tpu.core.runtime import get_core_worker
+
+            return get_core_worker().addr
+
+    a = A.remote()
+    addr = ray_tpu.get(a.addr.remote(), timeout=60)
+    wc = RpcClient(tuple(addr))
+    assert wc.call("profile_heap", 5, timeout=30.0)["started"]
+    assert wc.call("profile_heap_stop", timeout=30.0)["stopped"]
+    # Off again: a new call re-arms rather than snapshotting.
+    assert wc.call("profile_heap", 5, timeout=30.0)["started"]
+    assert wc.call("profile_heap_stop", timeout=30.0)["stopped"]
+    wc.close()
