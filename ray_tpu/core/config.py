@@ -76,6 +76,11 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "this many items ahead of the reader's ack, overlapping stage "
         "compute with handoff (reference: buffered shared-memory "
         "channels, shared_memory_channel.py:169)."),
+    "runtime_env_cache_bytes": (int, 2 * 1024**3,
+        "Size budget for materialized runtime envs (/tmp/ray_tpu_envs): "
+        "past it, least-recently-used env dirs not pinned by live workers "
+        "are evicted (reference: the runtime-env agent's URI cache GC, "
+        "runtime_env/plugin.py). 0 disables eviction."),
     "object_broadcast_min_bytes": (int, 8 * 1024 * 1024,
         "Objects at least this big use tree broadcast: the owner caps "
         "concurrent pulls per source and pullers re-register their copy "

@@ -228,6 +228,7 @@ class WorkerHandle:
         self.dedicated = False  # actor workers are never pooled
         self.tpu = False        # forked with accelerator env (see _fork_worker)
         self.env_hash = ""      # runtime-env identity for pool matching
+        self.env_dirs: List[str] = []  # cache dirs pinned against env GC
         self.last_used = time.monotonic()
         # Resources held by the current lease; credited back exactly once
         # (on lease return, worker kill, or death-reap — whichever first).
@@ -540,6 +541,7 @@ class Node:
         python_exe = sys.executable
         env_paths: List[str] = []
         extra_vars: Optional[Dict[str, str]] = None
+        env_dirs: List[str] = []
         if runtime_env:
             # Full env build (working_dir + py_modules + pip venv); any
             # failure raises and becomes the lease error (reference: the
@@ -550,6 +552,7 @@ class Node:
             extra_vars = built["env_vars"]
             workdir = built["cwd"]
             env_paths = [p for p in built["pythonpath"] if p != workdir]
+            env_dirs = built.get("env_dirs", [])
             if built["python"]:
                 python_exe = built["python"]
         env = self._spawn_env(strip_accel=not needs_tpu,
@@ -599,6 +602,14 @@ class Node:
         handle.dedicated = dedicated
         handle.tpu = needs_tpu
         handle.env_hash = _runtime_env_hash(runtime_env)
+        handle.env_dirs = env_dirs
+        if env_dirs:
+            # HOST-global GC pins (ENV_ROOT is shared across same-host
+            # nodes): any node's GC honors this worker's pid.
+            from ray_tpu.runtime_env import pin_env_dir
+
+            for d in env_dirs:
+                pin_env_dir(d, worker_id.hex(), proc.pid)
         with self._lock:
             self._workers[worker_id] = handle
         self._wait_registered(handle)
@@ -853,6 +864,11 @@ class Node:
         self._workers.pop(handle.worker_id, None)
         if handle in self._idle:
             self._idle.remove(handle)
+        if handle.env_dirs:
+            from ray_tpu.runtime_env import unpin_env_dir
+
+            for d in handle.env_dirs:
+                unpin_env_dir(d, handle.worker_id.hex())
         if handle.proc.poll() is not None:
             try:
                 handle.proc.wait(timeout=0)
@@ -932,8 +948,13 @@ class Node:
                 pass
 
     def _reaper_loop(self) -> None:
+        last_env_gc = time.monotonic()
         while not self._stopped.wait(5.0):
             now = time.monotonic()
+            if (config.runtime_env_cache_bytes > 0
+                    and now - last_env_gc > 60.0):
+                last_env_gc = now
+                self._gc_runtime_envs()
             with self._lock:
                 # Dead workers anywhere (incl. dedicated actor workers whose
                 # process crashed): credit their lease and forget them.
@@ -953,6 +974,20 @@ class Node:
                         keep.append(handle)
                 self._idle = keep
                 self._drain_waiters_locked()
+
+    def _gc_runtime_envs(self) -> None:
+        """Evict LRU runtime-env cache dirs past the budget, pinning every
+        dir a live worker was built from (reference: the runtime-env
+        agent's URI refcounting + cache eviction, runtime_env/plugin.py)."""
+        from ray_tpu.runtime_env import gc_envs
+
+        with self._lock:
+            in_use = {d for h in self._workers.values()
+                      for d in h.env_dirs if h.proc.poll() is None}
+        try:
+            gc_envs(config.runtime_env_cache_bytes, in_use)
+        except Exception:
+            pass
 
     def read_shm_object(self, oid_bytes: bytes) -> Optional[bytes]:
         """Serve a whole object from this node's store (or its spill dir) to

@@ -203,6 +203,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(json.dumps(
                     self._worker_call(worker_hex, "profile_heap", 25,
                                       timeout=30.0)).encode())
+            elif path.startswith("/worker/") and path.endswith(
+                    "/heap_stop"):
+                worker_hex = path[len("/worker/"):-len("/heap_stop")]
+                self._send(json.dumps(
+                    self._worker_call(worker_hex, "profile_heap_stop",
+                                      timeout=30.0)).encode())
             elif path == "/workers":
                 self._send(self._render_workers().encode(), "text/html")
             elif path in ("/", "/index.html"):
@@ -307,7 +313,9 @@ class _Handler(BaseHTTPRequestHandler):
                          ("actor" if w["dedicated"] else "busy"),
                 "profile": (f"<a href='/worker/{wid}/flame?duration=3'>"
                             f"flame</a> "
-                            f"<a href='/worker/{wid}/heap'>heap</a>"),
+                            f"<a href='/worker/{wid}/heap'>heap</a> "
+                            f"<a href='/worker/{wid}/heap_stop'>heap "
+                            f"off</a>"),
             })
         return _PAGE % ("<h2>workers</h2>"
                         + _table(rows, ["worker", "node", "pid", "state",

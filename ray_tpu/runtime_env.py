@@ -18,10 +18,23 @@ spec keys:
   cached across leases; the worker forks from the venv's interpreter.
   Build failures surface at lease time as the task's error (reference:
   ``pip.py`` + the agent's CreateRuntimeEnv reply).
+* ``image_uri``: container-image seam (reference:
+  ``runtime_env/image_uri.py``). On hosts without a container runtime the
+  only backing is ``dir://<path>`` — a pre-unpacked image root used as
+  the worker's cwd; ``docker://`` URIs fail the lease with a clear error.
+  Third parties add further isolation backends via
+  :func:`register_plugin` (reference: ``runtime_env/plugin.py``).
 
 Workers are pooled per runtime-env hash (reference: worker_pool.h's
 runtime_env_hash matching), so repeated tasks with the same env reuse
 their workers.
+
+**Cache GC** (reference: the agent's URI reference counting + cache
+eviction in ``runtime_env/plugin.py``): every materialized dir under
+``ENV_ROOT`` is LRU-tracked via its ``.ready`` marker's mtime;
+:func:`gc_envs` evicts past a size budget, skipping dirs pinned by live
+workers. The node supervisor runs it periodically
+(``runtime_env_cache_bytes``).
 """
 
 from __future__ import annotations
@@ -183,10 +196,202 @@ def ensure_pip_env(pip: List[str]) -> str:
     return python
 
 
+# --------------------------------------------------------------- plugins
+
+
+class RuntimeEnvPlugin:
+    """Isolation-backend seam (reference: ``runtime_env/plugin.py``'s
+    RuntimeEnvPlugin + ``image_uri.py``). A plugin owns one spec key:
+    ``validate`` runs at submission time (driver side), ``build`` at
+    lease time on the worker's node, mutating the build output in place
+    (set ``python`` for a different interpreter, ``cwd`` for a rooted
+    filesystem, extend ``pythonpath``/``env_vars``). Build failures
+    become the lease's error."""
+
+    name: str = ""
+
+    def validate(self, value: Any) -> Any:
+        return value
+
+    def build(self, value: Any, controller_client,
+              out: Dict[str, Any]) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class ImageURIPlugin(RuntimeEnvPlugin):
+    """Container-image seam. Backings:
+
+    * ``dir://<path>`` — a pre-unpacked image root (the only backing on
+      hosts without a container runtime, like this box): becomes the
+      worker's cwd, and its ``site-packages`` (if present) joins
+      PYTHONPATH.
+    * anything else (``docker://…``) — fails the lease with a clear
+      error until a container runtime backend is registered.
+    """
+
+    name = "image_uri"
+
+    def validate(self, value: Any) -> str:
+        value = str(value)
+        if "://" not in value:
+            raise ValueError(
+                "runtime_env['image_uri'] must be a URI (dir://<path> on "
+                "container-less hosts, docker://<image> with a container "
+                "runtime)")
+        return value
+
+    def build(self, value: Any, controller_client,
+              out: Dict[str, Any]) -> None:
+        uri = str(value)
+        if uri.startswith("dir://"):
+            root = uri[len("dir://"):]
+            if not os.path.isdir(root):
+                raise RuntimeError(f"image root {root} does not exist")
+            touch_env_dir(root)
+            out["cwd"] = root
+            site = os.path.join(root, "site-packages")
+            if os.path.isdir(site):
+                out["pythonpath"].append(site)
+            out["env_vars"].setdefault("RAY_TPU_IMAGE_URI", uri)
+            return
+        raise RuntimeError(
+            f"no container runtime available for {uri!r} on this host "
+            f"(supported here: dir://<unpacked-image-root>)")
+
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a name (its runtime_env key)")
+    _plugins[plugin.name] = plugin
+
+
+register_plugin(ImageURIPlugin())
+
+
+# ------------------------------------------------------------------- GC
+
+
+def touch_env_dir(path: str) -> None:
+    """Mark an env dir as recently used (LRU clock for gc_envs)."""
+    marker = os.path.join(path, ".ready")
+    try:
+        os.utime(marker if os.path.exists(marker) else path)
+    except OSError:
+        pass
+
+
+def pin_env_dir(path: str, worker_id_hex: str, pid: int) -> None:
+    """Record a live-process pin inside the env dir. Pins are HOST-global
+    (ENV_ROOT is shared by every node on the host, and by every test
+    session): GC honors any pin whose pid is still alive, so one node's
+    eviction can never delete another node's live worker's env."""
+    d = os.path.join(path, ".pins")
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, worker_id_hex), "w") as f:
+            f.write(str(pid))
+    except OSError:
+        pass
+
+
+def unpin_env_dir(path: str, worker_id_hex: str) -> None:
+    try:
+        os.unlink(os.path.join(path, ".pins", worker_id_hex))
+    except OSError:
+        pass
+
+
+def _has_live_pin(path: str) -> bool:
+    pins = os.path.join(path, ".pins")
+    try:
+        names = os.listdir(pins)
+    except OSError:
+        return False
+    for name in names:
+        try:
+            with open(os.path.join(pins, name)) as f:
+                pid = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            continue
+        if pid <= 0:
+            continue
+        try:
+            os.kill(pid, 0)  # alive (or zombie) => pinned
+            return True
+        except OSError:
+            # Dead owner: clear the stale pin.
+            try:
+                os.unlink(os.path.join(pins, name))
+            except OSError:
+                pass
+    return False
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for f in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+            except OSError:
+                pass
+    return total
+
+
+def gc_envs(budget_bytes: int, in_use: Optional[set] = None,
+            root: str = ENV_ROOT, min_age_s: float = 300.0) -> List[str]:
+    """Evict least-recently-used env dirs until the cache fits the
+    budget. Never touched: dirs in ``in_use``, dirs with a live pid pin
+    (``pin_env_dir`` — covers OTHER nodes' workers on this shared host),
+    dirs younger than ``min_age_s`` (closes the build-to-fork window and
+    prevents evict-the-freshest thrash when pinned dirs alone exceed the
+    budget), and half-built dirs (no ``.ready``). Returns the evicted
+    paths (reference: the agent's URI cache eviction,
+    runtime_env/plugin.py — without GC /tmp/ray_tpu_envs grows forever)."""
+    import shutil
+    import time as _time
+
+    in_use = {os.path.abspath(p) for p in (in_use or set())}
+    entries = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    now = _time.time()
+    for name in names:
+        path = os.path.abspath(os.path.join(root, name))
+        marker = os.path.join(path, ".ready")
+        try:
+            if not os.path.isdir(path) or not os.path.exists(marker):
+                continue  # half-built or foreign: leave it alone
+            mtime = os.path.getmtime(marker)
+            size = _dir_bytes(path)
+        except OSError:
+            continue  # vanished mid-scan (concurrent GC): skip
+        entries.append((mtime, path, size))
+    total = sum(size for _m, _p, size in entries)
+    evicted: List[str] = []
+    for mtime, path, size in sorted(entries):  # oldest first
+        if total <= budget_bytes:
+            break
+        if path in in_use or now - mtime < min_age_s:
+            continue
+        if _has_live_pin(path):
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        evicted.append(path)
+        total -= size
+    return evicted
+
+
 def build_env(runtime_env: Dict[str, Any],
               controller_client) -> Dict[str, Any]:
     """Materialize a full runtime env on this node. Returns
-    ``{python, pythonpath, cwd, env_vars}`` for the worker fork; raises on
+    ``{python, pythonpath, cwd, env_vars, env_dirs}`` for the worker fork
+    (``env_dirs`` = cache dirs the worker now pins against GC); raises on
     build failure (the node surfaces it in the lease reply — reference:
     the raylet failing a lease when the agent's CreateRuntimeEnv errors)."""
     out: Dict[str, Any] = {
@@ -195,17 +400,28 @@ def build_env(runtime_env: Dict[str, Any],
         "cwd": None,
         "env_vars": {str(k): str(v) for k, v in
                      (runtime_env.get("env_vars") or {}).items()},
+        "env_dirs": [],
     }
     wd = runtime_env.get("working_dir")
     if wd:
         out["cwd"] = materialize_working_dir(wd, controller_client)
         out["pythonpath"].append(out["cwd"])
+        touch_env_dir(out["cwd"])
+        out["env_dirs"].append(out["cwd"])
     for mod in runtime_env.get("py_modules") or []:
-        out["pythonpath"].append(
-            materialize_py_module(mod, controller_client))
+        entry = materialize_py_module(mod, controller_client)
+        out["pythonpath"].append(entry)
+        touch_env_dir(entry)
+        out["env_dirs"].append(entry)
     pip = runtime_env.get("pip")
     if pip:
         out["python"] = ensure_pip_env(list(pip))
+        venv_dir = os.path.dirname(os.path.dirname(out["python"]))
+        touch_env_dir(venv_dir)
+        out["env_dirs"].append(venv_dir)
+    for key, plugin in _plugins.items():
+        if key in runtime_env:
+            plugin.build(runtime_env[key], controller_client, out)
     return out
 
 
@@ -233,10 +449,12 @@ def normalize(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError("runtime_env['pip'] must be a list of "
                              "requirement strings")
         out["pip"] = list(pip)
-    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules",
-                                  "pip"}
+    for key, plugin in _plugins.items():
+        if key in runtime_env:
+            out[key] = plugin.validate(runtime_env[key])
+    known = {"env_vars", "working_dir", "py_modules", "pip"} | set(_plugins)
+    unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
-                         "(supported: env_vars, working_dir, py_modules, "
-                         "pip)")
+                         f"(supported: {sorted(known)})")
     return out

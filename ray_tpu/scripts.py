@@ -308,11 +308,11 @@ def cmd_profile(args) -> int:
             out = wc.call("profile_heap", 25, timeout=30.0)
             print(_json.dumps(out, indent=2))
             return 0
-        folded = wc.call("profile_cpu", args.duration, 100.0,
-                         timeout=args.duration + 30.0)
     finally:
         wc.close()
-    from ray_tpu.util.profiling import flamegraph_svg
+    from ray_tpu.util.profiling import flamegraph_svg, profile_worker
+
+    folded = profile_worker(target["addr"], args.duration)
 
     svg = flamegraph_svg(
         folded, title=f"worker {target['worker_id'][:8]} "

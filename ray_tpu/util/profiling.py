@@ -101,17 +101,17 @@ def flamegraph_svg(folded: Dict[str, int], width: int = 1100,
         return 1 + max((depth(c) for c in node.children.values()),
                        default=0)
 
+    def esc(s: str) -> str:
+        return (s.replace("&", "&amp;").replace("<", "&lt;")
+                .replace(">", "&gt;").replace("'", "&apos;"))
+
     height = (depth(root) + 2) * row_h
     out = [
         f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' "
         f"height='{height}' font-family='monospace' font-size='11'>",
-        f"<text x='5' y='{row_h - 4}' font-size='13'>{title} "
+        f"<text x='5' y='{row_h - 4}' font-size='13'>{esc(title)} "
         f"({root.value} samples)</text>",
     ]
-
-    def esc(s: str) -> str:
-        return (s.replace("&", "&amp;").replace("<", "&lt;")
-                .replace(">", "&gt;").replace("'", "&apos;"))
 
     def emit(node: _Node, x: float, y: int, w: float) -> None:
         if w < 1.0:

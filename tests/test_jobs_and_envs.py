@@ -361,3 +361,138 @@ def test_dashboard_logs_history_drilldown(ray_start_regular):
     finally:
         server._history.stop()
         server.shutdown()
+
+
+# ------------------------------------------------- env GC + plugin seam
+# (VERDICT r3 #8; reference: runtime_env/plugin.py URI refcounting + GC,
+# image_uri.py container seam)
+
+
+def test_runtime_env_gc_evicts_lru_not_pinned(tmp_path):
+    """gc_envs removes least-recently-used ready dirs past the budget but
+    never touches pinned (live-worker) or half-built dirs."""
+    import time as _t
+
+    from ray_tpu.runtime_env import gc_envs
+
+    root = str(tmp_path / "envs")
+    os.makedirs(root)
+
+    def mk(name, kb, ready=True, age=0):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        with open(os.path.join(d, "blob"), "wb") as f:
+            f.write(b"x" * kb * 1024)
+        if ready:
+            marker = os.path.join(d, ".ready")
+            with open(marker, "w") as f:
+                f.write("ok")
+            mtime = _t.time() - age
+            os.utime(marker, (mtime, mtime))
+        return d
+
+    old = mk("old", 64, age=600)
+    pinned = mk("pinned", 64, age=500)
+    live_pinned = mk("live_pinned", 64, age=400)
+    fresh = mk("fresh", 64, age=0)
+    half = mk("half", 64, ready=False)
+
+    # A live-pid pin (another node's worker on this shared host) guards
+    # live_pinned even though it is old and not in OUR in_use set.
+    from ray_tpu.runtime_env import pin_env_dir
+
+    pin_env_dir(live_pinned, "w" * 8, os.getpid())
+
+    evicted = gc_envs(budget_bytes=140 * 1024, in_use={pinned}, root=root,
+                      min_age_s=120.0)
+    # Only "old" fits the bill: LRU, ready, unpinned, old enough.
+    # "fresh" is over-budget too but younger than min_age (closes the
+    # build-to-fork window and prevents evict-the-freshest thrash).
+    assert evicted == [os.path.abspath(old)]
+    assert not os.path.exists(old)
+    assert os.path.exists(pinned) and os.path.exists(fresh)
+    assert os.path.exists(live_pinned)
+    assert os.path.exists(half)  # half-built: never touched
+
+
+def test_runtime_env_gc_end_to_end(ray_start_regular, tmp_path):
+    """A worker's env dirs stay pinned while it lives; after the env is
+    unused, a tiny budget evicts it and a later lease rebuilds it."""
+    from ray_tpu.core import api as api_mod
+    from ray_tpu.runtime_env import (gc_envs, materialize_working_dir,
+                                     upload_working_dir)
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "marker.txt").write_text("v1")
+    uri = upload_working_dir(str(proj))
+
+    @ray_tpu.remote
+    def read_marker():
+        with open("marker.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_marker.options(
+        runtime_env={"working_dir": uri}).remote(), timeout=120) == "v1"
+    node = api_mod._local_cluster[1]
+    core = ray_tpu.core.runtime.get_core_worker()
+    env_dir = materialize_working_dir(uri, core.controller)
+    assert os.path.exists(os.path.join(env_dir, ".ready"))
+
+    # Live worker pins it HOST-globally (pid pin file): even a zero
+    # budget with an empty in_use set must not evict it.
+    with node._lock:
+        pinned = {d for h in node._workers.values() for d in h.env_dirs}
+    assert env_dir in pinned
+    others = {os.path.join(os.path.dirname(env_dir), n)
+              for n in os.listdir(os.path.dirname(env_dir))} - {env_dir}
+    gc_envs(0, others, min_age_s=0.0)
+    assert os.path.exists(env_dir)
+
+    # Kill the env's workers (removes their pins), then GC: dir goes
+    # away. `others` keeps this test from wiping unrelated cached envs
+    # on the shared host.
+    with node._lock:
+        victims = [h for h in node._workers.values()
+                   if env_dir in h.env_dirs]
+    for h in victims:
+        node.kill_worker(h.worker_id.binary(), True)
+    gc_envs(0, others, min_age_s=0.0)
+    assert not os.path.exists(env_dir)
+
+    # Transparent rebuild on the next lease.
+    assert ray_tpu.get(read_marker.options(
+        runtime_env={"working_dir": uri}).remote(), timeout=120) == "v1"
+
+
+def test_image_uri_plugin_dir_backing(ray_start_regular, tmp_path):
+    """image_uri seam: dir:// roots the worker in the unpacked image (cwd
+    + site-packages on the path); docker:// fails the lease clearly."""
+    image = tmp_path / "img"
+    (image / "site-packages").mkdir(parents=True)
+    (image / "etc").mkdir()
+    (image / "etc" / "tag.txt").write_text("img-v7")
+    (image / "site-packages" / "imgmod.py").write_text(
+        "VALUE = 'from-image'\n")
+
+    @ray_tpu.remote
+    def inspect():
+        import imgmod  # noqa: F401 - from the image's site-packages
+
+        with open("etc/tag.txt") as f:
+            return imgmod.VALUE, f.read(), os.environ.get(
+                "RAY_TPU_IMAGE_URI")
+
+    uri = f"dir://{image}"
+    value, tag, env_uri = ray_tpu.get(inspect.options(
+        runtime_env={"image_uri": uri}).remote(), timeout=120)
+    assert value == "from-image" and tag == "img-v7" and env_uri == uri
+
+    @ray_tpu.remote
+    def nope():
+        return 1
+
+    with pytest.raises(Exception, match="container runtime"):
+        ray_tpu.get(nope.options(
+            runtime_env={"image_uri": "docker://python:3.12"}).remote(),
+            timeout=60)
