@@ -42,6 +42,9 @@ class ViTConfig:
     dropout: float = 0.0          # kept for API parity; eval path ignores
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # None = full remat; "dots" keeps matmul outputs (recompute only the
+    # cheap elementwise work — more memory, fewer recomputed FLOPs).
+    remat_policy: Any = None
 
     @property
     def num_patches(self) -> int:
@@ -182,7 +185,14 @@ def forward(params: Dict[str, Any], images: jax.Array,
                  for k, v in layer.items()}
         return _block(carry, layer, c), None
 
-    scan_body = jax.checkpoint(body) if c.remat else body
+    if c.remat and c.remat_policy == "dots":
+        scan_body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif c.remat:
+        scan_body = jax.checkpoint(body)
+    else:
+        scan_body = body
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = _layer_norm(x, params["final_ln_scale"].astype(c.dtype),
                     params["final_ln_bias"].astype(c.dtype))
@@ -203,6 +213,17 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     loss = jnp.mean(logz - gold)
     acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
     return loss, {"accuracy": acc}
+
+
+def flops_per_image(config: ViTConfig) -> float:
+    """Training FLOPs per image, same convention as
+    ``llama.flops_per_token`` (fwd+bwd ~= 6*N per token plus the
+    attention quadratic term); tokens = patches + CLS."""
+    c = config
+    tokens = c.num_patches + 1
+    param_flops = 6.0 * num_params(c) * tokens
+    attn_flops = 12.0 * c.n_layers * c.dim * tokens * tokens
+    return param_flops + attn_flops
 
 
 def num_params(config: ViTConfig) -> int:
