@@ -143,7 +143,9 @@ def run_one(cfg, batch: int, seq: int, steps: int, accum: int = 1):
 def run_vit(steps: int = 4, batch: int = 256):
     """Second model family (VERDICT r3 #10): ViT-B/16 train-step MFU with
     the same timing discipline (jitted donated scan + host fetch,
-    best-of-3). Returns (mfu_pct, img_per_sec, step_time_s)."""
+    best-of-3). SINGLE-CHIP measurement (unsharded jit runs on the
+    default device, so peak counts one chip — unlike the sharded llama
+    path). Returns (mfu_pct, img_per_sec, step_time_s, batch)."""
     import optax
 
     from ray_tpu.models import vit
@@ -154,7 +156,7 @@ def run_vit(steps: int = 4, batch: int = 256):
     opt = optax.adamw(3e-4, weight_decay=0.1)
     opt_state = opt.init(params)
     peak = peak_flops_per_chip(
-        getattr(jax.devices()[0], "device_kind", "")) * len(jax.devices())
+        getattr(jax.devices()[0], "device_kind", ""))
     fpi = vit.flops_per_image(cfg)
 
     def body(carry, batch_d):
@@ -186,7 +188,7 @@ def run_vit(steps: int = 4, batch: int = 256):
         dt = (time.perf_counter() - t0) / steps
         best = dt if best is None else min(best, dt)
     mfu = 100.0 * batch * fpi / best / peak
-    return round(mfu, 2), round(batch / best), round(best, 4)
+    return round(mfu, 2), round(batch / best), round(best, 4), batch
 
 
 def main() -> None:
@@ -230,12 +232,13 @@ def main() -> None:
     vit_row = {}
     if os.environ.get("RAY_TPU_BENCH_VIT", "1") != "0":
         try:
-            vmfu, img_s, vdt = run_vit()
+            vmfu, img_s, vdt, vbatch = run_vit()
             vit_row = {"vit_b16_mfu": vmfu, "vit_b16_img_per_sec": img_s,
                        "vit_b16_step_time_s": vdt,
-                       "vit_b16_batch": 256}
-        except Exception:
-            vit_row = {"vit_b16_mfu": None}
+                       "vit_b16_batch": vbatch}
+        except Exception as e:  # noqa: BLE001 — never risk the headline
+            vit_row = {"vit_b16_mfu": None,
+                       "vit_b16_error": str(e)[:300]}
 
     print(json.dumps({
         "metric": f"llama_{name}_train_mfu_{n}x_{kind.replace(' ', '_')}",

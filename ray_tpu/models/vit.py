@@ -218,10 +218,16 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
 def flops_per_image(config: ViTConfig) -> float:
     """Training FLOPs per image, same convention as
     ``llama.flops_per_token`` (fwd+bwd ~= 6*N per token plus the
-    attention quadratic term); tokens = patches + CLS."""
+    attention quadratic term). This model is mean-pool (NO CLS token), so
+    tokens = num_patches; the classifier head runs ONCE per image on the
+    pooled vector, and positional embeddings do no matmul work — neither
+    may be counted per-token."""
     c = config
-    tokens = c.num_patches + 1
-    param_flops = 6.0 * num_params(c) * tokens
+    tokens = c.num_patches
+    head_params = c.dim * c.num_classes
+    pos_params = c.num_patches * c.dim
+    per_token_params = num_params(c) - head_params - pos_params
+    param_flops = 6.0 * per_token_params * tokens + 6.0 * head_params
     attn_flops = 12.0 * c.n_layers * c.dim * tokens * tokens
     return param_flops + attn_flops
 

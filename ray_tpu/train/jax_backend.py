@@ -91,7 +91,11 @@ def _filter_native_output(drop_prefixes: tuple = ("[Gloo]",)) -> None:
     if _fd_filters_on:
         return
     _fd_filters_on = True
+    import atexit
     import threading
+
+    prefixes = tuple(p.encode() for p in drop_prefixes)
+    restores = []
 
     for fd in (1, 2):
         real = os.dup(fd)
@@ -101,6 +105,10 @@ def _filter_native_output(drop_prefixes: tuple = ("[Gloo]",)) -> None:
 
         def pump(r=r, real=real) -> None:
             buf = b""
+
+            def keep(data: bytes) -> bool:
+                return not data.lstrip().startswith(prefixes)
+
             while True:
                 try:
                     chunk = os.read(r, 65536)
@@ -111,20 +119,50 @@ def _filter_native_output(drop_prefixes: tuple = ("[Gloo]",)) -> None:
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
-                    if not any(line.lstrip().startswith(p.encode())
-                               for p in drop_prefixes):
+                    if keep(line):
                         try:
                             os.write(real, line + b"\n")
                         except OSError:
                             return
-            if buf:
+                # Partial-line passthrough: \r progress bars and
+                # unterminated prompts must stay visible (and the buffer
+                # bounded) — forward anything that already can't match a
+                # drop prefix.
+                if buf and (buf.endswith(b"\r") or len(buf) > 8192
+                            or (buf.lstrip()
+                                and not any(p.startswith(buf.lstrip()[:len(p)])
+                                            or buf.lstrip().startswith(p)
+                                            for p in prefixes))):
+                    if keep(buf):
+                        try:
+                            os.write(real, buf)
+                        except OSError:
+                            return
+                    buf = b""
+            if buf and keep(buf):
                 try:
                     os.write(real, buf)
                 except OSError:
                     pass
 
-        threading.Thread(target=pump, name=f"fd{fd}-filter",
-                         daemon=True).start()
+        t = threading.Thread(target=pump, name=f"fd{fd}-filter",
+                             daemon=True)
+        t.start()
+        restores.append((fd, real, t))
+
+    def _unfilter() -> None:
+        # Point the fds back at the real streams; the pipe write ends'
+        # refcount drops to zero, the pumps see EOF, flush their tails,
+        # and exit — final output is never lost to a killed daemon.
+        for fd, real, t in restores:
+            try:
+                os.dup2(real, fd)
+            except OSError:
+                pass
+        for _fd, _real, t in restores:
+            t.join(timeout=2.0)
+
+    atexit.register(_unfilter)
 
 
 def init_process(
