@@ -638,7 +638,7 @@ class Controller:
                 if "error" in lease:
                     if picked_node_id is not None:
                         excluded.append(picked_node_id)
-                    if time.monotonic() > deadline:
+                    if lease.get("permanent") or time.monotonic() > deadline:
                         raise RuntimeError(
                             f"actor worker lease failed: {lease['error']}")
                     # PG-bundle leases skip pick_node, so back off here too —

@@ -423,7 +423,13 @@ class Node:
                                                    env_hash)
         except Exception as e:
             self._credit(resources, bundle)
-            return {"error": f"worker start failed: {e!r}"}
+            from ray_tpu.runtime_env import RuntimeEnvBuildError
+
+            # Permanent = the same spec fails identically on every node
+            # (bad pip requirement, missing image root): callers abort
+            # instead of retrying until their lease deadline.
+            return {"error": f"worker start failed: {e!r}",
+                    "permanent": isinstance(e, RuntimeEnvBuildError)}
         with self._lock:
             handle.lease_resources = dict(resources)
             handle.lease_bundle = bundle

@@ -1277,7 +1277,7 @@ class TaskSubmitter:
                 if "error" in lease:
                     if picked_node_id is not None:
                         excluded.append(picked_node_id)
-                    if time.monotonic() > deadline:
+                    if lease.get("permanent") or time.monotonic() > deadline:
                         raise RayTpuError(f"worker lease failed: {lease['error']}")
                     # PG-bundle leases don't go through the pick_node backoff
                     # above; sleep here so a busy node isn't RPC-hammered.

@@ -49,6 +49,12 @@ from typing import Any, Dict, List, Optional
 
 ENV_ROOT = "/tmp/ray_tpu_envs"
 
+
+class RuntimeEnvBuildError(RuntimeError):
+    """Deterministic env-build failure (bad pip requirement, missing
+    image root, …): leases fail FAST instead of retrying until the lease
+    deadline — the same spec will fail the same way on every node."""
+
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
@@ -107,7 +113,8 @@ def materialize_working_dir(spec: str, controller_client) -> str:
     if not os.path.exists(marker):
         blob = controller_client.call("kv_get", key)
         if blob is None:
-            raise RuntimeError(f"working_dir package {key} not in KV")
+            raise RuntimeEnvBuildError(
+                f"working_dir package {key} not in KV")
         os.makedirs(dest, exist_ok=True)
         with zipfile.ZipFile(io.BytesIO(blob)) as zf:
             zf.extractall(dest)
@@ -179,7 +186,10 @@ def ensure_pip_env(pip: List[str]) -> str:
                  "install", "--no-input", *pip],
                 capture_output=True, text=True, timeout=600)
             if proc.returncode != 0:
-                raise RuntimeError(
+                # Deterministic for the spec in the common case (bad
+                # requirement); genuinely-transient index trouble is rare
+                # on TPU pods and recoverable at the task-retry layer.
+                raise RuntimeEnvBuildError(
                     f"pip install {pip} failed: "
                     f"{(proc.stderr or proc.stdout)[-800:]}")
             with open(os.path.join(build, ".ready"), "w") as f:
@@ -246,7 +256,8 @@ class ImageURIPlugin(RuntimeEnvPlugin):
         if uri.startswith("dir://"):
             root = uri[len("dir://"):]
             if not os.path.isdir(root):
-                raise RuntimeError(f"image root {root} does not exist")
+                raise RuntimeEnvBuildError(
+                    f"image root {root} does not exist")
             touch_env_dir(root)
             out["cwd"] = root
             site = os.path.join(root, "site-packages")
@@ -254,7 +265,7 @@ class ImageURIPlugin(RuntimeEnvPlugin):
                 out["pythonpath"].append(site)
             out["env_vars"].setdefault("RAY_TPU_IMAGE_URI", uri)
             return
-        raise RuntimeError(
+        raise RuntimeEnvBuildError(
             f"no container runtime available for {uri!r} on this host "
             f"(supported here: dir://<unpacked-image-root>)")
 
@@ -402,26 +413,35 @@ def build_env(runtime_env: Dict[str, Any],
                      (runtime_env.get("env_vars") or {}).items()},
         "env_dirs": [],
     }
-    wd = runtime_env.get("working_dir")
-    if wd:
-        out["cwd"] = materialize_working_dir(wd, controller_client)
-        out["pythonpath"].append(out["cwd"])
-        touch_env_dir(out["cwd"])
-        out["env_dirs"].append(out["cwd"])
-    for mod in runtime_env.get("py_modules") or []:
-        entry = materialize_py_module(mod, controller_client)
-        out["pythonpath"].append(entry)
-        touch_env_dir(entry)
-        out["env_dirs"].append(entry)
-    pip = runtime_env.get("pip")
-    if pip:
-        out["python"] = ensure_pip_env(list(pip))
-        venv_dir = os.path.dirname(os.path.dirname(out["python"]))
-        touch_env_dir(venv_dir)
-        out["env_dirs"].append(venv_dir)
-    for key, plugin in _plugins.items():
-        if key in runtime_env:
-            plugin.build(runtime_env[key], controller_client, out)
+    try:
+        wd = runtime_env.get("working_dir")
+        if wd:
+            out["cwd"] = materialize_working_dir(wd, controller_client)
+            out["pythonpath"].append(out["cwd"])
+            touch_env_dir(out["cwd"])
+            out["env_dirs"].append(out["cwd"])
+        for mod in runtime_env.get("py_modules") or []:
+            entry = materialize_py_module(mod, controller_client)
+            out["pythonpath"].append(entry)
+            touch_env_dir(entry)
+            out["env_dirs"].append(entry)
+        pip = runtime_env.get("pip")
+        if pip:
+            out["python"] = ensure_pip_env(list(pip))
+            venv_dir = os.path.dirname(os.path.dirname(out["python"]))
+            touch_env_dir(venv_dir)
+            out["env_dirs"].append(venv_dir)
+        for key, plugin in _plugins.items():
+            if key in runtime_env:
+                plugin.build(runtime_env[key], controller_client, out)
+    except ValueError as e:
+        # Spec validation problems are deterministic on every node.
+        raise RuntimeEnvBuildError(str(e)) from e
+    # Everything else: RuntimeEnvBuildError only where the RAISE SITE
+    # knows the failure is deterministic (bad pip requirement, package
+    # missing from the KV, missing image root). Node-local trouble (full
+    # disk, transport blips) stays generic so the lease loop can exclude
+    # the node and re-pick instead of aborting the submission.
     return out
 
 

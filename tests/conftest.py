@@ -27,6 +27,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's XLA programs are identical
+# across runs (static shapes, fixed configs), so repeat invocations skip
+# most compiles. Workers inherit the env var. Safe to share: the cache is
+# keyed by program hash.
+_cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                   "/tmp/ray_tpu_test_jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 

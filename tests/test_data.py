@@ -459,14 +459,14 @@ def test_shuffle_iterate_larger_than_store_bounded_memory(ray_start_cluster):
     from ray_tpu.core.config import config
 
     old = config.object_store_memory_bytes
-    config.object_store_memory_bytes = 96 * 1024 * 1024
+    config.object_store_memory_bytes = 48 * 1024 * 1024
     try:
         cluster = ray_start_cluster
         cluster.add_node(num_cpus=2)
         ray_tpu.init(address=cluster.address)
         from ray_tpu import data as rdata
 
-        n_blocks, rows_per = 60, 500_000  # 4 MB/block, 240 MB total
+        n_blocks, rows_per = 30, 500_000  # 4 MB/block, 120 MB total
         ds = rdata.from_numpy(
             {"x": np.arange(n_blocks * rows_per, dtype=np.int64)},
             num_blocks=n_blocks)
@@ -489,8 +489,8 @@ def test_shuffle_iterate_larger_than_store_bounded_memory(ray_start_cluster):
         assert count == n
         assert total == n * (n - 1) // 2  # every row exactly once
         # Bounded: driver never held anything near the full dataset
-        # (240 MB); generous cap for allocator slack under load.
-        assert peak_extra < 160 * 1024 * 1024, f"RSS grew {peak_extra >> 20} MiB"
+        # (120 MB); generous cap for allocator slack under load.
+        assert peak_extra < 90 * 1024 * 1024, f"RSS grew {peak_extra >> 20} MiB"
     finally:
         config.object_store_memory_bytes = old
 
