@@ -229,6 +229,15 @@ class WorkerHandle:
         self.tpu = False        # forked with accelerator env (see _fork_worker)
         self.env_hash = ""      # runtime-env identity for pool matching
         self.env_dirs: List[str] = []  # cache dirs pinned against env GC
+        self.tasks_received = 0        # worker-reported (worker_ping)
+        self.last_ping_ts = 0.0        # when that report arrived
+        self.lease_ts = 0.0            # when the current lease was granted
+        self.tasks_at_grant = 0        # counter snapshot at grant time
+        # Lease generation: bumped on every grant AND reclamation, echoed
+        # in return_worker so a duplicated or stale return (lost reply
+        # retry, post-reclaim stragglers) can never credit someone else's
+        # lease or double-pool the worker.
+        self.lease_seq = 0
         self.last_used = time.monotonic()
         # Resources held by the current lease; credited back exactly once
         # (on lease return, worker kill, or death-reap — whichever first).
@@ -435,7 +444,12 @@ class Node:
             handle.lease_bundle = bundle
             handle.task_meta = dict(task_meta) if task_meta else None
             handle.last_used = time.monotonic()
-        return {"worker_id": handle.worker_id.binary(), "addr": handle.addr}
+            handle.lease_ts = time.monotonic()
+            handle.tasks_at_grant = handle.tasks_received
+            handle.lease_seq += 1
+            lease_seq = handle.lease_seq
+        return {"worker_id": handle.worker_id.binary(), "addr": handle.addr,
+                "lease_seq": lease_seq}
 
     def _credit(self, resources: Dict[str, float], bundle) -> None:
         with self._lock:
@@ -476,17 +490,24 @@ class Node:
     def return_worker(self, worker_id_bytes: bytes,
                       resources: Dict[str, float],
                       bundle: Optional[BundleKey] = None,
-                      dead: bool = False) -> None:
+                      dead: bool = False,
+                      lease_seq: Optional[int] = None) -> None:
         worker_id = WorkerID(worker_id_bytes)
         bundle = tuple(bundle) if bundle is not None else None
         with self._lock:
             handle = self._workers.get(worker_id)
             if handle is not None:
+                if lease_seq is not None and lease_seq != handle.lease_seq:
+                    # Stale or duplicated return (retried over a lossy
+                    # link, or the lease was already reclaimed/re-granted):
+                    # acting on it would credit the CURRENT holder's lease
+                    # or double-pool the worker.
+                    return
                 self._credit_lease_locked(handle)
                 handle.task_meta = None
                 if dead or handle.proc.poll() is not None:
                     self._remove_worker_locked(handle)
-                elif not handle.dedicated:
+                elif not handle.dedicated and not handle.idle:
                     handle.idle = True
                     handle.last_used = time.monotonic()
                     self._idle.append(handle)
@@ -812,14 +833,24 @@ class Node:
                          daemon=True).start()
         return count
 
-    def worker_ping(self, worker_id_bytes: bytes) -> Dict[str, bool]:
+    def worker_ping(self, worker_id_bytes: bytes,
+                    tasks_received: int = -1) -> Dict[str, bool]:
         """Liveness ping that also answers "does this node still know me?".
         A worker whose handle is gone from the table (lost forkserver pid
         reply, reaper false positive, any future leak path) self-terminates
-        instead of orphaning — the table is the single source of truth."""
+        instead of orphaning — the table is the single source of truth.
+
+        ``tasks_received`` lets the reaper detect GRANTED-BUT-UNDELIVERED
+        leases: when a lease reply is lost on the network, the caller never
+        learns its worker id, so no task ever arrives — without
+        reclamation the worker would sit leased until the idle reaper
+        (minutes) while the node's resources stay exhausted."""
         with self._lock:
-            known = WorkerID(worker_id_bytes) in self._workers
-        return {"known": known}
+            handle = self._workers.get(WorkerID(worker_id_bytes))
+            if handle is not None and tasks_received >= 0:
+                handle.tasks_received = tasks_received
+                handle.last_ping_ts = time.monotonic()
+        return {"known": handle is not None}
 
     def register_worker(self, worker_id_bytes: bytes, addr: Addr) -> Dict[str, Any]:
         worker_id = WorkerID(worker_id_bytes)
@@ -961,6 +992,7 @@ class Node:
                     and now - last_env_gc > 60.0):
                 last_env_gc = now
                 self._gc_runtime_envs()
+            self._reclaim_undelivered_leases(now)
             with self._lock:
                 # Dead workers anywhere (incl. dedicated actor workers whose
                 # process crashed): credit their lease and forget them.
@@ -980,6 +1012,45 @@ class Node:
                         keep.append(handle)
                 self._idle = keep
                 self._drain_waiters_locked()
+
+    def _reclaim_undelivered_leases(self, now: float) -> None:
+        """Reclaim leases whose grant reply was lost (lossy network): the
+        caller never learned its worker id, so no task ever arrived. The
+        worker self-reports its work counter via worker_ping; a leased
+        worker whose counter never moved past the grant snapshot for
+        ``lease_undelivered_timeout_s`` gets its lease credited back —
+        pooled workers rejoin the pool, dedicated (actor) forks die (their
+        creation was retried elsewhere)."""
+        timeout_s = config.lease_undelivered_timeout_s
+        if timeout_s <= 0:
+            return
+        victims: List[WorkerHandle] = []
+        with self._lock:
+            for handle in list(self._workers.values()):
+                if (handle.lease_resources is not None
+                        and handle.lease_ts
+                        and now - handle.lease_ts > timeout_s
+                        and handle.tasks_received == handle.tasks_at_grant
+                        # The zero-counter report must POSTDATE the grant:
+                        # when pings themselves are starving (overloaded
+                        # node) we cannot distinguish lost-grant from
+                        # busy-with-stale-report — do nothing.
+                        and handle.last_ping_ts > handle.lease_ts + 2.0
+                        and handle.proc.poll() is None):
+                    self._credit_lease_locked(handle)
+                    handle.lease_ts = 0.0
+                    handle.lease_seq += 1  # invalidate straggler returns
+                    if handle.dedicated:
+                        self._remove_worker_locked(handle)
+                        victims.append(handle)
+                    else:
+                        handle.idle = True
+                        handle.last_used = now
+                        self._idle.append(handle)
+            if victims or self._waiters:
+                self._drain_waiters_locked()
+        for handle in victims:
+            _kill_and_reap(handle.proc, force=True)
 
     def _gc_runtime_envs(self) -> None:
         """Evict LRU runtime-env cache dirs past the budget, pinning every

@@ -96,12 +96,14 @@ def loads_frame(frame) -> Any:
 
 def send_frame(sock: socket.socket, payload) -> None:
     if isinstance(payload, (bytes, bytearray)):
+        _chaos_gate(sock, len(payload))
         sock.sendall(_LEN.pack(len(payload)) + payload)
         return
     # Scatter path: length header, then parts in order. Small parts
     # coalesce into one syscall; big buffers go straight from their
     # backing memory (an mmap'd store chunk never lands in a pickle copy).
     total = sum(memoryview(p).nbytes for p in payload)
+    _chaos_gate(sock, total)
     head = bytearray(_LEN.pack(total))
     for p in payload:
         if memoryview(p).nbytes < 65536 and len(head) < (1 << 20):
@@ -131,6 +133,49 @@ def recv_frame(sock: socket.socket) -> memoryview:
     header = recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     return recv_exact(sock, length)
+
+
+# Network-chaos injection seam (reference: tc-based latency/bandwidth
+# chaos, tests/chaos/chaos_network_delay.yaml + chaos_network_bandwidth
+# .yaml — here in-process so the multi-node-in-one-machine fixture can
+# exercise slow/lossy links without root/tc). Applied on the CLIENT send
+# path of the process that called set_network_chaos (per-process, like tc
+# on one host's egress).
+_chaos = {"delay_s": 0.0, "jitter_s": 0.0, "drop_prob": 0.0, "rng": None,
+          "bandwidth_bps": 0.0}
+
+
+def set_network_chaos(delay_ms: float = 0.0, jitter_ms: float = 0.0,
+                      drop_prob: float = 0.0,
+                      bandwidth_mbps: float = 0.0, seed: int = 0) -> None:
+    """Inject latency/jitter/loss/bandwidth limits into every outbound RPC
+    of THIS process. ``drop_prob`` drops the send by severing the
+    connection (the peer sees a reset — exercising the same reconnect
+    paths a flaky network does). Zero everything to disable."""
+    import random as _random
+
+    _chaos.update(delay_s=delay_ms / 1e3, jitter_s=jitter_ms / 1e3,
+                  drop_prob=drop_prob,
+                  bandwidth_bps=bandwidth_mbps * 125_000.0,
+                  rng=_random.Random(seed))
+
+
+def _chaos_gate(sock: socket.socket, nbytes: int) -> None:
+    if _chaos["rng"] is None:
+        return
+    if _chaos["drop_prob"] and _chaos["rng"].random() < _chaos["drop_prob"]:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise OSError("chaos: connection dropped")
+    delay = _chaos["delay_s"]
+    if _chaos["jitter_s"]:
+        delay += _chaos["rng"].uniform(0.0, _chaos["jitter_s"])
+    if _chaos["bandwidth_bps"]:
+        delay += nbytes / _chaos["bandwidth_bps"]
+    if delay > 0:
+        time.sleep(delay)
 
 
 class RpcError(Exception):

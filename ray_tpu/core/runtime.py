@@ -137,6 +137,10 @@ class CoreWorker:
         self._actor_runtime: Optional[ActorExecutionRuntime] = None
         self._current_task_desc = threading.local()
         self._shutdown = threading.Event()
+        # Work-received counter, reported in worker_ping so the node can
+        # reclaim leases whose grant REPLY was lost (the worker would
+        # otherwise sit leased-but-idle until the idle reaper).
+        self.tasks_received = 0
 
         # Owner-kept task lineage for object reconstruction: return oid ->
         # shared record of the producing task (reference: task_manager.h:215
@@ -954,6 +958,7 @@ class CoreWorker:
         Reference: the PushTask execution path in ``_raylet.pyx:2259``
         (task_execution_handler) minus the Cython; results return in-band to
         the owner (reference inlines <100KB returns the same way)."""
+        self.tasks_received += 1
         try:
             fn = self._load_function(spec["func_key"], spec.get("func_blob"))
             args, kwargs = self._resolve_args(spec["args_blob"])
@@ -1134,6 +1139,7 @@ class CoreWorker:
     # -------------------------------------------------------- actor side
 
     def _handle_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        self.tasks_received += 1
         try:
             cls = self._load_function(spec["cls_key"], spec.get("cls_blob"))
             args, kwargs = self._resolve_args(spec["args_blob"])
@@ -1197,6 +1203,24 @@ class TaskSubmitter:
         for oid in return_ids:
             self._core.store.put_error(oid, err)
 
+    def _return_worker_safely(self, node_addr, worker_id, resources,
+                              bundle, dead: bool,
+                              lease_seq: Optional[int] = None) -> None:
+        """Return a lease without letting a transport blip become the
+        TASK's error: one fresh-socket retry, then rely on the node's
+        reaper to re-credit when the worker idles out or dies. The
+        lease_seq makes the retry idempotent — a first attempt that was
+        APPLIED but whose reply was lost cannot double-credit/double-pool
+        (the node's generation check no-ops the duplicate)."""
+        for attempt in range(2):
+            try:
+                self._core.clients.get(tuple(node_addr)).call(
+                    "return_worker", worker_id, resources, bundle, dead,
+                    lease_seq, timeout=10.0)
+                return
+            except (RpcError, RemoteCallError, TimeoutError):
+                self._core.clients.invalidate(tuple(node_addr))
+
     def _run(self, spec, options, return_ids, arg_refs,
              held_refs=None) -> None:
         core = self._core
@@ -1214,22 +1238,34 @@ class TaskSubmitter:
             lease_attempts = 0
             deadline = time.monotonic() + config.worker_lease_timeout_s
             while True:
-                # 2. Cluster-level node selection.
+                # 2. Cluster-level node selection. Transport errors to the
+                #    controller (lossy network, head blip) are retried
+                #    within the lease deadline like any other transient —
+                #    the ReconnectingClient reopens the socket underneath.
                 placement = options.get("placement")  # (pg_id_bytes, index)
                 picked_node_id: Optional[bytes] = None
+                try:
+                    if placement is not None:
+                        target = core.controller.call(
+                            "get_placement_group", placement[0])
+                    else:
+                        pick = core.controller.call(
+                            "pick_node",
+                            options.get("resources", {"CPU": 1.0}),
+                            options.get("scheduling_strategy"),
+                            core.node_id.binary(), excluded)
+                except (RpcError, TimeoutError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+                    continue
                 if placement is not None:
-                    target = core.controller.call(
-                        "get_placement_group", placement[0])
                     if target is None or placement[1] not in target["placement"]:
                         raise RayTpuError(
                             f"placement group bundle {placement} not ready")
                     node_addr = target["placement"][placement[1]][1]
                     bundle = (placement[0], placement[1])
                 else:
-                    pick = core.controller.call(
-                        "pick_node", options.get("resources", {"CPU": 1.0}),
-                        options.get("scheduling_strategy"),
-                        core.node_id.binary(), excluded)
                     if pick is None:
                         if time.monotonic() > deadline:
                             raise RayTpuError(
@@ -1270,7 +1306,11 @@ class TaskSubmitter:
                         # attempts settle into the queue so a saturated or
                         # single-node cluster still makes progress.
                         early_attempt,
-                        timeout=config.worker_lease_timeout_s + 10.0)
+                        # Track the attempt's patience, not the global
+                        # lease deadline: a LOST REPLY on a 5s-patience
+                        # spillback probe must not block 40s (one lost
+                        # packet would eat the whole lease budget).
+                        timeout=patience + 10.0)
                 except (RpcError, RemoteCallError, TimeoutError) as e:
                     core.clients.invalidate(tuple(node_addr))
                     lease = {"error": f"node unreachable: {e}"}
@@ -1284,6 +1324,7 @@ class TaskSubmitter:
                     time.sleep(0.2)
                     continue
                 worker_id, worker_addr = lease["worker_id"], lease["addr"]
+                lease_seq = lease.get("lease_seq")
                 t_lease = time.time()
                 worker_hex = WorkerID(worker_id).hex()
                 # 4. Direct push to the leased worker.
@@ -1291,9 +1332,10 @@ class TaskSubmitter:
                     reply = core.clients.get(worker_addr).call(
                         "push_task", spec, timeout=None)
                 except (RpcError, RemoteCallError, TimeoutError) as e:
-                    node_client.call("return_worker", worker_id,
-                                     options.get("resources", {"CPU": 1.0}),
-                                     bundle, True)
+                    self._return_worker_safely(
+                        node_addr, worker_id,
+                        options.get("resources", {"CPU": 1.0}), bundle,
+                        True, lease_seq)
                     core.clients.invalidate(worker_addr)
                     if retries_left > 0 and options.get("retry_on_crash", True):
                         retries_left -= 1
@@ -1314,9 +1356,14 @@ class TaskSubmitter:
                             f"memory monitor: {cause}") from e
                     raise WorkerCrashedError(
                         f"worker died executing {spec['desc']}: {e}") from e
-                node_client.call("return_worker", worker_id,
-                                 options.get("resources", {"CPU": 1.0}),
-                                 bundle, False)
+                # Best-effort with one fresh-socket retry: the task already
+                # SUCCEEDED — a lossy link must not convert a lost lease
+                # return into a task failure (the node's reaper re-credits
+                # the lease when the worker idles out or dies).
+                self._return_worker_safely(
+                    node_addr, worker_id,
+                    options.get("resources", {"CPU": 1.0}), bundle, False,
+                    lease_seq)
                 t_run = time.time()
                 break
             # 5. Fulfil owned return objects.

@@ -50,7 +50,7 @@ def run(node_addr, controller_addr, node_id_hex: str,
         time.sleep(2.0)
         try:
             reply = node_client.call("worker_ping", core.worker_id.binary(),
-                                     timeout=10.0)
+                                     core.tasks_received, timeout=10.0)
             if not reply.get("known", True):
                 break
             misses = 0

@@ -11,9 +11,12 @@ from ray_tpu.data.read_api import (  # noqa: F401
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )

@@ -257,6 +257,13 @@ class _Filter(_Op):
         return {k: v[keep] for k, v in block.items()}
 
 
+def _stage_desc(ops: List[_Op]) -> str:
+    """Stable per-stage task name: execution stats aggregate task events
+    by this desc (reference: per-operator stats in _internal/stats.py)."""
+    names = "+".join(type(op).__name__.lstrip("_") for op in ops) or "Read"
+    return f"data::{names}"
+
+
 def _fuse_ops(ops: List[_Op]) -> Callable[[Block], Block]:
     """Operator fusion: one task applies the whole chain to a block
     (the reference's physical-plan fusion rule — MapOperator chaining)."""
@@ -266,15 +273,20 @@ def _fuse_ops(ops: List[_Op]) -> Callable[[Block], Block]:
             block = op.apply_block(block)
         return block
 
+    fused.__qualname__ = _stage_desc(ops)
     return fused
 
 
 class Dataset:
     """Lazy dataset: input block refs + a chain of operators."""
 
-    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None):
+    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None,
+                 exec_log: Optional[List[str]] = None):
         self._block_refs = list(block_refs)
         self._ops = list(ops or [])
+        # Stage descs this dataset's lineage has EXECUTED (stats() joins
+        # them against the cluster's task events for per-op wall times).
+        self._exec_log: List[str] = list(exec_log or [])
 
     # ---------------------------------------------------- transformations
 
@@ -316,7 +328,8 @@ class Dataset:
             else:
                 fn = wrap_batch_fn(fn, batch_format)
         return Dataset(self._block_refs, self._ops + [_MapBatches(
-            fn, compute, concurrency, fn_constructor_args)])
+            fn, compute, concurrency, fn_constructor_args)],
+                       exec_log=self._exec_log)
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
         def batch_fn(block: Block) -> Block:
@@ -329,7 +342,8 @@ class Dataset:
         return self.map_batches(batch_fn)
 
     def filter(self, pred: Callable[[Dict[str, Any]], bool]) -> "Dataset":
-        return Dataset(self._block_refs, self._ops + [_Filter(pred)])
+        return Dataset(self._block_refs, self._ops + [_Filter(pred)],
+                       exec_log=self._exec_log)
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Task-based repartition exchange: map tasks slice each block by
@@ -359,7 +373,7 @@ class Dataset:
             _concat_parts.remote(*[parts[b][p]
                                    for b in range(len(parts))])
             for p in live]
-        return Dataset(out_refs)
+        return Dataset(out_refs, exec_log=self._exec_log)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Distributed all-to-all shuffle: map tasks scatter each block's
@@ -386,7 +400,7 @@ class Dataset:
                                     *[parts[b][p]
                                       for b in range(len(parts))])
             for p in range(num_parts)]
-        return Dataset(out_refs)
+        return Dataset(out_refs, exec_log=self._exec_log)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Distributed sort via a range-partition exchange: sample keys,
@@ -400,7 +414,7 @@ class Dataset:
             if not mat._block_refs:
                 return mat
             out = _sorted_combine.remote(key, descending, mat._block_refs[0])
-            return Dataset([out])
+            return Dataset([out], exec_log=self._exec_log)
         samples = np.concatenate(ray_tpu.get(
             [_sample_keys.remote(r, key) for r in mat._block_refs]))
         if len(samples) == 0:
@@ -423,7 +437,7 @@ class Dataset:
             _sorted_combine.remote(key, descending,
                                    *[parts[b][p] for b in range(len(parts))])
             for p in order]
-        return Dataset(out_refs)
+        return Dataset(out_refs, exec_log=self._exec_log)
 
     def groupby(self, key: str) -> "GroupedData":
         """Hash-partition exchange + per-partition grouping (reference:
@@ -461,7 +475,7 @@ class Dataset:
         right_refs = [
             _concat_parts.remote(*[parts[b][p] for b in range(len(parts))])
             for p in range(n_out)]
-        return Dataset([_zip_blocks.remote(l, r) for l, r in
+        return Dataset(exec_log=self._exec_log, block_refs=[_zip_blocks.remote(l, r) for l, r in
                         zip(left._block_refs, right_refs)])
 
     def union(self, *others: "Dataset") -> "Dataset":
@@ -543,7 +557,11 @@ class Dataset:
 
     def stats(self) -> str:
         """Human-readable execution summary (reference:
-        ``Dataset.stats()``): block count, rows, bytes, operator chain."""
+        ``Dataset.stats()`` backed by per-operator stats,
+        ``_internal/stats.py``): block count, rows, bytes, the pending
+        operator chain, and PER-EXECUTED-STAGE wall-time aggregates
+        (count/total/mean/p50/p99 + scheduling latency) joined from the
+        cluster's task events by stage desc."""
         counts = ray_tpu.get([_count_block.remote(r)
                               for r in self._block_refs])
         sizer = ray_tpu.remote(
@@ -551,9 +569,54 @@ class Dataset:
         sizes = ray_tpu.get([sizer.remote(r) for r in self._block_refs])
         ops = " -> ".join(type(op).__name__.lstrip("_")
                           for op in self._ops) or "Read"
-        return (f"Dataset: {len(self._block_refs)} blocks, "
-                f"{sum(counts)} rows, {sum(sizes) / 1e6:.2f} MB "
-                f"(pending ops: {ops})")
+        lines = [f"Dataset: {len(self._block_refs)} blocks, "
+                 f"{sum(counts)} rows, {sum(sizes) / 1e6:.2f} MB "
+                 f"(pending ops: {ops})"]
+        for stage, row in self._stage_stats().items():
+            lines.append(
+                f"  stage {stage}: {row['tasks']} tasks, wall "
+                f"total={row['total_s']:.2f}s mean={row['mean_s'] * 1e3:.0f}ms "
+                f"p50={row['p50_s'] * 1e3:.0f}ms p99={row['p99_s'] * 1e3:.0f}ms, "
+                f"sched p50={row['sched_p50_ms']:.0f}ms")
+        return "\n".join(lines)
+
+    def _stage_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-executed-stage aggregates from the controller's task-event
+        table (the same events `ray_tpu timeline` exports)."""
+        if not self._exec_log:
+            return {}
+        try:
+            from ray_tpu.core.runtime import get_core_worker
+
+            core = get_core_worker()
+            core._flush_task_events()
+            events = core.controller.call("list_task_events", 20000)
+        except Exception:
+            return {}
+        out: Dict[str, Dict[str, float]] = {}
+        for stage in self._exec_log:
+            runs, scheds = [], []
+            for e in events:
+                if (e.get("desc") != stage.split("[")[0]
+                        or e.get("state") != "FINISHED"):
+                    continue
+                if e.get("end_ts") and e.get("lease_ts"):
+                    runs.append(e["end_ts"] - e["lease_ts"])
+                if e.get("lease_ts") and e.get("submitted_ts"):
+                    scheds.append(e["lease_ts"] - e["submitted_ts"])
+            if not runs:
+                continue
+            runs.sort()
+            out[stage] = {
+                "tasks": len(runs),
+                "total_s": float(np.sum(runs)),
+                "mean_s": float(np.mean(runs)),
+                "p50_s": runs[len(runs) // 2],
+                "p99_s": runs[min(len(runs) - 1, int(len(runs) * 0.99))],
+                "sched_p50_ms": (1e3 * sorted(scheds)[len(scheds) // 2]
+                                 if scheds else 0.0),
+            }
+        return out
 
     # --------------------------------------------------------- execution
 
@@ -595,7 +658,9 @@ class Dataset:
                 yield ray_tpu.get(ref)
             return
         fused = _fuse_ops(self._ops)
-        process = ray_tpu.remote(lambda block: fused(block))
+        if fused.__qualname__ not in self._exec_log:
+            self._exec_log.append(fused.__qualname__)
+        process = ray_tpu.remote(fused)
         ref_iter = iter(self._block_refs)
         pending: List[Any] = []
         fixed = max_in_flight is not None
@@ -622,18 +687,22 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         if not self._ops:
-            return Dataset(self._block_refs)
+            return Dataset(self._block_refs, exec_log=self._exec_log)
         refs = list(self._block_refs)
         # Consecutive task ops fuse into one task per block; an actor op
         # breaks fusion and runs on a stateful pool (operator grouping, as
         # the reference's physical planner does).
         segment: List[_Op] = []
+        executed: List[str] = list(self._exec_log)
 
         def flush_tasks(refs):
             if not segment:
                 return refs
             fused = _fuse_ops(list(segment))
-            process = ray_tpu.remote(lambda block: fused(block))
+            executed.append(fused.__qualname__)
+            # Submit the fused callable DIRECTLY: its qualname is the
+            # stage desc, which is what stats() joins task events on.
+            process = ray_tpu.remote(fused)
             segment.clear()
             return [process.remote(r) for r in refs]
 
@@ -641,11 +710,12 @@ class Dataset:
             if isinstance(op, _MapBatches) and op.compute == "actors":
                 refs = flush_tasks(refs)
                 refs = self._actor_map(op, refs)
+                executed.append(_stage_desc([op]) + "[actors]")
             else:
                 segment.append(op)
         refs = flush_tasks(refs)
         ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
-        return Dataset(refs)
+        return Dataset(refs, exec_log=executed)
 
     def _actor_map(self, op: "_MapBatches", refs: List[Any]) -> List[Any]:
         """Actor-pool execution with min/max autoscaling (reference:
@@ -813,12 +883,35 @@ class Dataset:
 
         return self._write_blocks(path, "json", write_one)
 
+    def write_tfrecords(self, path: str, column: str = "record"
+                        ) -> List[str]:
+        """One TFRecord container per block; rows of ``column`` must be
+        bytes (reference: ``Dataset.write_tfrecords`` — payloads are the
+        caller's serialized protos). Framing matches ``read_tfrecords``."""
+        def write_one(block: Block, out_path: str) -> str:
+            import struct as _struct
+
+            from ray_tpu.data.read_api import _tfrecord_crc
+
+            with open(out_path, "wb") as f:
+                for rec in block[column]:
+                    payload = bytes(rec)
+                    header = _struct.pack("<Q", len(payload))
+                    f.write(header)
+                    f.write(_struct.pack("<I", _tfrecord_crc(header)))
+                    f.write(payload)
+                    f.write(_struct.pack("<I", _tfrecord_crc(payload)))
+            return out_path
+
+        return self._write_blocks(path, "tfrecords", write_one)
+
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by whole blocks."""
         chunks: List[List[Any]] = [[] for _ in range(n)]
         for i, ref in enumerate(self._block_refs):
             chunks[i % n].append(ref)
-        return [Dataset(c, self._ops) for c in chunks]
+        return [Dataset(c, self._ops, exec_log=self._exec_log)
+                for c in chunks]
 
     def streaming_split(self, n: int, equal: bool = True) -> List["DataIterator"]:
         """Per-consumer iterators for distributed ingest (reference:
@@ -865,7 +958,7 @@ class GroupedData:
             _group_combine.remote(self._key, list(aggs),
                                   *[parts[b][p] for b in range(len(parts))])
             for p in range(num_parts)]
-        return Dataset(out_refs)
+        return Dataset(out_refs, exec_log=self._ds._exec_log)
 
     def count(self) -> Dataset:
         return self.aggregate(("count", None, "count"))
@@ -898,7 +991,7 @@ class GroupedData:
             _map_groups_part.remote(self._key, fn_blob,
                                     *[parts[b][p] for b in range(len(parts))])
             for p in range(num_parts)]
-        return Dataset(out_refs)
+        return Dataset(out_refs, exec_log=self._ds._exec_log)
 
 
 @ray_tpu.remote
