@@ -191,12 +191,19 @@ class StandardAutoscaler:
                  min_nodes: int = 0, max_nodes: int = 8,
                  idle_timeout_s: float = 60.0,
                  update_interval_s: float = 1.0,
-                 node_labels: "Optional[Dict[str, str]]" = None):
+                 node_labels: "Optional[Dict[str, str]]" = None,
+                 instance_manager=None):
         if hasattr(controller, "call") and not hasattr(
                 controller, "autoscaler_state"):
             controller = _RemoteController(controller)
         self._controller = controller
         self._provider = provider
+        # Optional lifecycle layer (reference: autoscaler/v2
+        # instance_manager + updater.py's retry/backoff setup): when
+        # present, the planner requests/terminates THROUGH it and it owns
+        # allocation retries, setup backoff, and stuck-instance
+        # replacement.
+        self._im = instance_manager
         self._node_resources = dict(node_resources)
         self._node_labels = dict(node_labels or {})
         self._min_nodes = min_nodes
@@ -264,12 +271,16 @@ class StandardAutoscaler:
         provider_ids = set(self._provider.non_terminated_nodes())
         registered = {n["labels"].get("provider_node_id")
                       for n in nodes}
+        if self._im is not None:
+            self._im.reconcile(registered)
 
         # Plan scale-up: bin-pack unmet demand onto hypothetical new nodes.
         # Launched-but-not-yet-registered nodes count as capacity so slow
         # provisioning (minutes for a TPU slice) doesn't relaunch the same
-        # demand every tick.
-        provisioning = len(provider_ids - registered)
+        # demand every tick (with an instance manager, REQUESTED-but-not-
+        # yet-allocated instances count too).
+        provisioning = (self._im.pending_count() if self._im is not None
+                        else len(provider_ids - registered))
         unmet: List[tuple] = []
         capacity = ([(n.get("labels", {}), dict(n["available"]))
                      for n in nodes]
@@ -290,20 +301,30 @@ class StandardAutoscaler:
                 to_launch += 1
                 pool = dict(new_node)
                 resmath.take(pool, shape)
-        launchable = max(0, min(
-            to_launch,
-            self._max_nodes - len(self._provider.non_terminated_nodes())))
-        for _ in range(launchable):
-            self._provider.create_node(self._node_resources,
-                                       dict(self._node_labels))
+        def current_count() -> int:
+            live = len(self._provider.non_terminated_nodes())
+            if self._im is not None:
+                # Provider view + not-yet-allocated requests.
+                return live + self._im.requested_count()
+            return live
+
+        def launch_one() -> None:
+            if self._im is not None:
+                self._im.request_node(self._node_resources,
+                                      dict(self._node_labels))
+            else:
+                self._provider.create_node(self._node_resources,
+                                           dict(self._node_labels))
             self.num_launches += 1
 
+        launchable = max(0, min(to_launch,
+                                self._max_nodes - current_count()))
+        for _ in range(launchable):
+            launch_one()
+
         # Ensure the floor.
-        short = self._min_nodes - len(self._provider.non_terminated_nodes())
-        for _ in range(max(0, short)):
-            self._provider.create_node(self._node_resources,
-                                       dict(self._node_labels))
-            self.num_launches += 1
+        for _ in range(max(0, self._min_nodes - current_count())):
+            launch_one()
 
         # Plan scale-down: terminate nodes idle past the timeout. Any
         # provider works: nodes carry their provider instance id as the
@@ -322,7 +343,10 @@ class StandardAutoscaler:
             if (now - first_idle > self._idle_timeout_s
                     and remaining > self._min_nodes
                     and pid in provider_ids):
-                self._provider.terminate_node(pid)
+                if self._im is not None:
+                    self._im.terminate(pid)
+                else:
+                    self._provider.terminate_node(pid)
                 self._idle_since.pop(n["node_id"], None)
                 self.num_terminations += 1
                 remaining -= 1

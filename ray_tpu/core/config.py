@@ -32,12 +32,13 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "process (~10 ms) instead of spawning a fresh interpreter (~150 ms+) "
         "(reference: prestarted worker pool, worker_pool.h:357)."),
     "lease_undelivered_timeout_s": (float, 10.0,
-        "A leased worker that receives NO task within this window is "
-        "treated as a lost lease grant (the reply never reached the "
-        "caller over a lossy network): the lease is credited back and "
-        "the worker returns to the pool. Callers never push to a lease "
-        "they did not hear about, so reclamation cannot race a late "
-        "delivery of the ORIGINAL grant."),
+        "A pooled worker that self-reports IDLE for this long while its "
+        "lease is held had its grant reply or lease return lost on the "
+        "network: the lease is credited back and the worker re-pooled. "
+        "Dedicated (actor) forks whose actor runtime never started get "
+        "3x this window before being killed (their creation was retried "
+        "elsewhere). The lease GENERATION token keeps any straggler "
+        "return/push from corrupting accounting. 0 disables."),
     "idle_worker_keep_s": (float, 300.0,
         "Idle workers beyond the soft pool limit are reaped after this long."),
     "heartbeat_period_s": (float, 1.0,

@@ -230,9 +230,10 @@ class WorkerHandle:
         self.env_hash = ""      # runtime-env identity for pool matching
         self.env_dirs: List[str] = []  # cache dirs pinned against env GC
         self.tasks_received = 0        # worker-reported (worker_ping)
+        self.reported_active = -1      # worker-reported in-flight tasks
+        self.actor_started = False     # worker-reported actor runtime up
         self.last_ping_ts = 0.0        # when that report arrived
         self.lease_ts = 0.0            # when the current lease was granted
-        self.tasks_at_grant = 0        # counter snapshot at grant time
         # Lease generation: bumped on every grant AND reclamation, echoed
         # in return_worker so a duplicated or stale return (lost reply
         # retry, post-reclaim stragglers) can never credit someone else's
@@ -445,7 +446,6 @@ class Node:
             handle.task_meta = dict(task_meta) if task_meta else None
             handle.last_used = time.monotonic()
             handle.lease_ts = time.monotonic()
-            handle.tasks_at_grant = handle.tasks_received
             handle.lease_seq += 1
             lease_seq = handle.lease_seq
         return {"worker_id": handle.worker_id.binary(), "addr": handle.addr,
@@ -834,21 +834,24 @@ class Node:
         return count
 
     def worker_ping(self, worker_id_bytes: bytes,
-                    tasks_received: int = -1) -> Dict[str, bool]:
+                    tasks_received: int = -1, active_tasks: int = -1,
+                    actor_started: bool = False) -> Dict[str, bool]:
         """Liveness ping that also answers "does this node still know me?".
         A worker whose handle is gone from the table (lost forkserver pid
         reply, reaper false positive, any future leak path) self-terminates
         instead of orphaning — the table is the single source of truth.
 
-        ``tasks_received`` lets the reaper detect GRANTED-BUT-UNDELIVERED
-        leases: when a lease reply is lost on the network, the caller never
-        learns its worker id, so no task ever arrives — without
-        reclamation the worker would sit leased until the idle reaper
-        (minutes) while the node's resources stay exhausted."""
+        The worker self-reports its work state so the reaper can reclaim
+        leases orphaned by a LOSSY NETWORK: a lost grant reply (the caller
+        never learned its worker id) or a lost lease return (the task
+        finished but the credit never landed) both look the same from
+        here — a lease held while the worker sits demonstrably idle."""
         with self._lock:
             handle = self._workers.get(WorkerID(worker_id_bytes))
             if handle is not None and tasks_received >= 0:
                 handle.tasks_received = tasks_received
+                handle.reported_active = active_tasks
+                handle.actor_started = actor_started
                 handle.last_ping_ts = time.monotonic()
         return {"known": handle is not None}
 
@@ -1014,39 +1017,55 @@ class Node:
                 self._drain_waiters_locked()
 
     def _reclaim_undelivered_leases(self, now: float) -> None:
-        """Reclaim leases whose grant reply was lost (lossy network): the
-        caller never learned its worker id, so no task ever arrived. The
-        worker self-reports its work counter via worker_ping; a leased
-        worker whose counter never moved past the grant snapshot for
-        ``lease_undelivered_timeout_s`` gets its lease credited back —
-        pooled workers rejoin the pool, dedicated (actor) forks die (their
-        creation was retried elsewhere)."""
+        """Reclaim leases orphaned by a lossy network. Two shapes, both
+        detected through the worker's own reports (worker_ping):
+
+        * POOLED worker leased but demonstrably IDLE (active==0 reported
+          well after the grant, lease old): either the grant reply never
+          reached the caller (no push will ever come — deps resolve
+          before leasing, so a heard grant is pushed within an RPC) or
+          the task finished and the lease RETURN was lost. Credit the
+          lease and re-pool. A pathologically late push still executes
+          fine (the worker accepts it; the lease GENERATION token keeps
+          its eventual return from corrupting accounting).
+        * DEDICATED fork whose actor runtime NEVER started (the
+          create_actor_worker reply was lost; the controller retried
+          elsewhere): credit and kill. Uses 3x the window — a live
+          actor's start_actor is pushed right after the lease, but
+          controller storms deserve slack. Actors that DID start are
+          never touched (they hold their lease for life, however idle).
+
+        Reclamation requires the idle report to POSTDATE the grant: when
+        pings themselves starve (overloaded node) we cannot distinguish
+        lost-grant from busy-with-stale-report — do nothing."""
         timeout_s = config.lease_undelivered_timeout_s
         if timeout_s <= 0:
             return
         victims: List[WorkerHandle] = []
         with self._lock:
             for handle in list(self._workers.values()):
-                if (handle.lease_resources is not None
-                        and handle.lease_ts
-                        and now - handle.lease_ts > timeout_s
-                        and handle.tasks_received == handle.tasks_at_grant
-                        # The zero-counter report must POSTDATE the grant:
-                        # when pings themselves are starving (overloaded
-                        # node) we cannot distinguish lost-grant from
-                        # busy-with-stale-report — do nothing.
-                        and handle.last_ping_ts > handle.lease_ts + 2.0
-                        and handle.proc.poll() is None):
+                if (handle.lease_resources is None or not handle.lease_ts
+                        or handle.reported_active != 0
+                        or handle.last_ping_ts < handle.lease_ts + 2.0
+                        or now - handle.last_ping_ts > 6.0
+                        or handle.proc.poll() is not None):
+                    continue
+                if (not handle.dedicated
+                        and now - handle.lease_ts > timeout_s):
                     self._credit_lease_locked(handle)
                     handle.lease_ts = 0.0
                     handle.lease_seq += 1  # invalidate straggler returns
-                    if handle.dedicated:
-                        self._remove_worker_locked(handle)
-                        victims.append(handle)
-                    else:
+                    if not handle.idle:
                         handle.idle = True
                         handle.last_used = now
                         self._idle.append(handle)
+                elif (handle.dedicated and not handle.actor_started
+                        and now - handle.lease_ts > 3 * timeout_s):
+                    self._credit_lease_locked(handle)
+                    handle.lease_ts = 0.0
+                    handle.lease_seq += 1
+                    self._remove_worker_locked(handle)
+                    victims.append(handle)
             if victims or self._waiters:
                 self._drain_waiters_locked()
         for handle in victims:

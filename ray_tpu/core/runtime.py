@@ -137,10 +137,11 @@ class CoreWorker:
         self._actor_runtime: Optional[ActorExecutionRuntime] = None
         self._current_task_desc = threading.local()
         self._shutdown = threading.Event()
-        # Work-received counter, reported in worker_ping so the node can
-        # reclaim leases whose grant REPLY was lost (the worker would
-        # otherwise sit leased-but-idle until the idle reaper).
+        # Work counters, reported in worker_ping so the node can reclaim
+        # leases whose grant or return was lost on the network (the
+        # worker would otherwise sit leased forever).
         self.tasks_received = 0
+        self.active_tasks = 0
 
         # Owner-kept task lineage for object reconstruction: return oid ->
         # shared record of the producing task (reference: task_manager.h:215
@@ -959,6 +960,7 @@ class CoreWorker:
         (task_execution_handler) minus the Cython; results return in-band to
         the owner (reference inlines <100KB returns the same way)."""
         self.tasks_received += 1
+        self.active_tasks += 1
         try:
             fn = self._load_function(spec["func_key"], spec.get("func_blob"))
             args, kwargs = self._resolve_args(spec["args_blob"])
@@ -1002,6 +1004,7 @@ class CoreWorker:
                     "error_frame": serialization.serialize(err)}
         finally:
             self._current_task_desc.value = None
+            self.active_tasks -= 1
 
     def _pack_results(self, results: List[Any],
                       force_shm: bool = False) -> List[tuple]:
@@ -1207,11 +1210,14 @@ class TaskSubmitter:
                               bundle, dead: bool,
                               lease_seq: Optional[int] = None) -> None:
         """Return a lease without letting a transport blip become the
-        TASK's error: one fresh-socket retry, then rely on the node's
-        reaper to re-credit when the worker idles out or dies. The
-        lease_seq makes the retry idempotent — a first attempt that was
-        APPLIED but whose reply was lost cannot double-credit/double-pool
-        (the node's generation check no-ops the duplicate)."""
+        TASK's error: one fresh-socket retry, then give up — the node's
+        reaper reclaims the lease anyway once the worker self-reports
+        idle past lease_undelivered_timeout_s (_reclaim_undelivered_
+        leases), so a doubly-lost return degrades to a short capacity dip,
+        not a leak. The lease_seq makes the retry idempotent — a first
+        attempt that was APPLIED but whose reply was lost cannot
+        double-credit/double-pool (the node's generation check no-ops the
+        duplicate)."""
         for attempt in range(2):
             try:
                 self._core.clients.get(tuple(node_addr)).call(

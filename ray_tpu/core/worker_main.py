@@ -49,8 +49,10 @@ def run(node_addr, controller_addr, node_id_hex: str,
     while not core._shutdown.is_set():
         time.sleep(2.0)
         try:
-            reply = node_client.call("worker_ping", core.worker_id.binary(),
-                                     core.tasks_received, timeout=10.0)
+            reply = node_client.call(
+                "worker_ping", core.worker_id.binary(),
+                core.tasks_received, core.active_tasks,
+                core._actor_runtime is not None, timeout=10.0)
             if not reply.get("known", True):
                 break
             misses = 0

@@ -597,8 +597,7 @@ class Dataset:
         for stage in self._exec_log:
             runs, scheds = [], []
             for e in events:
-                if (e.get("desc") != stage.split("[")[0]
-                        or e.get("state") != "FINISHED"):
+                if e.get("desc") != stage or e.get("state") != "FINISHED":
                     continue
                 if e.get("end_ts") and e.get("lease_ts"):
                     runs.append(e["end_ts"] - e["lease_ts"])
@@ -657,9 +656,11 @@ class Dataset:
             for ref in self._block_refs:
                 yield ray_tpu.get(ref)
             return
+        import uuid as _uuid
+
         fused = _fuse_ops(self._ops)
-        if fused.__qualname__ not in self._exec_log:
-            self._exec_log.append(fused.__qualname__)
+        fused.__qualname__ += f"#{_uuid.uuid4().hex[:6]}"
+        self._exec_log.append(fused.__qualname__)
         process = ray_tpu.remote(fused)
         ref_iter = iter(self._block_refs)
         pending: List[Any] = []
@@ -698,10 +699,14 @@ class Dataset:
         def flush_tasks(refs):
             if not segment:
                 return refs
+            import uuid as _uuid
+
             fused = _fuse_ops(list(segment))
+            # Unique per EXECUTION: stats() joins task events by this
+            # desc, and two datasets running the same op chain must not
+            # pollute each other's aggregates.
+            fused.__qualname__ += f"#{_uuid.uuid4().hex[:6]}"
             executed.append(fused.__qualname__)
-            # Submit the fused callable DIRECTLY: its qualname is the
-            # stage desc, which is what stats() joins task events on.
             process = ray_tpu.remote(fused)
             segment.clear()
             return [process.remote(r) for r in refs]
@@ -710,7 +715,9 @@ class Dataset:
             if isinstance(op, _MapBatches) and op.compute == "actors":
                 refs = flush_tasks(refs)
                 refs = self._actor_map(op, refs)
-                executed.append(_stage_desc([op]) + "[actors]")
+                # Actor-pool stages run through actor calls, which do not
+                # land in the task-event table under a stage desc — no
+                # exec-log entry (stats() would join the wrong events).
             else:
                 segment.append(op)
         refs = flush_tasks(refs)
