@@ -279,8 +279,13 @@ class StandardAutoscaler:
         # provisioning (minutes for a TPU slice) doesn't relaunch the same
         # demand every tick (with an instance manager, REQUESTED-but-not-
         # yet-allocated instances count too).
-        provisioning = (self._im.pending_count() if self._im is not None
-                        else len(provider_ids - registered))
+        # Provider-visible-but-unregistered nodes count even with an
+        # instance manager: its state is in-memory, so after a restart it
+        # would not know about a TPU slice still provisioning — and a
+        # duplicate launch for the same demand is the expensive mistake.
+        provisioning = len(provider_ids - registered)
+        if self._im is not None:
+            provisioning += self._im.requested_count()
         unmet: List[tuple] = []
         capacity = ([(n.get("labels", {}), dict(n["available"]))
                      for n in nodes]

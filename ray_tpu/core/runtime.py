@@ -1143,6 +1143,10 @@ class CoreWorker:
 
     def _handle_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         self.tasks_received += 1
+        # active_tasks covers the WHOLE __init__: the node's lease reaper
+        # must see this worker as busy while a slow constructor (model
+        # load) runs, or it would reclaim a delivered actor lease.
+        self.active_tasks += 1
         try:
             cls = self._load_function(spec["cls_key"], spec.get("cls_blob"))
             args, kwargs = self._resolve_args(spec["args_blob"])
@@ -1150,6 +1154,8 @@ class CoreWorker:
         except BaseException as e:  # noqa: BLE001
             err = TaskError(e, task_desc=f"{spec.get('desc', '')}.__init__")
             return {"ok": False, "error_frame": serialization.serialize(err)}
+        finally:
+            self.active_tasks -= 1
         self._actor_runtime = ActorExecutionRuntime(
             self, instance,
             max_concurrency=spec.get("max_concurrency", 1),

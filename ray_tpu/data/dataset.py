@@ -661,6 +661,7 @@ class Dataset:
         fused = _fuse_ops(self._ops)
         fused.__qualname__ += f"#{_uuid.uuid4().hex[:6]}"
         self._exec_log.append(fused.__qualname__)
+        del self._exec_log[:-20]  # bounded: epoch loops re-execute forever
         process = ray_tpu.remote(fused)
         ref_iter = iter(self._block_refs)
         pending: List[Any] = []
@@ -707,6 +708,7 @@ class Dataset:
             # pollute each other's aggregates.
             fused.__qualname__ += f"#{_uuid.uuid4().hex[:6]}"
             executed.append(fused.__qualname__)
+            del executed[:-20]  # bounded lineage (epoch loops)
             process = ray_tpu.remote(fused)
             segment.clear()
             return [process.remote(r) for r in refs]

@@ -86,7 +86,10 @@ class InstanceManager:
             inst = next((i for i in self._instances.values()
                          if i.provider_id == provider_id
                          and i.state not in (TERMINATED, FAILED)), None)
-        self._terminate_instance(inst, "planner scale-down")
+            # Under the lock: the setup-failure thread checks state before
+            # acting, so marking TERMINATED here prevents it from
+            # replacing a node the planner just removed.
+            self._terminate_instance(inst, "planner scale-down")
         if inst is None:
             # Foreign instance (pre-manager or manual): still honor it.
             try:
@@ -100,6 +103,12 @@ class InstanceManager:
         """One pass of the lifecycle state machine. ``registered_provider_
         ids``: provider ids of nodes the cluster controller sees alive."""
         now = time.monotonic()
+        # One provider snapshot per pass, taken OUTSIDE the lock (a cloud
+        # list call must not stall setup threads' transitions).
+        try:
+            live_provider_ids = set(self._provider.non_terminated_nodes())
+        except Exception:
+            live_provider_ids = set()
         with self._lock:
             instances = list(self._instances.values())
             # Prune terminal records past a bounded history (the reference
@@ -130,9 +139,8 @@ class InstanceManager:
                             and now >= inst.next_attempt_ts):
                         self._try_setup(inst, now)
                 elif inst.state == RUNNING:
-                    if inst.provider_id not in registered_provider_ids and \
-                            inst.provider_id not in set(
-                                self._provider.non_terminated_nodes()):
+                    if (inst.provider_id not in registered_provider_ids
+                            and inst.provider_id not in live_provider_ids):
                         inst.state = TERMINATED  # died/externally removed
                         self._event(inst, "gone")
 

@@ -1,6 +1,7 @@
 """ray_tpu.rl: reinforcement learning at scale (reference: RLlib)."""
 
 from ray_tpu.rl.bc import BC, BCConfig, collect_dataset  # noqa: F401
+from ray_tpu.rl.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.offline import (  # noqa: F401
     dataset_to_buffer,

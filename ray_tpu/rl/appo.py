@@ -1,0 +1,113 @@
+"""APPO: asynchronous PPO (IMPALA pipeline + clipped surrogate).
+
+Analogue of the reference's APPO (``rllib/algorithms/appo/appo.py`` — PPO
+losses computed asynchronously over IMPALA's actor-learner pipeline with
+V-trace off-policy correction and a periodically-synced TARGET network
+stabilizing the value baseline). Samplers never wait for the learner
+(inherited from :class:`IMPALA`); the loss differs:
+
+* policy: PPO clipped surrogate on V-trace advantages — the importance
+  ratio is clipped BOTH by V-trace's rho (for the value targets) and by
+  PPO's epsilon (for the policy step), so a stale rollout can neither
+  poison the baseline nor drag the policy far.
+* value: regression to V-trace targets computed with the TARGET network's
+  values; the target hard-syncs every ``target_update_interval`` learner
+  updates (the reference's `target_network_update_freq`).
+
+The target params + sync counter ride inside the optimizer-state bundle
+so the whole update stays one jitted function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import ray_tpu  # noqa: F401 — same actor topology as IMPALA
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig, vtrace
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip_eps: float = 0.2
+    target_update_interval: int = 16   # learner updates between syncs
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        import jax
+        import jax.numpy as jnp
+
+        # Bundle = (optax state, target params, updates-since-sync).
+        self.opt_state = (self.opt_state,
+                          jax.tree.map(lambda x: x, self.params),
+                          jnp.zeros((), jnp.int32))
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        forward = self._forward
+
+        def loss_fn(params, target_params, batch):
+            T, N = batch["rewards"].shape
+            obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+            logits, values_flat = forward(params, obs)
+            logits = logits.reshape(T, N, -1)
+            values = values_flat.reshape(T, N)
+            _t_logits, t_values_flat = forward(target_params, obs)
+            t_values = jax.lax.stop_gradient(t_values_flat.reshape(T, N))
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            # V-trace baseline/advantages from the TARGET network (the
+            # reference's stabilized value targets).
+            vs, pg_adv = vtrace(
+                batch["logp"], jax.lax.stop_gradient(target_logp),
+                batch["rewards"], t_values, batch["dones"],
+                batch["last_value"], batch["valids"], cfg.gamma,
+                cfg.rho_clip, cfg.c_clip)
+            vs = jax.lax.stop_gradient(vs)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            valid = batch["valids"]
+            valid_count = jnp.maximum(valid.sum(), 1.0)
+            # PPO clipped surrogate with the off-policy ratio.
+            ratio = jnp.exp(target_logp - batch["logp"])
+            clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps,
+                               1.0 + cfg.clip_eps)
+            pi_loss = -jnp.sum(
+                valid * jnp.minimum(ratio * pg_adv, clipped * pg_adv)
+            ) / valid_count
+            vf_loss = jnp.sum(valid * (values - vs) ** 2) / valid_count
+            entropy = -jnp.sum(
+                valid[..., None] * jax.nn.softmax(logits) * logp_all
+            ) / valid_count
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "clip_frac": jnp.sum(
+                               valid * (jnp.abs(ratio - 1.0)
+                                        > cfg.clip_eps)) / valid_count}
+
+        def update(params, bundle, batch):
+            opt_state, target_params, since_sync = bundle
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            since_sync = since_sync + 1
+            sync = since_sync >= cfg.target_update_interval
+            target_params = jax.tree.map(
+                lambda t, p: jnp.where(sync, p, t), target_params, params)
+            since_sync = jnp.where(sync, 0, since_sync)
+            aux["total_loss"] = loss
+            return params, (opt_state, target_params, since_sync), aux
+
+        return update

@@ -74,7 +74,8 @@ class BC:
         self._iteration = 0
 
         obs_shape, num_actions = probe_env_spec(
-            config.env, config.env_config, config.frame_stack)
+            config.env, config.env_config, config.frame_stack,
+            getattr(config, "obs_connectors", None))
         init_fn, self._forward = build_policy(obs_shape, num_actions,
                                               config.hidden)
         self.params = init_fn(jax.random.key(config.seed))

@@ -33,16 +33,31 @@ class ConfigBuilderMixin:
 
 
 def probe_env_spec(env: str, env_config: Dict[str, Any],
-                   frame_stack: int = 1) -> Tuple[tuple, int]:
-    """Observation shape (after frame stacking) + action count."""
+                   frame_stack: int = 1,
+                   obs_connectors=None) -> Tuple[tuple, int]:
+    """Observation shape (after connectors + frame stacking) + action
+    count. Connectors transform obs before the policy, so the policy's
+    input shape comes from a transformed sample, not the raw space."""
     import gymnasium as gym
+    import numpy as np
 
     if env.startswith("ray_tpu/"):
         from ray_tpu.rl import testing  # noqa: F401 (registers the ids)
     probe = gym.make(env, **env_config)
-    obs_shape = probe.observation_space.shape
+    obs, _ = probe.reset(seed=0)
     num_actions = int(probe.action_space.n)
     probe.close()
+    if obs_connectors:
+        import copy
+
+        from ray_tpu.rl.connectors import apply_connectors
+
+        # Probe through a DEEP COPY: stateful connectors (running
+        # normalization) must not have their statistics polluted by the
+        # probe sample before being shipped to runners.
+        obs = apply_connectors(copy.deepcopy(list(obs_connectors)),
+                               np.asarray(obs)[None])[0]
+    obs_shape = tuple(np.asarray(obs).shape)
     if frame_stack > 1:
         obs_shape = obs_shape[:-1] + (obs_shape[-1] * frame_stack,)
     return tuple(obs_shape), num_actions
@@ -57,7 +72,8 @@ def make_env_runners(config) -> List[Any]:
             config.rollout_length, seed=config.seed + i,
             env_config=config.env_config,
             frame_stack=getattr(config, "frame_stack", 1),
-            policy_mode=getattr(config, "policy_mode", "categorical"))
+            policy_mode=getattr(config, "policy_mode", "categorical"),
+            obs_connectors=getattr(config, "obs_connectors", None))
         for i in range(config.num_env_runners)
     ]
 

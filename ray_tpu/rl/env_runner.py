@@ -35,7 +35,8 @@ class EnvRunner:
                  rollout_length: int = 128, seed: int = 0,
                  env_config: Optional[Dict] = None,
                  frame_stack: int = 1,
-                 policy_mode: str = "categorical"):
+                 policy_mode: str = "categorical",
+                 obs_connectors: Optional[list] = None):
         import jax
 
         self._jax = jax
@@ -43,17 +44,25 @@ class EnvRunner:
         self.num_envs = num_envs
         self.rollout_length = rollout_length
         self.frame_stack = frame_stack
+        # Env-to-module preprocessing chain (reference: ConnectorV2
+        # pipelines, rllib/connectors/): applied to every observation
+        # BEFORE storage and the policy forward — and before frame
+        # stacking, which consumes the transformed frames.
+        from ray_tpu.rl.connectors import apply_connectors
+        self._connectors = list(obs_connectors or [])
+        self._apply_conn = apply_connectors
         self._rng = np.random.default_rng(seed)
         self._key = jax.random.key(seed)
         obs, _ = self.envs.reset(seed=seed)
-        self._raw_shape = self.envs.single_observation_space.shape
+        obs = self._apply_conn(self._connectors, obs)
+        self._raw_shape = tuple(obs.shape[1:])
         self._stack = None
         if frame_stack > 1:
             if len(self._raw_shape) != 3:
                 raise ValueError("frame_stack needs (H, W, C) observations")
             h, w, c = self._raw_shape
             self._stack = np.zeros((num_envs, h, w, c * frame_stack),
-                                   self.envs.single_observation_space.dtype)
+                                   obs.dtype)
             # Episode starts are [frame]*k everywhere (the same treatment
             # resets get), not zero-padded history.
             self._push_frames(obs, reset_mask=np.ones(num_envs, bool))
@@ -182,6 +191,7 @@ class EnvRunner:
                 env_action = action
             obs, reward, terminated, truncated, _ = self.envs.step(
                 env_action)
+            obs = self._apply_conn(self._connectors, obs)
             done = np.logical_or(terminated, truncated)
             if self._stack is not None:
                 self._push_frames(obs, reset_mask=self._prev_done)
