@@ -51,13 +51,19 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=15)
     args = ap.parse_args()
 
-    from ray_tpu.rl import DQNConfig, MultiAgentPPOConfig, PPOConfig, SACConfig
+    from ray_tpu.rl import (APPOConfig, DQNConfig, MultiAgentPPOConfig,
+                            PPOConfig, SACConfig)
 
     ray_tpu.init(num_cpus=6)
     rows = [
         bench("PPO/CartPole-v1", PPOConfig(
             env="CartPole-v1", num_env_runners=2, seed=0).build(),
             args.iters),
+        bench("APPO/CartPole-v1", APPOConfig(
+            env="CartPole-v1", num_env_runners=2, seed=0).build(),
+            args.iters,
+            note="async clipped surrogate over the IMPALA pipeline; "
+                 "samplers never wait for the learner"),
         # Replay ratio rebalanced for a THROUGHPUT row (VERDICT r3 Weak
         # #5): the learning default (32 jitted replay updates/iter)
         # spends ~16 train samples per env step — right for sample
