@@ -154,18 +154,23 @@ class _ForkedProc:
                     os.close(self._pidfd)
                     self._pidfd = None
                 return self.returncode
-        try:
-            with open(f"/proc/{self.pid}/stat", "rb") as f:
-                stat = f.read()
-            # Field 3, after the parenthesised comm (which may hold spaces).
-            state = stat.rsplit(b")", 1)[1].split()[0]
-        except (OSError, IndexError):
-            self.returncode = 1
-            return self.returncode
-        if state == b"Z":
-            self.returncode = 1
-            return self.returncode
-        return None
+            # /proc fallback stays under the SAME lock: the returncode
+            # transition must be atomic with _signal()'s dead-check, or a
+            # worker that died (and had its PID recycled) between that
+            # check and os.kill could deliver a stray signal to an
+            # unrelated process.
+            try:
+                with open(f"/proc/{self.pid}/stat", "rb") as f:
+                    stat = f.read()
+                # Field 3, after the parenthesised comm (may hold spaces).
+                state = stat.rsplit(b")", 1)[1].split()[0]
+            except (OSError, IndexError):
+                self.returncode = 1
+                return self.returncode
+            if state == b"Z":
+                self.returncode = 1
+                return self.returncode
+            return None
 
     def wait(self, timeout: Optional[float] = None) -> int:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -312,12 +317,13 @@ class Node:
                 "get_info": self.get_info,
                 "ping": lambda: "pong",
                 "worker_ping": self.worker_ping,
+                "validate_lease": self.validate_lease,
             },
             host=host,
             name="node",
             max_workers=128,
             inline_methods={"return_worker", "register_worker",
-                            "worker_ping", "reserve_bundle",
+                            "worker_ping", "validate_lease", "reserve_bundle",
                             "release_bundle", "kill_worker",
                             "worker_death_cause"},
         )
@@ -449,7 +455,7 @@ class Node:
             handle.lease_seq += 1
             lease_seq = handle.lease_seq
         return {"worker_id": handle.worker_id.binary(), "addr": handle.addr,
-                "lease_seq": lease_seq}
+                "lease_seq": lease_seq, "lease_ts": handle.lease_ts}
 
     def _credit(self, resources: Dict[str, float], bundle) -> None:
         with self._lock:
@@ -854,6 +860,16 @@ class Node:
                 handle.actor_started = actor_started
                 handle.last_ping_ts = time.monotonic()
         return {"known": handle is not None}
+
+    def validate_lease(self, worker_id_bytes: bytes, lease_seq: int) -> bool:
+        """Is ``lease_seq`` still the worker's CURRENT lease? Late task
+        pushes (delayed past the reclamation window on a chaos-slow link)
+        call this before executing: a reclaimed-then-re-granted worker must
+        not run the stale push concurrently with the new lease's task —
+        the seq token protects accounting, this check protects execution."""
+        with self._lock:
+            handle = self._workers.get(WorkerID(worker_id_bytes))
+            return handle is not None and handle.lease_seq == lease_seq
 
     def register_worker(self, worker_id_bytes: bytes, addr: Addr) -> Dict[str, Any]:
         worker_id = WorkerID(worker_id_bytes)

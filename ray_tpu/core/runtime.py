@@ -548,6 +548,7 @@ class CoreWorker:
                 # done/failed so the slot frees and our replica joins the
                 # tree.
                 src_key = payload.pop("src_key", None)
+                slot_token = payload.pop("slot_token", None)
                 remote = payload["node_id"] != self.node_id.binary()
                 try:
                     if src_key is not None and remote:
@@ -561,7 +562,8 @@ class CoreWorker:
                     if src_key is not None:
                         try:
                             owner.notify("pull_failed", ref.id.binary(),
-                                         src_key, payload["node_id"])
+                                         src_key, payload["node_id"],
+                                         slot_token)
                         except Exception:
                             pass
                         src_fails += 1
@@ -587,7 +589,7 @@ class CoreWorker:
                 if src_key is not None:
                     try:
                         owner.notify("pull_done", ref.id.binary(), src_key,
-                                     new_loc)
+                                     new_loc, slot_token)
                     except Exception:
                         pass
                 self.store.put_shm_ref(ref.id, new_loc or payload)
@@ -701,41 +703,59 @@ class CoreWorker:
                 now = time.monotonic()
                 best_key, best_load = None, None
                 for key, loc in locs.items():
-                    live = [t for t in track["slots"].get(key, [])
-                            if t > now]
+                    live = {tok: t
+                            for tok, t in track["slots"].get(key, {}).items()
+                            if t > now}
                     track["slots"][key] = live
                     if len(live) < fanout and (best_load is None
                                                or len(live) < best_load):
                         best_key, best_load = key, len(live)
                 if best_key is not None:
-                    track["slots"].setdefault(best_key, []).append(
+                    # Per-grant token: done/failed releases THIS lease, so
+                    # a pull completing past its expiry (already pruned)
+                    # can't pop another puller's live slot and transiently
+                    # exceed the fanout budget.
+                    token = os.urandom(8)
+                    track["slots"].setdefault(best_key, {})[token] = (
                         now + lease)
                     loc = dict(locs[best_key])
                     loc["src_key"] = best_key
+                    loc["slot_token"] = token
                     return ("shm", loc)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None  # borrower re-polls
                 self._bcast_cond.wait(min(remaining, 1.0))
 
+    def _release_pull_slot_locked(self, track: Dict[str, Any],
+                                  src_key: bytes,
+                                  slot_token: Optional[bytes]) -> None:
+        slots = track["slots"].get(src_key)
+        if not slots:
+            return
+        if slot_token is not None:
+            slots.pop(slot_token, None)  # absent = already expiry-pruned
+        else:
+            slots.pop(next(iter(slots)), None)
+
     def _handle_pull_done(self, oid_bytes: bytes, src_key: bytes,
-                          new_locator: Optional[Dict[str, Any]]) -> None:
+                          new_locator: Optional[Dict[str, Any]],
+                          slot_token: Optional[bytes] = None) -> None:
         """A puller finished: release its source slot and (when it managed
         to replicate into its node's store) add that copy as a new source."""
         with self._bcast_cond:
             track = self._bcast.get(oid_bytes)
             if track is None:
                 return
-            slots = track["slots"].get(src_key)
-            if slots:
-                slots.pop()
+            self._release_pull_slot_locked(track, src_key, slot_token)
             if new_locator is not None:
                 track["secondaries"][new_locator["node_id"]] = new_locator
             self._bcast_cond.notify_all()
 
     def _handle_pull_failed(self, oid_bytes: bytes,
                             src_key: Optional[bytes],
-                            bad_key: bytes) -> None:
+                            bad_key: bytes,
+                            slot_token: Optional[bytes] = None) -> None:
         """A source failed mid-pull/read: release the leased slot (when one
         was leased — local reads lease none) and forget the secondary (a
         dead PRIMARY is the reconstruction path's business)."""
@@ -744,9 +764,7 @@ class CoreWorker:
             if track is None:
                 return
             if src_key is not None:
-                slots = track["slots"].get(src_key)
-                if slots:
-                    slots.pop()
+                self._release_pull_slot_locked(track, src_key, slot_token)
             track["secondaries"].pop(bad_key, None)
             self._bcast_cond.notify_all()
 
@@ -959,6 +977,26 @@ class CoreWorker:
         Reference: the PushTask execution path in ``_raylet.pyx:2259``
         (task_execution_handler) minus the Cython; results return in-band to
         the owner (reference inlines <100KB returns the same way)."""
+        # A push that arrives near/past the lease-reclamation window may
+        # race a reclaim-and-re-grant: running it would execute two leases'
+        # tasks concurrently on one pooled worker (resources double-booked).
+        # Validate against the node's CURRENT lease_seq only in that rare
+        # late window — the common path (push within seconds of the grant,
+        # which reclamation provably cannot have touched) stays RPC-free.
+        lease_seq = spec.get("lease_seq")
+        lease_ts = spec.get("lease_ts")  # node monotonic; same host as us
+        if (lease_seq is not None and lease_ts is not None
+                and config.lease_undelivered_timeout_s > 0
+                and time.monotonic() - lease_ts
+                > max(0.5, config.lease_undelivered_timeout_s - 2.0)):
+            try:
+                still_mine = self.clients.get(self.node_addr).call(
+                    "validate_lease", self.worker_id.binary(), lease_seq,
+                    timeout=5.0)
+            except Exception:
+                still_mine = True  # node unreachable: keep pre-check behavior
+            if not still_mine:
+                return {"ok": False, "stale_lease": True}
         self.tasks_received += 1
         self.active_tasks += 1
         try:
@@ -1248,6 +1286,7 @@ class TaskSubmitter:
             retries_left = options.get("max_retries", 3)
             excluded: List[bytes] = []
             lease_attempts = 0
+            stale_leases = 0
             deadline = time.monotonic() + config.worker_lease_timeout_s
             while True:
                 # 2. Cluster-level node selection. Transport errors to the
@@ -1337,6 +1376,8 @@ class TaskSubmitter:
                     continue
                 worker_id, worker_addr = lease["worker_id"], lease["addr"]
                 lease_seq = lease.get("lease_seq")
+                spec["lease_seq"] = lease_seq
+                spec["lease_ts"] = lease.get("lease_ts")
                 t_lease = time.time()
                 worker_hex = WorkerID(worker_id).hex()
                 # 4. Direct push to the leased worker.
@@ -1368,6 +1409,24 @@ class TaskSubmitter:
                             f"memory monitor: {cause}") from e
                     raise WorkerCrashedError(
                         f"worker died executing {spec['desc']}: {e}") from e
+                if reply.get("stale_lease"):
+                    # The node reclaimed this lease while the push crawled
+                    # over the network; the worker refused to run it. The
+                    # lease credit already happened at reclamation — take a
+                    # fresh lease and push again, but BOUNDED: a link whose
+                    # every push outlives the reclamation window would
+                    # otherwise livelock here forever.
+                    stale_leases += 1
+                    if stale_leases > 5:
+                        raise RayTpuError(
+                            f"task {spec['desc']}: {stale_leases} leases "
+                            "reclaimed before their push arrived — link "
+                            "slower than lease_undelivered_timeout_s "
+                            f"({config.lease_undelivered_timeout_s}s)")
+                    time.sleep(0.2 * stale_leases)
+                    deadline = (time.monotonic()
+                                + config.worker_lease_timeout_s)
+                    continue
                 # Best-effort with one fresh-socket retry: the task already
                 # SUCCEEDED — a lossy link must not convert a lost lease
                 # return into a task failure (the node's reaper re-credits

@@ -155,14 +155,56 @@ def read_images(paths, size: Optional[tuple] = None,
     return _read_files(paths, reader)
 
 
+_CRC32C_TABLE: Optional[List[int]] = None
+
+
+try:  # a C-speed wheel when one exists; per-byte Python otherwise
+    from crc32c import crc32c as _crc32c_native  # type: ignore
+except ImportError:
+    try:
+        from google_crc32c import value as _crc32c_native  # type: ignore
+    except ImportError:
+        _crc32c_native = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli, reflected poly 0x82F63B78). `zlib.crc32` is
+    the WRONG polynomial (IEEE): TensorFlow verifies the length-CRC
+    unconditionally, so only real CRC32C interoperates. Uses a crc32c
+    wheel when installed; falls back to a table-driven pure-Python loop
+    (fine for small records, slow for MB-scale payloads)."""
+    if _crc32c_native is not None:
+        return _crc32c_native(data) & 0xFFFFFFFF
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        # NB: plain `range` here would hit this module's Dataset-factory
+        # `range()` shadowing the builtin.
+        table = []
+        for i in builtins.range(256):
+            c = i
+            for _ in builtins.range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    tab = _CRC32C_TABLE
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = tab[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
 def _tfrecord_crc(data: bytes) -> int:
-    """Masked CRC32C as the TFRecord format specifies. Pure-python CRC32C
-    (slow path) — records are small and framing integrity is the point."""
+    """Masked CRC32C exactly as the TFRecord spec defines it — files we
+    write round-trip through standard TFRecord readers and vice versa."""
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _tfrecord_crc_legacy(data: bytes) -> int:
+    """Masked crc32 (zlib) — what this repo's writer emitted before the
+    CRC32C fix; the reader still ACCEPTS it so old files stay readable."""
     import zlib
 
-    # crc32c unavailable in-image; use crc32 consistently on BOTH the
-    # write and read side of THIS implementation, and skip verification
-    # for records whose crc doesn't match either variant (foreign files).
     crc = zlib.crc32(data) & 0xFFFFFFFF
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
 
@@ -197,20 +239,20 @@ def read_tfrecords(paths, verify: bool = False) -> Dataset:
                         f"{path} (corrupt length field)")
                 if verify:
                     (want,) = _struct.unpack("<I", lcrc)
-                    if _tfrecord_crc(header) != want:
+                    if (_tfrecord_crc(header) != want
+                            and _tfrecord_crc_legacy(header) != want):
                         raise ValueError(
-                            f"TFRecord length-crc mismatch in {path} "
-                            f"(foreign crc32c files: pass verify=False)")
+                            f"TFRecord length-crc mismatch in {path}")
                 payload = f.read(length)
                 pcrc = f.read(4)
                 if len(payload) < length or len(pcrc) < 4:
                     raise ValueError(f"truncated TFRecord file {path}")
                 if verify:
                     (want,) = _struct.unpack("<I", pcrc)
-                    if _tfrecord_crc(payload) != want:
+                    if (_tfrecord_crc(payload) != want
+                            and _tfrecord_crc_legacy(payload) != want):
                         raise ValueError(
-                            f"TFRecord crc mismatch in {path} (foreign "
-                            f"crc32c files: pass verify=False)")
+                            f"TFRecord crc mismatch in {path}")
                 records.append(payload)
         return {"record": np.array(records, dtype=object)}
 

@@ -358,7 +358,20 @@ class ServeController:
                     record = get_core_worker().controller.call(
                         "get_actor", proxy.handle.actor_id.binary())
                 except Exception:
-                    continue
+                    # Actor table unavailable (head hiccup). Don't let that
+                    # pin a dead proxy forever: past a much higher failure
+                    # count, force-replace — but the kill must actually
+                    # LAND before we forget the handle (proxies bind a
+                    # fixed ingress port; a leaked live proxy would
+                    # EADDRINUSE every replacement). Until kill stops
+                    # raising, keep the record and retry next round.
+                    if proxy.failures < 10:
+                        continue
+                    try:
+                        ray_tpu.kill(proxy.handle)
+                    except Exception:
+                        continue
+                    record = None
                 if record is None or record["state"] == "DEAD":
                     with self._lock:
                         if self._proxies.get(node_hex) is proxy:
