@@ -358,24 +358,30 @@ class ServeController:
                     record = get_core_worker().controller.call(
                         "get_actor", proxy.handle.actor_id.binary())
                 except Exception:
-                    # Actor table unavailable (head hiccup). Don't let that
-                    # pin a dead proxy forever: past a much higher failure
-                    # count, force-replace — but the kill must actually
-                    # LAND before we forget the handle (proxies bind a
-                    # fixed ingress port; a leaked live proxy would
-                    # EADDRINUSE every replacement). Until kill stops
-                    # raising, keep the record and retry next round.
-                    if proxy.failures < 10:
-                        continue
-                    try:
-                        ray_tpu.kill(proxy.handle)
-                    except Exception:
-                        continue
-                    record = None
-                if record is None or record["state"] == "DEAD":
-                    with self._lock:
-                        if self._proxies.get(node_hex) is proxy:
-                            self._proxies.pop(node_hex)
+                    # Actor table unreachable: we can neither verify nor
+                    # replace (starting a proxy needs the head too), so
+                    # keep the record and retry next round — the normal
+                    # paths below take over the moment the head answers.
+                    continue
+                if record is not None and record["state"] != "DEAD":
+                    # Alive-but-unresponsive (healthz failing for many
+                    # rounds while the actor table says ALIVE — a hung
+                    # proxy): force-kill it, but DON'T forget the handle
+                    # yet. Proxies bind a fixed ingress port, so the
+                    # record may only be dropped once a later round
+                    # observes DEAD — popping a live process would
+                    # EADDRINUSE every replacement.
+                    if proxy.failures >= 10:
+                        try:
+                            ray_tpu.kill(proxy.handle)
+                        except Exception:
+                            pass
+                    continue
+                # No record, or DEAD: safe to forget and let the
+                # missing-node pass below start a replacement.
+                with self._lock:
+                    if self._proxies.get(node_hex) is proxy:
+                        self._proxies.pop(node_hex)
         # Missing nodes: start a proxy pinned to that node.
         with self._lock:
             have = set(self._proxies)
