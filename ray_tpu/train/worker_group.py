@@ -24,17 +24,23 @@ from ray_tpu.core.placement import (
 )
 
 
+class GangReservationError(ray_tpu.RayTpuError):
+    """The cluster cannot currently reserve the gang's placement group.
+    Retriable: callers (Tune) requeue the trial until resources free."""
+
+
 class TrainWorker:
     """Actor hosting one training process (one jax process per worker; on a
     pod slice, one worker per TPU-VM host)."""
 
     def __init__(self, world: Dict[str, Any], storage_path: Optional[str],
-                 experiment_name: str, latest_checkpoint: Optional[str]):
+                 experiment_name: str, latest_checkpoint: Optional[str],
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         from ray_tpu.train.session import TrainSession, WorldInfo, init_session
 
         self._session = TrainSession(
             WorldInfo(**world), storage_path, experiment_name,
-            latest_checkpoint)
+            latest_checkpoint, dataset_shards=dataset_shards)
         init_session(self._session)
         self._thread: Optional[threading.Thread] = None
 
@@ -159,24 +165,29 @@ class WorkerGroup:
             strategy=placement_strategy)
         if not self.pg.ready(timeout=60.0):
             remove_placement_group(self.pg)
-            raise ray_tpu.RayTpuError(
+            raise GangReservationError(
                 f"could not gang-reserve {num_workers} x {self.resources} "
                 f"(placement strategy {placement_strategy})")
         self.workers: List[Any] = []
         self._jax_bootstrapped = False
 
     def start(self, storage_path: Optional[str], experiment_name: str,
-              latest_checkpoint: Optional[str]) -> None:
+              latest_checkpoint: Optional[str],
+              dataset_shards_per_rank: Optional[List[Dict[str, Any]]] = None
+              ) -> None:
         actor_cls = ray_tpu.remote(TrainWorker)
         for rank in range(self.num_workers):
             world = {"world_rank": rank, "world_size": self.num_workers,
                      "local_rank": 0}
+            shards = (dataset_shards_per_rank[rank]
+                      if dataset_shards_per_rank else None)
             self.workers.append(actor_cls.options(
                 num_cpus=0,
                 resources=self.resources,
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     self.pg, rank),
-            ).remote(world, storage_path, experiment_name, latest_checkpoint))
+            ).remote(world, storage_path, experiment_name,
+                     latest_checkpoint, shards))
         if self.jax_config is not None and self.jax_config.distributed:
             self._bootstrap_jax()
 
