@@ -1,6 +1,10 @@
 """ray_tpu.rl: reinforcement learning at scale (reference: RLlib)."""
 
 from ray_tpu.rl.bc import BC, BCConfig, collect_dataset  # noqa: F401
+from ray_tpu.rl.checkpointing import (  # noqa: F401
+    Checkpointable,
+    as_trainable,
+)
 from ray_tpu.rl.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.offline import (  # noqa: F401

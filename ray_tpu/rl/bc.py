@@ -17,6 +17,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ray_tpu.rl.checkpointing import Checkpointable
+
 from ray_tpu.rl.common import ConfigBuilderMixin, probe_env_spec
 from ray_tpu.rl.models import build_policy
 
@@ -62,8 +64,10 @@ class BCConfig(ConfigBuilderMixin):
         return self
 
 
-class BC:
+class BC(Checkpointable):
     """Behavior cloning learner over a Dataset of {"obs", "actions"}."""
+
+    _CKPT_ATTRS = ("params", "opt_state", "_iteration")
 
     def __init__(self, config: BCConfig, dataset=None):
         import jax

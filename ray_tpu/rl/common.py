@@ -73,7 +73,8 @@ def make_env_runners(config) -> List[Any]:
             env_config=config.env_config,
             frame_stack=getattr(config, "frame_stack", 1),
             policy_mode=getattr(config, "policy_mode", "categorical"),
-            obs_connectors=getattr(config, "obs_connectors", None))
+            obs_connectors=getattr(config, "obs_connectors", None),
+            action_connectors=getattr(config, "action_connectors", None))
         for i in range(config.num_env_runners)
     ]
 

@@ -96,6 +96,91 @@ def apply_connectors(connectors: Optional[Sequence[Connector]],
     return obs
 
 
+# ------------------------------------------------- module-to-env (actions)
+
+
+class ActionConnector:
+    """Module-to-env connector: transforms the POLICY's raw action batch
+    ``(N, d)`` into what ``env.step`` expects (reference:
+    ``rllib/connectors/module_to_env/`` — unsquash/clip/rescale live here
+    so continuous-control support is structural, not per-policy hacks).
+    Rollout storage keeps the POLICY actions; only the env sees the
+    transformed ones."""
+
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class UnsquashAction(ActionConnector):
+    """[-1, 1]^d (tanh-squashed policies) -> the env's Box bounds."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32).reshape(-1)
+        self.high = np.asarray(high, np.float32).reshape(-1)
+        if not (np.isfinite(self.low).all() and np.isfinite(self.high).all()):
+            raise ValueError(
+                f"UnsquashAction needs finite bounds, got {low} / {high}")
+
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        a = np.clip(np.asarray(actions, np.float32), -1.0, 1.0)
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+
+class ClipAction(ActionConnector):
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32).reshape(-1)
+        self.high = np.asarray(high, np.float32).reshape(-1)
+
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        return np.clip(np.asarray(actions, np.float32), self.low, self.high)
+
+
+class RescaleAction(ActionConnector):
+    """Affine map: action * scale + shift (e.g. torque unit changes)."""
+
+    def __init__(self, scale: float = 1.0, shift: float = 0.0):
+        self.scale, self.shift = scale, shift
+
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        return np.asarray(actions, np.float32) * self.scale + self.shift
+
+
+# ------------------------------------------------------ learner pipeline
+
+
+class LearnerConnector:
+    """Learner-side connector: transforms the assembled train batch (dict
+    of arrays) before the update (reference: ``rllib/connectors/learner/``
+    — e.g. whole-batch advantage normalization)."""
+
+    def __call__(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+
+class NormalizeAdvantages(LearnerConnector):
+    """Zero-mean / unit-std advantages across the WHOLE train batch (the
+    reference's GeneralAdvantageEstimation learner connector ends with
+    exactly this normalization)."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.eps = eps
+
+    def __call__(self, batch: dict) -> dict:
+        adv = batch.get("advantages")
+        if adv is not None and len(adv):
+            batch = dict(batch)
+            batch["advantages"] = ((adv - adv.mean())
+                                   / (adv.std() + self.eps)).astype(
+                np.float32)
+        return batch
+
+
+def apply_learner_connectors(connectors, batch: dict) -> dict:
+    for c in connectors or []:
+        batch = c(batch)
+    return batch
+
+
 def validate_connectors(connectors: Iterable) -> List[Connector]:
     out = []
     for c in connectors:

@@ -25,7 +25,9 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ray_tpu.rl.checkpointing import Checkpointable
 from ray_tpu.rl.common import ConfigBuilderMixin
+from ray_tpu.rl.connectors import apply_connectors
 from ray_tpu.rl.models import (
     build_squashed_gaussian_actor,
     build_twin_q,
@@ -61,8 +63,13 @@ class CQLConfig(ConfigBuilderMixin):
         return self
 
 
-class CQL:
+class CQL(Checkpointable):
     """Offline learner over a transitions Dataset (no EnvRunners)."""
+
+    _CKPT_ATTRS = ("actor", "critic", "target_critic", "log_alpha",
+                   "actor_opt_state", "critic_opt_state",
+                   "alpha_opt_state", "_iteration", "_updates_done")
+    _CKPT_KEY_ATTRS = ("_key",)
 
     def __init__(self, config: CQLConfig, dataset=None):
         import gymnasium as gym
@@ -80,12 +87,17 @@ class CQL:
         obs_dim = int(np.prod(probe.observation_space.shape))
         self._action_dim = int(np.prod(probe.action_space.shape))
         self._action_shape = probe.action_space.shape
-        # Stored actions live in [-1, 1] (EnvRunner convention); rescale
-        # to env bounds only at evaluation time.
-        self._act_low = np.asarray(probe.action_space.low,
-                                   np.float32).reshape(-1)
-        self._act_high = np.asarray(probe.action_space.high,
-                                    np.float32).reshape(-1)
+        # Stored actions live in [-1, 1] (EnvRunner convention); the
+        # module-to-env connector chain maps them to the env's action
+        # space only at evaluation time (default: unsquash to bounds).
+        self._action_connectors = list(
+            getattr(config, "action_connectors", None) or [])
+        if not self._action_connectors:
+            from ray_tpu.rl.connectors import UnsquashAction
+
+            self._action_connectors = [UnsquashAction(
+                np.asarray(probe.action_space.low).reshape(-1),
+                np.asarray(probe.action_space.high).reshape(-1))]
         probe.close()
 
         k = jax.random.split(jax.random.key(config.seed), 2)
@@ -274,10 +286,12 @@ class CQL:
             done, total = False, 0.0
             while not done:
                 mean, _ = fwd(self.actor, jnp.asarray(obs)[None])
-                squashed = np.asarray(jnp.tanh(mean[0]))
-                action = (self._act_low + (squashed + 1.0) * 0.5
-                          * (self._act_high - self._act_low)
-                          ).reshape(self._action_shape)
+                squashed = np.asarray(jnp.tanh(mean))  # (1, d) policy batch
+                # Module-to-env mapping goes through the connector chain
+                # (default: unsquash to the env's bounds), same as runners.
+                action = np.asarray(apply_connectors(
+                    self._action_connectors, squashed))[0].reshape(
+                    self._action_shape)
                 obs, reward, term, trunc, _ = env.step(action)
                 total += float(reward)
                 done = term or trunc

@@ -18,6 +18,7 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.checkpointing import Checkpointable
 from ray_tpu.rl.common import (
     ConfigBuilderMixin,
     make_env_runners,
@@ -79,7 +80,11 @@ def rollout_to_transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return shared(ro, done_key="dones", action_dtype=np.int32)
 
 
-class DQN:
+class DQN(Checkpointable):
+    _CKPT_ATTRS = ("params", "target_params", "opt_state", "_iteration",
+                   "_total_env_steps", "_learner_steps")
+    _CKPT_BUFFER_ATTR = "buffer"
+
     def __init__(self, config: DQNConfig):
         import jax
         import optax

@@ -36,7 +36,8 @@ class EnvRunner:
                  env_config: Optional[Dict] = None,
                  frame_stack: int = 1,
                  policy_mode: str = "categorical",
-                 obs_connectors: Optional[list] = None):
+                 obs_connectors: Optional[list] = None,
+                 action_connectors: Optional[list] = None):
         import jax
 
         self._jax = jax
@@ -88,22 +89,27 @@ class EnvRunner:
         self._policy_mode = policy_mode
         self._epsilon = 1.0
         self._action_dim = None
+        self._action_connectors = list(action_connectors or [])
         if policy_mode == "continuous":
-            # Box actions: the policy emits [-1, 1]^d, rescaled to the
-            # env's bounds at step time (reference: SAC's squashed actions
-            # + action-space normalization connector).
+            # Box actions: the policy emits [-1, 1]^d; a module-to-env
+            # connector chain maps that to the env's action space
+            # (reference: SAC's squashed actions + the module_to_env
+            # unsquash connector). No chain given = the default unsquash
+            # to the env's bounds, which then must be finite.
             self._action_dim = int(np.prod(space.shape))
             self._action_shape = tuple(space.shape)
-            # Flattened bounds: the policy works in (N, prod(shape)); the
-            # env action reshapes back to (N,) + space.shape at step time.
-            self._act_low = np.asarray(space.low, np.float32).reshape(-1)
-            self._act_high = np.asarray(space.high, np.float32).reshape(-1)
-            if not (np.isfinite(self._act_low).all()
-                    and np.isfinite(self._act_high).all()):
-                raise ValueError(
-                    f"continuous policy_mode needs finite action bounds to "
-                    f"rescale [-1, 1] actions; got low={space.low} "
-                    f"high={space.high}")
+            if not self._action_connectors:
+                from ray_tpu.rl.connectors import UnsquashAction
+
+                try:
+                    self._action_connectors = [UnsquashAction(
+                        np.asarray(space.low).reshape(-1),
+                        np.asarray(space.high).reshape(-1))]
+                except ValueError as e:
+                    raise ValueError(
+                        f"continuous policy_mode needs finite action "
+                        f"bounds (or explicit action_connectors); "
+                        f"{e}") from None
             _init, actor_forward = build_squashed_gaussian_actor(
                 int(np.prod(self.obs.shape[1:])), self._action_dim)
             self._sample_fn = jax.jit(
@@ -120,6 +126,15 @@ class EnvRunner:
     def set_epsilon(self, eps: float) -> None:
         """Exploration rate for epsilon_greedy mode (DQN)."""
         self._epsilon = float(eps)
+
+    def get_connectors(self) -> list:
+        """Connector objects WITH their state (running normalization
+        statistics) — collected into algorithm checkpoints (reference:
+        per-EnvRunner ConnectorV2 state get/set)."""
+        return self._connectors
+
+    def set_connectors(self, connectors) -> None:
+        self._connectors = list(connectors or [])
 
     @property
     def obs_shape(self):
@@ -180,15 +195,12 @@ class EnvRunner:
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
             valid_buf[t] = 1.0 - self._prev_done.astype(np.float32)
+            env_action = self._apply_conn(self._action_connectors, action) \
+                if self._action_connectors else action
             if self._action_dim is not None:
-                # Policy actions live in [-1, 1]; the env wants its bounds
-                # and its native action shape.
-                env_action = (self._act_low
-                              + (action + 1.0) * 0.5
-                              * (self._act_high - self._act_low)
-                              ).reshape((len(action),) + self._action_shape)
-            else:
-                env_action = action
+                # The env wants its native action shape back.
+                env_action = np.asarray(env_action).reshape(
+                    (len(action),) + self._action_shape)
             obs, reward, terminated, truncated, _ = self.envs.step(
                 env_action)
             obs = self._apply_conn(self._connectors, obs)

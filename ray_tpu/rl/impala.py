@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.checkpointing import Checkpointable
 from ray_tpu.rl.common import (
     ConfigBuilderMixin,
     make_env_runners,
@@ -93,7 +94,10 @@ class IMPALAConfig(ConfigBuilderMixin):
         return IMPALA(self)
 
 
-class IMPALA:
+class IMPALA(Checkpointable):
+    _CKPT_ATTRS = ("params", "opt_state", "_iteration", "_updates",
+                   "_total_env_steps", "_steps_iter")
+
     def __init__(self, config: IMPALAConfig):
         import jax
         import optax

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.checkpointing import Checkpointable
 from ray_tpu.rl.models import build_policy
 from ray_tpu.rl.ppo import compute_gae, make_ppo_update
 
@@ -228,9 +229,12 @@ class MultiAgentPPOConfig:
         return MultiAgentPPO(self)
 
 
-class MultiAgentPPO:
+class MultiAgentPPO(Checkpointable):
     """Independent PPO over a policy map (reference: the multi-agent
     Algorithm path — MultiRLModule + per-module learner updates)."""
+
+    _CKPT_ATTRS = ("params", "opt_state", "_iteration",
+                   "_total_env_steps")
 
     def __init__(self, config: MultiAgentPPOConfig):
         import jax

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.checkpointing import Checkpointable
 from ray_tpu.rl.common import (
     ConfigBuilderMixin,
     make_env_runners,
@@ -131,7 +132,9 @@ def make_ppo_update(forward, optimizer, clip_eps: float, vf_coeff: float,
     return update
 
 
-class PPO:
+class PPO(Checkpointable):
+    _CKPT_ATTRS = ("params", "opt_state", "_iteration", "_total_env_steps")
+
     def __init__(self, config: PPOConfig):
         import jax
         import optax
@@ -200,6 +203,12 @@ class PPO:
         # Synthetic autoreset rows are not experience.
         keep = batch.pop("valids") > 0.5
         batch = {k: v[keep] for k, v in batch.items()}
+        # Learner-side connector pipeline (reference:
+        # rllib/connectors/learner/): whole-batch transforms before SGD.
+        from ray_tpu.rl.connectors import apply_learner_connectors
+
+        batch = apply_learner_connectors(
+            getattr(cfg, "learner_connectors", None), batch)
         n = len(batch["actions"])
         self._total_env_steps += n
 

@@ -133,3 +133,25 @@ class ReplayBuffer:
         p = np.abs(np.asarray(td_errors, np.float64)) + self.priority_eps
         self._max_priority = max(self._max_priority, float(p.max()))
         self._tree.set(idx, p ** self.alpha)
+
+    # --------------------------------------------------- checkpoint state
+
+    def state_dict(self, max_transitions: Optional[int] = None
+                   ) -> Dict[str, np.ndarray]:
+        """The newest ``max_transitions`` transitions in insertion order
+        (None = everything). Priorities are not persisted: restored
+        experience re-enters at max priority, exactly like fresh
+        experience (reference: replay checkpointing keeps content, and
+        one pass of TD updates re-establishes the priority profile)."""
+        if self._storage is None or self._size == 0:
+            return {"batch": None}
+        n = self._size if max_transitions is None \
+            else min(self._size, int(max_transitions))
+        idx = (self._next - n + np.arange(n)) % self.capacity
+        return {"batch": {k: v[idx].copy()
+                          for k, v in self._storage.items()}}
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        batch = state.get("batch")
+        if batch is not None and len(next(iter(batch.values()))):
+            self.add(batch)

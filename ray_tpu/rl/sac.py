@@ -18,6 +18,7 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.checkpointing import Checkpointable
 from ray_tpu.rl.common import ConfigBuilderMixin, make_env_runners, stop_runners
 from ray_tpu.rl.models import (
     build_squashed_gaussian_actor,
@@ -51,7 +52,13 @@ class SACConfig(ConfigBuilderMixin):
         return SAC(self)
 
 
-class SAC:
+class SAC(Checkpointable):
+    _CKPT_ATTRS = ("actor", "critic", "target_critic", "log_alpha",
+                   "actor_opt_state", "critic_opt_state",
+                   "alpha_opt_state", "_iteration", "_total_env_steps")
+    _CKPT_KEY_ATTRS = ("_key",)
+    _CKPT_BUFFER_ATTR = "buffer"
+
     def __init__(self, config: SACConfig):
         import gymnasium as gym
         import jax
