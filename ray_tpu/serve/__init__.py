@@ -12,6 +12,10 @@ from ray_tpu.serve.api import (  # noqa: F401
     stop_http,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.decode import (  # noqa: F401
+    DecodeEngine,
+    LlamaDecodeDeployment,
+)
 from ray_tpu.serve.build import deploy_config  # noqa: F401
 from ray_tpu.serve.deployment import (  # noqa: F401
     AutoscalingConfig,

@@ -1,0 +1,139 @@
+"""Decode serving: continuous batching correctness + streaming generation
+through the serve stack (VERDICT r4 Missing #2 / Next #3; reference:
+replica call path ``serve/_private/replica.py:231`` + streaming
+``proxy.py:761`` — here the engine owns the KV cache and jitted programs).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def _tiny():
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=61, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, mlp_dim=64, max_seq_len=128)
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield ray_start_regular
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+
+
+def test_continuous_batching_matches_solo_generate():
+    """Requests of different lengths decoded TOGETHER produce exactly what
+    each produces alone (greedy): per-slot length masking is exact."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    prompts = [[5, 9, 2], [7], [11, 3, 4, 8, 1]]
+    solo = [np.asarray(llama_decode.generate(
+        params, np.array([p], np.int32), cfg, max_new_tokens=6))[0]
+        for p in prompts]
+
+    eng = DecodeEngine(params, cfg, slots=4, capacity=64)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    for _ in range(40):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    assert all(r.done.is_set() for r in reqs)
+    for req, want in zip(reqs, solo):
+        assert req.output == list(want), (req.output, list(want))
+
+
+def test_request_joins_mid_stream():
+    """A request submitted while another is mid-decode joins the running
+    batch (continuous batching) and still matches its solo output."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=2, capacity=64)
+    first = eng.submit([3, 1, 4], max_new_tokens=10)
+    for _ in range(4):
+        eng.step()
+    assert not first.done.is_set()
+    late = eng.submit([9, 9], max_new_tokens=4)
+    for _ in range(30):
+        if first.done.is_set() and late.done.is_set():
+            break
+        eng.step()
+    solo_first = np.asarray(llama_decode.generate(
+        params, np.array([[3, 1, 4]], np.int32), cfg,
+        max_new_tokens=10))[0]
+    solo_late = np.asarray(llama_decode.generate(
+        params, np.array([[9, 9]], np.int32), cfg, max_new_tokens=4))[0]
+    assert first.output == list(solo_first)
+    assert late.output == list(solo_late)
+    # Slots recycled.
+    assert eng.stats()["free_slots"] == 2
+
+
+def test_more_requests_than_slots_queue_and_finish():
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=2, capacity=64)
+    reqs = [eng.submit([i + 1], max_new_tokens=3) for i in range(5)]
+    for _ in range(60):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    assert all(r.done.is_set() for r in reqs)
+    assert all(len(r.output) == 3 for r in reqs)
+
+
+@pytest.mark.timeout_s(240)
+def test_streaming_generation_through_serve(serve_cluster):
+    """Tokens stream through the per-node proxy as the engine emits them:
+    deployment -> replica stream session -> HTTP chunked response."""
+    import urllib.request
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.decode import LlamaDecodeDeployment
+
+    cfg = llama.LlamaConfig(vocab_size=61, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, mlp_dim=64, max_seq_len=128)
+    serve.run(
+        serve.deployment(LlamaDecodeDeployment).options(
+            max_concurrency=4).bind(config=cfg, slots=2, capacity=64),
+        name="llm")
+    handle = serve.get_deployment_handle("llm")
+
+    # Unary path: full generation in one reply (+ TTFT measured).
+    out = handle.remote({"tokens": [5, 9, 2],
+                         "max_new_tokens": 5}).result(timeout=120)
+    assert len(out["tokens"]) == 5
+    assert out["ttft_s"] >= 0
+
+    # Handle streaming path.
+    toks = list(handle.stream({"tokens": [5, 9, 2], "max_new_tokens": 5,
+                               "stream": True}))
+    assert toks == out["tokens"]  # greedy == deterministic
+
+    # HTTP chunked streaming through the per-node proxy.
+    host, port = serve.start_http()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/llm",
+        data=json.dumps({"tokens": [5, 9, 2], "max_new_tokens": 5,
+                         "stream": True}).encode(),
+        headers={"X-Serve-Stream": "1"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        lines = [json.loads(ln) for ln in resp.read().splitlines() if ln]
+    assert lines == out["tokens"]
