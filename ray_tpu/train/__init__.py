@@ -14,6 +14,7 @@ from ray_tpu.train.config import (  # noqa: F401
 )
 from ray_tpu.train.session import (  # noqa: F401
     get_checkpoint,
+    get_dataset_shard,
     get_session,
     get_world_rank,
     get_world_size,

@@ -33,7 +33,8 @@ class WorldInfo:
 class TrainSession:
     def __init__(self, world: WorldInfo, storage_path: Optional[str],
                  experiment_name: str,
-                 latest_checkpoint: Optional[str] = None):
+                 latest_checkpoint: Optional[str] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.world = world
         self.storage_path = storage_path
         self.experiment_name = experiment_name
@@ -42,6 +43,9 @@ class TrainSession:
         self.iteration = 0
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # This worker's per-rank DataIterators (reference:
+        # session.get_dataset_shard / streaming_split ingest).
+        self.dataset_shards: Dict[str, Any] = dataset_shards or {}
 
     # -------------------------------------------------------------- api
 
@@ -104,6 +108,19 @@ def report(metrics: Dict[str, Any],
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's DataIterator for ``JaxTrainer(datasets={name: ds})``
+    (reference: ``ray.train.get_dataset_shard`` — each worker pulls its
+    own streaming split; pair with ``iter_device_batches(mesh=...)`` for
+    prefetched, mesh-sharded device batches)."""
+    shards = get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; pass datasets={{{name!r}: ds}} "
+            f"to JaxTrainer (have: {sorted(shards)})")
+    return shards[name]
 
 
 def get_world_rank() -> int:

@@ -47,14 +47,31 @@ class JaxTrainer:
         train_loop_config: Optional[Dict] = None,
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
         result_callback: Optional[Callable[[Dict], None]] = None,
     ):
         self._train_fn = train_loop_per_worker
         self._config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # name -> ray_tpu.data.Dataset; each attempt re-splits into one
+        # streaming shard per worker, consumed via
+        # ``train.get_dataset_shard(name)`` (reference:
+        # DataParallelTrainer datasets + data_config.py ingest).
+        self._datasets = datasets
         self._callback = result_callback
         self._name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+
+    def dataset_shards_per_rank(self) -> Optional[List[Dict[str, Any]]]:
+        """Fresh streaming splits, one dict of shards per worker rank
+        (fresh per attempt/trial: a DataIterator is single-consumption)."""
+        if not self._datasets:
+            return None
+        n = self.scaling_config.num_workers
+        split = {name: ds.streaming_split(n)
+                 for name, ds in self._datasets.items()}
+        return [{name: its[rank] for name, its in split.items()}
+                for rank in range(n)]
 
     def fit(self) -> Result:
         from ray_tpu import usage as _usage
@@ -112,7 +129,9 @@ class JaxTrainer:
         try:
             try:
                 group.start(self.run_config.storage_path, self._name,
-                            latest_checkpoint)
+                            latest_checkpoint,
+                            dataset_shards_per_rank=(
+                                self.dataset_shards_per_rank()))
                 group.run(self._train_fn, self._config, fn_blob=fn_blob)
             except _AttemptFailed:
                 raise
