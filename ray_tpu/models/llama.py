@@ -331,7 +331,16 @@ def hidden_states(params: Dict[str, Any], tokens: jax.Array,
         x = _embed_matmul(params["tok_embed"].astype(c.dtype), tokens,
                           chunk=c.embed_chunk)
     else:
-        x = params["tok_embed"].astype(c.dtype)[tokens]
+        # All-gather the table BEFORE the lookup: left to itself XLA
+        # gathers from the fsdp-sharded table and then cannot convert the
+        # embed-sharded output to batch sharding on permuted-order meshes
+        # (expert/dcn/multi-process) — spmd_partitioner falls back to
+        # "Involuntary full rematerialization", replicating the whole
+        # activation every step. One explicit table all-gather is the
+        # cheap, local-lookup form of the same data movement.
+        table = constrain(params["tok_embed"].astype(c.dtype),
+                          (None, None))
+        x = table[tokens]
     x = constrain(x, ("batch", "length", "act_embed"))
     cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
 
