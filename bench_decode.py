@@ -1,0 +1,220 @@
+"""Decode serving benchmark: KV-cache generation rows for BENCH_SERVE.json
+(VERDICT r4 Next #3 — "Re-measure BENCH_SERVE with decode tokens/s and
+per-token p50").
+
+Measures on the attached chip, 160M-param Llama:
+
+  1. engine-direct continuous batching (slots=16): decode tokens/s,
+     inter-token p50/p99, TTFT p50 — per-token steps (decode_chunk=1);
+  2. same with decode_chunk=8 (K greedy steps per device call): the
+     dispatch-floor amortization row (this rig has a ~60 ms tunnel floor
+     per device call, so chunking is the serving lever here);
+  3. the full serve stack: deployment replica + handle, closed-loop
+     clients requesting generation (streamed tokens).
+
+Appends/replaces the decode rows in BENCH_SERVE.json, preserving the
+prefill rows. Run: ``python bench_decode.py [--quick]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import threading
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_bench_jax_cache")
+
+
+def pctl(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+def engine_rows(params, cfg, quick: bool):
+    from ray_tpu.serve.decode import DecodeEngine
+
+    import numpy as np
+
+    slots = 4 if quick else 16
+    prompt_len = 16 if quick else 64
+    gen = 16 if quick else 64
+    n_requests = 8 if quick else 64
+    rows = []
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    for chunk in (1, 8):
+        eng = DecodeEngine(params, cfg, slots=slots,
+                           capacity=256, decode_chunk=chunk)
+        # Warm every program before timing: each admission batch size
+        # (n = 1..slots, powers of two), the decode step, and (for
+        # chunked mode) the whole k ladder — a solo request's
+        # remaining-count walks down through all of k=chunk..1.
+        w = eng.submit(prompts[0], max_new_tokens=max(2, 2 * chunk))
+        while not w.done.is_set():
+            eng.step()
+        n_warm = 2
+        while n_warm <= slots:
+            burst = [eng.submit(prompts[i % len(prompts)],
+                                max_new_tokens=1) for i in range(n_warm)]
+            while not all(b.done.is_set() for b in burst):
+                eng.step()
+            n_warm *= 2
+
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, max_new_tokens=gen)
+                for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            if eng.step() == 0:
+                time.sleep(0.001)
+        wall = time.monotonic() - t0
+        total_tokens = sum(len(r.output) for r in reqs)
+        # Per-token latency per request: stream duration / tokens (robust
+        # to chunked emission's bursts, which make raw gaps bimodal).
+        per_tok = [1e3 * (r.finished_at - r.first_token_at)
+                   / max(1, len(r.output) - 1) for r in reqs
+                   if len(r.output) > 1]
+        ttfts = [1e3 * (r.first_token_at - r.submitted_at) for r in reqs]
+        rows.append({
+            "metric": f"decode_tokens_per_s_chunk{chunk}",
+            "value": round(total_tokens / wall, 1),
+            "unit": "tokens/s",
+            "note": (f"{n_requests} reqs x {gen} new tokens, prompt "
+                     f"{prompt_len}, {slots} slots continuous batching, "
+                     f"decode_chunk={chunk}; wall {wall:.1f}s"),
+        })
+        rows.append({
+            "metric": f"decode_per_token_p50_chunk{chunk}",
+            "value": round(pctl(per_tok, 0.5), 1) if per_tok else None,
+            "unit": "ms",
+            "note": (f"per-request stream duration/token; p99="
+                     f"{pctl(per_tok, 0.99):.1f}ms; TTFT p50="
+                     f"{pctl(ttfts, 0.5):.0f}ms (includes queueing — "
+                     f"{n_requests} reqs over {slots} slots)"
+                     if per_tok else ""),
+        })
+        eng.shutdown()
+    return rows
+
+
+def serve_stack_row(cfg, quick: bool):
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.decode import LlamaDecodeDeployment
+
+    import numpy as np
+
+    gen = 8 if quick else 32
+    clients = 2 if quick else 8
+    duration = 5 if quick else 20
+    dep = serve.deployment(LlamaDecodeDeployment).options(
+        max_ongoing_requests=64, max_concurrency=32,
+        ray_actor_options=(
+            {} if quick else {"resources": {"TPU": 1.0}}),
+    ).bind(config=cfg, slots=4 if quick else 16, capacity=256,
+           decode_chunk=8)
+    serve.run(dep, name="llm_decode")
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if serve.status().get("llm_decode", {}).get("replicas", 0) >= 1:
+            break
+        time.sleep(0.5)
+    handle = serve.get_deployment_handle("llm_decode")
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, 16 if quick else 64).tolist()
+    # Warm (retry through the replica-registration race).
+    for _ in range(120):
+        try:
+            handle.remote({"tokens": prompt, "max_new_tokens": 2}).result(
+                timeout=300)
+            break
+        except RuntimeError:
+            time.sleep(1.0)
+
+    stop = time.monotonic() + duration
+    lat, tokens = [], [0]
+    lock = threading.Lock()
+
+    def client():
+        while time.monotonic() < stop:
+            t0 = time.monotonic()
+            out = handle.remote({"tokens": prompt,
+                                 "max_new_tokens": gen}).result(
+                timeout=300)
+            dt = time.monotonic() - t0
+            with lock:
+                lat.append(dt * 1e3)
+                tokens[0] += len(out["tokens"])
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    row = {
+        "metric": "decode_serve_stack_tokens_per_s",
+        "value": round(tokens[0] / wall, 1),
+        "unit": "tokens/s",
+        "note": (f"{clients} closed-loop clients x {gen} new tokens/req "
+                 f"through controller-routed handle, {len(lat)} reqs, "
+                 f"req p50={pctl(lat, 0.5):.0f}ms "
+                 f"p99={pctl(lat, 0.99):.0f}ms"),
+    }
+    serve.shutdown()
+    return [row]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.quick:
+        # Env var too: serve replica workers inherit it at fork, so the
+        # whole quick path (driver + replicas) stays on CPU.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import ray_tpu
+    from ray_tpu.models import llama
+
+    cfg = llama.PRESETS["debug"] if args.quick else llama.PRESETS["160m"]
+    params = llama.init_params(cfg, jax.random.key(0))
+
+    rows = engine_rows(params, cfg, args.quick)
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        rows += serve_stack_row(cfg, args.quick)
+    finally:
+        ray_tpu.shutdown()
+
+    out_path = "BENCH_SERVE.json"
+    doc = {"artifact": "BENCH_SERVE", "rows": []}
+    if os.path.exists(out_path) and not args.quick:
+        with open(out_path) as f:
+            doc = json.load(f)
+        doc["rows"] = [r for r in doc["rows"]
+                       if not r["metric"].startswith("decode_")]
+    if args.quick:
+        out_path = "/tmp/bench_decode_quick.json"
+    doc.setdefault("decode_model",
+                   "llama-160m, KV-cache continuous batching "
+                   "(serve/decode.py), bf16")
+    doc["rows"] = doc.get("rows", []) + rows
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,12 @@ class ViTConfig:
     # None = full remat; "dots" keeps matmul outputs (recompute only the
     # cheap elementwise work — more memory, fewer recomputed FLOPs).
     remat_policy: Any = None
+    # Pad the token axis to this length inside the model (masked, exact):
+    # ViT-B/16's 196 tokens ride 8x128 MXU tiles badly (1.53 lane tiles);
+    # 256 tiles perfectly. Padded tokens get zero attention weight and
+    # are excluded from the pool, so the math is unchanged — only the
+    # tiling improves. None = no padding.
+    pad_tokens_to: Optional[int] = None
 
     @property
     def num_patches(self) -> int:
@@ -149,7 +155,7 @@ def patchify(images: jax.Array, config: ViTConfig) -> jax.Array:
     return x.reshape(b, (h // p) * (w // p), p * p * ch)
 
 
-def _block(x, layer, c: ViTConfig):
+def _block(x, layer, c: ViTConfig, n_valid: Optional[int] = None):
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
     h = constrain(h, ("batch", "length", "act_embed"))
     qkv = jnp.einsum("bne,ehd->bnhd", h, layer["wqkv"].astype(c.dtype))
@@ -157,7 +163,8 @@ def _block(x, layer, c: ViTConfig):
     q = constrain(q, ("batch", "length", "heads", "head_dim"))
     from ray_tpu.ops.attention import attention
 
-    out = attention(q, k, v, causal=False)  # scale applied in the kernel
+    # scale applied in the kernel; tile-padding keys masked out
+    out = attention(q, k, v, causal=False, kv_valid=n_valid)
     out = jnp.einsum("bnhd,hde->bne", out, layer["wo"].astype(c.dtype))
     x = x + constrain(out, ("batch", "length", "act_embed"))
 
@@ -178,12 +185,20 @@ def forward(params: Dict[str, Any], images: jax.Array,
     x = jnp.einsum("bnp,pe->bne", patches,
                    params["patch_embed"].astype(c.dtype))
     x = x + params["pos_embed"].astype(c.dtype)
+    n_tokens = x.shape[1]
+    n_valid = None
+    if c.pad_tokens_to and c.pad_tokens_to > n_tokens:
+        # Tile-friendly token padding (masked, exact — see the config
+        # field). Padded rows carry zeros; attention masks them as keys
+        # and the pool slices them off, so only the MXU tiling changes.
+        x = jnp.pad(x, ((0, 0), (0, c.pad_tokens_to - n_tokens), (0, 0)))
+        n_valid = n_tokens
     x = constrain(x, ("batch", "length", "act_embed"))
 
     def body(carry, layer):
         layer = {k: v.astype(c.dtype) if v.dtype == jnp.float32 else v
                  for k, v in layer.items()}
-        return _block(carry, layer, c), None
+        return _block(carry, layer, c, n_valid), None
 
     if c.remat and c.remat_policy == "dots":
         scan_body = jax.checkpoint(
@@ -194,6 +209,7 @@ def forward(params: Dict[str, Any], images: jax.Array,
     else:
         scan_body = body
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = x[:, :n_tokens]
     x = _layer_norm(x, params["final_ln_scale"].astype(c.dtype),
                     params["final_ln_bias"].astype(c.dtype))
     pooled = jnp.mean(x, axis=1)  # mean-pool head

@@ -43,14 +43,18 @@ def attention(
     kv_offset: int = 0,              # global position of k[0]
     impl: str = "xla",
     chunk_size: int = 512,
+    kv_valid: Optional[int] = None,  # keys >= this are masked (tile pad)
 ) -> jax.Array:
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
     if impl == "xla":
-        out, _ = _attention_xla(q, k, v, causal, q_offset, kv_offset)
+        out, _ = _attention_xla(q, k, v, causal, q_offset, kv_offset,
+                                kv_valid)
         return out
     if impl == "chunked":
+        if kv_valid is not None:
+            raise ValueError("kv_valid is only supported by impl='xla'")
         out, _ = _attention_chunked(q, k, v, causal, q_offset, kv_offset,
                                     chunk_size)
         return out
@@ -102,7 +106,7 @@ def _mask(sq: int, sk: int, q_offset, kv_offset) -> jax.Array:
     return q_pos >= k_pos
 
 
-def _attention_xla(q, k, v, causal, q_offset, kv_offset):
+def _attention_xla(q, k, v, causal, q_offset, kv_offset, kv_valid=None):
     """Returns (out, (max, sumexp)) — the softmax stats make this directly
     composable into ring attention's cross-shard combine."""
     d = q.shape[-1]
@@ -112,6 +116,12 @@ def _attention_xla(q, k, v, causal, q_offset, kv_offset):
     if causal:
         mask = _mask(q.shape[1], k.shape[1], q_offset, kv_offset)
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    if kv_valid is not None and kv_valid < k.shape[1]:
+        # Static tail mask: tile-padding tokens must get zero softmax
+        # weight from every real query (exactness of padded shapes).
+        alive = jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, 1, k.shape[1]), 3) < kv_valid
+        scores = jnp.where(alive, scores, _NEG_INF)
     m = jnp.max(scores, axis=-1, keepdims=True)
     # Fully masked rows (ring attention shards ahead of the causal frontier)
     # must contribute zero, not NaN.

@@ -60,7 +60,8 @@ class DecodeEngine:
     replica, or drive ``step()`` manually in tests."""
 
     def __init__(self, params, config, slots: int = 4,
-                 capacity: int = 1024, prefill_bucket: int = 128):
+                 capacity: int = 1024, prefill_bucket: int = 128,
+                 decode_chunk: int = 1):
         import jax
 
         from ray_tpu.models import llama_decode as ld
@@ -84,34 +85,46 @@ class DecodeEngine:
         # shared cache. Donating the cache makes the slot insert in-place.
         # Params are ARGUMENTS (not closure captures), or jit would bake
         # the weights into the program as constants.
-        self._prefill_one = jax.jit(
-            self._prefill_one_impl, static_argnames=("bucket",),
+        self._prefill_many = jax.jit(
+            self._prefill_many_impl, static_argnames=("n", "bucket"),
             donate_argnums=(1,))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        # K greedy steps per device call (dispatch amortization); chunking
+        # only engages when no admissions are pending and every active
+        # request is greedy — sampling and joins stay per-token exact.
+        self.decode_chunk = max(1, int(decode_chunk))
+        self._decode_k = jax.jit(self._decode_chunk_impl,
+                                 static_argnames=("k",),
+                                 donate_argnums=(1,))
         self.steps = 0
         self.tokens_out = 0
 
     # ------------------------------------------------------ jitted bodies
 
-    def _prefill_one_impl(self, params, cache, tokens_row, length, slot,
-                          bucket):
-        from jax import lax
-
+    def _prefill_many_impl(self, params, cache, tokens_rows, lengths,
+                           slot_ids, n, bucket):
+        """Batched admission: prefill ``n`` rows in ONE device call and
+        scatter their K/V into the shared cache at ``slot_ids``. One
+        compiled program per (n, bucket) power-of-two pair — dispatch
+        overhead amortizes over the whole admission wave."""
         ld, cfg = self._ld, self.config
-        one = ld.init_cache(cfg, 1, self.capacity)
-        logits, one = ld.prefill(params, tokens_row[None, :bucket],
-                                 one, cfg, lengths=length[None])
+        batch = ld.init_cache(cfg, n, self.capacity)
+        logits, batch = ld.prefill(params, tokens_rows[:, :bucket],
+                                   batch, cfg, lengths=lengths)
+        s = batch["k"].shape[2]
         new = {
-            "k": lax.dynamic_update_slice(
-                cache["k"], one["k"], (0, slot, 0, 0, 0)),
-            "v": lax.dynamic_update_slice(
-                cache["v"], one["v"], (0, slot, 0, 0, 0)),
-            "length": cache["length"].at[slot].set(length),
+            "k": cache["k"].at[:, slot_ids, :s].set(batch["k"]),
+            "v": cache["v"].at[:, slot_ids, :s].set(batch["v"]),
+            "length": cache["length"].at[slot_ids].set(lengths),
         }
-        return logits[0], new
+        return logits, new
 
     def _decode_impl(self, params, cache, tokens):
         return self._ld.decode_step(params, cache, tokens, self.config)
+
+    def _decode_chunk_impl(self, params, cache, tokens, k):
+        return self._ld.decode_chunk(params, cache, tokens, self.config,
+                                     k)
 
     # ------------------------------------------------------------ intake
 
@@ -137,28 +150,56 @@ class DecodeEngine:
 
         ld = self._ld
         while self._free and not self._pending.empty():
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
+            # Drain up to len(free) pending requests and prefill them as
+            # ONE batched device call per prompt bucket.
+            wave: List[_Request] = []
+            while len(wave) < len(self._free):
+                try:
+                    wave.append(self._pending.get_nowait())
+                except queue.Empty:
+                    break
+            if not wave:
                 return
-            slot = self._free.pop()
-            n = len(req.tokens)
-            bucket = min(ld.cache_bucket(n, self.prefill_bucket),
-                         self.capacity)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:n] = req.tokens
-            logits, self.cache = self._prefill_one(
-                self.params, self.cache, jnp.asarray(padded),
-                jnp.asarray(n, jnp.int32), slot, bucket=bucket)
-            tok = self._sample_host(np.asarray(logits), req)
-            req.slot = slot
-            req.first_token_at = time.monotonic()
-            self._emit(req, tok)
-            self._tokens[slot] = tok
-            self._active[slot] = req
-            if req.generated >= req.max_new_tokens or (
-                    req.eos_id is not None and tok == req.eos_id):
-                self._finish(slot)
+            by_bucket: Dict[int, List[_Request]] = {}
+            for req in wave:
+                bucket = min(ld.cache_bucket(len(req.tokens),
+                                             self.prefill_bucket),
+                             self.capacity)
+                by_bucket.setdefault(bucket, []).append(req)
+            for bucket, reqs in by_bucket.items():
+                slots = [self._free.pop() for _ in reqs]
+                # Pad the admission count to a power of two (bounded
+                # program set); pad rows REPEAT the last real row into
+                # the same slot — an idempotent overwrite.
+                n = 1
+                while n < len(reqs):
+                    n *= 2
+                rows = np.zeros((n, bucket), np.int32)
+                lengths = np.zeros((n,), np.int32)
+                slot_ids = np.full((n,), slots[-1], np.int32)
+                for i, req in enumerate(reqs):
+                    rows[i, :len(req.tokens)] = req.tokens
+                    lengths[i] = len(req.tokens)
+                    slot_ids[i] = slots[i]
+                for i in range(len(reqs), n):  # idempotent pad rows
+                    rows[i] = rows[len(reqs) - 1]
+                    lengths[i] = lengths[len(reqs) - 1]
+                logits, self.cache = self._prefill_many(
+                    self.params, self.cache, jnp.asarray(rows),
+                    jnp.asarray(lengths), jnp.asarray(slot_ids),
+                    n=n, bucket=bucket)
+                logits = np.asarray(logits)
+                now = time.monotonic()
+                for i, req in enumerate(reqs):
+                    tok = self._sample_host(logits[i], req)
+                    req.slot = slots[i]
+                    req.first_token_at = now
+                    self._emit(req, tok)
+                    self._tokens[slots[i]] = tok
+                    self._active[slots[i]] = req
+                    if req.generated >= req.max_new_tokens or (
+                            req.eos_id is not None and tok == req.eos_id):
+                        self._finish(slots[i])
 
     def _sample_host(self, logits: np.ndarray, req: _Request) -> int:
         if req.temperature <= 0.0:
@@ -198,6 +239,39 @@ class DecodeEngine:
         if not self._active:
             return 0
         stepped = len(self._active)
+        chunk = 1
+        # Chunking engages when the batch can't change mid-chunk anyway
+        # (no free slot for a pending request) or nothing is waiting.
+        if (self.decode_chunk > 1
+                and (self._pending.empty() or not self._free)
+                and all(r.temperature <= 0.0
+                        for r in self._active.values())):
+            chunk = min(self.decode_chunk,
+                        min(r.max_new_tokens - r.generated
+                            for r in self._active.values()))
+            # Round down to a power of two: each distinct k is its own
+            # compiled program, so the program set must stay bounded
+            # ({1, 2, 4, ..., decode_chunk}), not one per remaining-count.
+            while chunk & (chunk - 1):
+                chunk &= chunk - 1
+        if chunk > 1:
+            toks, self.cache = self._decode_k(
+                self.params, self.cache, jnp.asarray(self._tokens),
+                k=chunk)
+            toks = np.asarray(toks)  # (chunk, slots)
+            self.steps += chunk
+            for slot in list(self._active):
+                req = self._active[slot]
+                for i in range(chunk):
+                    tok = int(toks[i, slot])
+                    self._emit(req, tok)
+                    self._tokens[slot] = tok
+                    if req.generated >= req.max_new_tokens or (
+                            req.eos_id is not None
+                            and tok == req.eos_id):
+                        self._finish(slot)
+                        break
+            return stepped
         logits, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(self._tokens))
         logits = np.asarray(logits)
@@ -246,7 +320,7 @@ class LlamaDecodeDeployment:
 
     def __init__(self, preset: str = "debug", slots: int = 4,
                  capacity: int = 1024, seed: int = 0,
-                 config=None):
+                 config=None, decode_chunk: int = 1):
         import jax
 
         from ray_tpu.models import llama
@@ -255,7 +329,8 @@ class LlamaDecodeDeployment:
         self.cfg = cfg
         params = llama.init_params(cfg, jax.random.key(seed))
         self.engine = DecodeEngine(params, cfg, slots=slots,
-                                   capacity=capacity)
+                                   capacity=capacity,
+                                   decode_chunk=decode_chunk)
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()

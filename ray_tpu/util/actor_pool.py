@@ -16,6 +16,7 @@ class ActorPool:
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
         self._future_to_actor = {}
+        self._future_to_index = {}  # O(1) unordered pops (no ref scan)
         self._index_to_future = {}
         self._next_task_index = 0
         self._next_return_index = 0
@@ -26,15 +27,21 @@ class ActorPool:
         actor = self._idle.pop(0)
         ref = fn(actor, value)
         self._future_to_actor[ref] = actor
+        self._future_to_index[ref] = self._next_task_index
         self._index_to_future[self._next_task_index] = ref
         self._next_task_index += 1
 
     def has_next(self) -> bool:
-        return self._next_return_index < self._next_task_index
+        return bool(self._index_to_future)
 
     def get_next(self, timeout=None) -> Any:
-        """Next result in submission order."""
+        """Next result in submission order (skipping indices already
+        consumed by ``get_next_unordered``)."""
+        while (self._next_return_index < self._next_task_index
+               and self._next_return_index not in self._index_to_future):
+            self._next_return_index += 1
         ref = self._index_to_future.pop(self._next_return_index)
+        self._future_to_index.pop(ref, None)
         self._next_return_index += 1
         value = ray_tpu.get(ref, timeout=timeout)
         self._idle.append(self._future_to_actor.pop(ref))
@@ -46,11 +53,8 @@ class ActorPool:
         if not ready:
             raise TimeoutError("no result ready")
         ref = ready[0]
-        for idx, fut in list(self._index_to_future.items()):
-            if fut == ref:
-                del self._index_to_future[idx]
-                if idx == self._next_return_index:
-                    self._next_return_index += 1
+        idx = self._future_to_index.pop(ref)  # O(1): ref -> index map
+        self._index_to_future.pop(idx, None)
         value = ray_tpu.get(ref)
         self._idle.append(self._future_to_actor.pop(ref))
         return value

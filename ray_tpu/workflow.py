@@ -18,6 +18,18 @@ DAG plus a storage contract:
 Step identity: a content hash of the step's position in the graph + the
 function's qualified name, so the same graph resumes onto the same step
 files (the reference keys steps the same way, by step id in storage).
+
+Dynamic workflows (reference: ``workflow/workflow_executor.py``
+continuations): a step may return ``workflow.continuation(sub_dag)`` — the
+engine executes the returned DAG in the step's place, durably, with the
+sub-steps keyed under the parent step (resume replays finished sub-steps
+from storage; the parent must re-return the same continuation shape, the
+reference's determinism contract). Events (reference:
+``workflow/event_listener.py``): ``workflow.event(listener)`` is a DAG
+node that blocks until the listener's ``poll()`` yields a payload; the
+payload persists like a step result, so a resumed workflow never re-waits
+for an event it already consumed. Virtual actors are deliberately out of
+scope (deprecated upstream).
 """
 
 from __future__ import annotations
@@ -35,6 +47,54 @@ def _step_key(node: DAGNode, path: str) -> str:
     fn = getattr(node.fn, "_fn", node.fn)
     name = getattr(fn, "__qualname__", str(fn))
     return hashlib.sha1(f"{path}:{name}".encode()).hexdigest()[:16]
+
+
+class Continuation:
+    """A step's dynamic return: 'execute THIS graph in my place'. Capture
+    happens step-side (``workflow.continuation(dag)``) so the graph ships
+    home as a plain picklable record."""
+
+    def __init__(self, dag: DAGNode):
+        self.record = _make_picklable(dag)
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    return Continuation(dag)
+
+
+class EventListener:
+    """Poll-based external event source (reference:
+    ``workflow/event_listener.py``). ``poll()`` returns None while the
+    event is absent, or the (picklable) payload once it fired. Listeners
+    must be picklable — they persist in the workflow graph."""
+
+    def poll(self):
+        raise NotImplementedError
+
+
+class FileEventListener(EventListener):
+    """Fires when ``path`` exists; payload is the file's pickled content
+    (or raw bytes when not a pickle)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def poll(self):
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            data = f.read()
+        try:
+            return pickle.loads(data)
+        except Exception:
+            return data
+
+
+def event(listener: EventListener, poll_interval_s: float = 0.2) -> DAGNode:
+    """A DAG node that resolves to the listener's payload. Durable: once
+    consumed, the payload is a stored step result and resume never waits
+    again."""
+    return DAGNode("event", None, (listener, float(poll_interval_s)), {})
 
 
 def _wf_dir(storage: str, workflow_id: str) -> str:
@@ -116,6 +176,8 @@ def _execute(dag: DAGNode, workflow_id: str, storage: str, args: Any) -> Any:
     """Walk the graph; each step's result is fetched (blocking) and
     persisted before dependents run — the durability contract: a step runs
     at most once per completed execution."""
+    import time as _time
+
     cache: Dict[int, Any] = {}
 
     def run_node(node: DAGNode, path: str):
@@ -126,6 +188,17 @@ def _execute(dag: DAGNode, workflow_id: str, storage: str, args: Any) -> Any:
         elif node.kind == "output":
             value = [run_node(a, f"{path}.{i}")
                      for i, a in enumerate(node.args)]
+        elif node.kind == "event":
+            key = _step_key(node, path)
+            value, done = _load(storage, workflow_id, key)
+            if not done:
+                listener, interval = node.args
+                while True:
+                    value = listener.poll()
+                    if value is not None:
+                        break
+                    _time.sleep(interval)
+                _store(storage, workflow_id, key, value)
         else:
             key = _step_key(node, path)
             value, done = _load(storage, workflow_id, key)
@@ -139,6 +212,15 @@ def _execute(dag: DAGNode, workflow_id: str, storage: str, args: Any) -> Any:
                     for k, v in node.kwargs.items()}
                 value = ray_tpu.get(node.fn.remote(*call_args,
                                                    **call_kwargs))
+                # Dynamic workflow: the step returned a continuation —
+                # execute the sub-graph in its place, durably, keyed
+                # under this step (sub-steps resume independently; the
+                # step's own file stores only the FINAL value, so an
+                # interrupted sub-graph re-enters here and replays
+                # finished sub-steps from storage).
+                while isinstance(value, Continuation):
+                    sub = _restore_dag(value.record)
+                    value = run_node(sub, f"{path}.c[{key}]")
                 _store(storage, workflow_id, key, value)
         cache[id(node)] = value
         return value

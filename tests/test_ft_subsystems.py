@@ -538,3 +538,78 @@ def test_autoscaler_with_instance_manager_end_to_end():
         for pid in provider.non_terminated_nodes():
             provider.terminate_node(pid)
         controller.stop()
+
+
+def test_workflow_dynamic_continuation(ray_start_regular, tmp_path):
+    """A step returning workflow.continuation(sub_dag) has the sub-graph
+    executed durably in its place (reference: dynamic workflows,
+    workflow_executor.py continuations), including nesting and resume."""
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    count_file = str(tmp_path / "leaf_runs")
+
+    @ray_tpu.remote
+    def leaf(x):
+        n = int(open(count_file).read()) if os.path.exists(count_file) else 0
+        with open(count_file, "w") as f:
+            f.write(str(n + 1))
+        return x * 10
+
+    @ray_tpu.remote
+    def fan_in(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def planner(x):
+        # Dynamic: the shape of the rest of the workflow depends on x.
+        from ray_tpu import workflow as wf
+        return wf.continuation(fan_in.bind(leaf.bind(x), leaf.bind(x + 1)))
+
+    storage = str(tmp_path / "durable")
+    with InputNode() as inp:
+        dag = planner.bind(inp)
+    result = workflow.run(dag, workflow_id="dyn1", storage=storage, args=3)
+    assert result == 3 * 10 + 4 * 10
+    assert int(open(count_file).read()) == 2
+    # Resume of the finished workflow replays from storage: no new runs.
+    assert workflow.resume("dyn1", storage=storage) == 70
+    assert int(open(count_file).read()) == 2
+
+
+def test_workflow_event_listener(ray_start_regular, tmp_path):
+    """workflow.event() blocks until the listener fires and persists the
+    payload — a resumed workflow does not wait again."""
+    import pickle
+    import threading
+    import time as _t
+
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    evt_path = str(tmp_path / "evt")
+
+    @ray_tpu.remote
+    def combine(x, payload):
+        return f"{x}:{payload}"
+
+    storage = str(tmp_path / "durable")
+    with InputNode() as inp:
+        dag = combine.bind(
+            inp, workflow.event(workflow.FileEventListener(evt_path),
+                                poll_interval_s=0.05))
+
+    def fire():
+        _t.sleep(0.5)
+        with open(evt_path, "wb") as f:
+            pickle.dump("lift-off", f)
+
+    threading.Thread(target=fire, daemon=True).start()
+    t0 = _t.monotonic()
+    result = workflow.run(dag, workflow_id="evt1", storage=storage,
+                          args="go")
+    assert result == "go:lift-off"
+    assert _t.monotonic() - t0 >= 0.4  # actually waited for the event
+    # Payload persisted: resume doesn't need the file anymore.
+    os.unlink(evt_path)
+    assert workflow.resume("evt1", storage=storage) == "go:lift-off"

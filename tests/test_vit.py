@@ -105,3 +105,34 @@ def test_vit_through_jax_trainer(ray_start_regular):
                                      resources_per_worker={"CPU": 1})).fit()
     assert result.error is None, result.error
     assert "loss" in result.metrics
+
+
+def test_pad_tokens_to_is_exact():
+    """Tile-friendly token padding (pad_tokens_to) changes only the MXU
+    tiling: logits match the unpadded model bit-for-tolerance (padded
+    keys masked in attention, pool slices them off)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import vit
+
+    base = vit.ViTConfig(image_size=16, patch_size=4, dim=64, n_layers=2,
+                         n_heads=2, mlp_dim=128, num_classes=10)
+    padded = dataclasses.replace(base, pad_tokens_to=32)  # 16 -> 32 tokens
+    params = vit.init_params(base, jax.random.key(0))
+    images = jax.random.normal(jax.random.key(1), (3, 16, 16, 3))
+    out_base = np.asarray(vit.forward(params, images, base))
+    out_pad = np.asarray(vit.forward(params, images, padded))
+    np.testing.assert_allclose(out_pad, out_base, rtol=2e-2, atol=2e-2)
+    # Gradients agree too (the whole padded path is differentiable-exact).
+    g1 = jax.grad(lambda p: vit.loss_fn(
+        p, {"images": images, "labels": jax.numpy.zeros(3, jax.numpy.int32)},
+        base)[0])(params)
+    g2 = jax.grad(lambda p: vit.loss_fn(
+        p, {"images": images, "labels": jax.numpy.zeros(3, jax.numpy.int32)},
+        padded)[0])(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-2, atol=5e-2)
