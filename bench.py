@@ -151,13 +151,7 @@ def run_vit(steps: int = 4, batch: int = 256):
     from ray_tpu.models import vit
     from ray_tpu.tpu import peak_flops_per_chip
 
-    import dataclasses as _dc
-
-    # Tile-friendly masked token padding (196 -> 256): 196 tokens ride
-    # 8x128 MXU tiles at 1.53 lane tiles; 256 tiles exactly. Math is
-    # unchanged (padded keys masked, pool slices them off) — MFU still
-    # counts only the 196 real tokens' FLOPs.
-    cfg = _dc.replace(vit.PRESETS["vit_b16"], pad_tokens_to=256)
+    cfg = vit.PRESETS["vit_b16"]
     params = vit.init_params(cfg, jax.random.key(0))
     opt = optax.adamw(3e-4, weight_decay=0.1)
     opt_state = opt.init(params)

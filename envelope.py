@@ -102,6 +102,106 @@ def control_plane(n_nodes: int) -> None:
         ctrl.stop()
 
 
+def _rss_bytes() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+
+def owner_queue_depth(n_queued: int) -> None:
+    """The reference's many_tasks row (release/benchmarks/README.md:31 —
+    1M+ queued on one node) is an OWNER-side queue-depth exercise: can one
+    driver hold n_queued in-flight tasks (specs, return refs, lineage) and
+    drain them? Runs on a single-node cluster; the 50-raylet storm row
+    measures cluster scheduling separately. Reports owner-side bytes/task
+    (the data-structure cost the row exists to expose) and the drain rate
+    (lease-pipelined: runners hold worker leases and push ready same-shape
+    tasks back-to-back, batched 16 per RPC)."""
+    import gc
+
+    import ray_tpu
+
+    print(f"[owner queue depth @ {n_queued:,} tasks]")
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    try:
+        ray_tpu.get([noop.remote(i) for i in range(200)])
+        gc.collect()
+        rss0 = _rss_bytes()
+        t0 = time.time()
+        refs = [noop.remote(i) for i in range(n_queued)]
+        submit_wall = time.time() - t0
+        rss_mid = _rss_bytes()
+        out = ray_tpu.get(refs, timeout=3600)
+        drain_wall = time.time() - t0
+        assert len(out) == n_queued and out[12345] == 12345
+        per_task = max(0, rss_mid - rss0) / n_queued
+        row("tasks queued in one owner", n_queued, "tasks",
+            "1,000,000+ queued on one node",
+            f"submitted in {submit_wall:.0f}s "
+            f"({n_queued / submit_wall:,.0f}/s), drained in "
+            f"{drain_wall:.0f}s ({n_queued / drain_wall:,.0f}/s), "
+            f"~{per_task:,.0f} B/task owner-side")
+    finally:
+        ray_tpu.shutdown()
+
+
+def actor_surge(n_actors: int, wave: int = 500) -> None:
+    """Dedicated single-node actor surge (the 50-raylet fixture shares one
+    core across every subsystem; this row isolates the worker-pool path:
+    forkserver warm forks + dedicated actor processes). Created in waves
+    (bounding control-RPC queue depth the way any loader at this scale
+    does); the row's claim is N actors LIVE simultaneously, all callable
+    in one fan-out. Needs kernel.pid_max above the stock 32,768 — every
+    worker is a process with ~5 threads; the harness raises it
+    best-effort (standard tuning for high worker counts)."""
+    import ray_tpu
+
+    try:  # 3,000+ workers x ~5 threads each outgrows the stock pid space
+        with open("/proc/sys/kernel/pid_max", "r+") as f:
+            if int(f.read()) < 4_194_304:
+                f.seek(0)
+                f.write("4194304")
+    except OSError:
+        pass
+
+    print(f"[actor surge @ {n_actors:,} actors]")
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    class Member:
+        def pid(self):
+            return os.getpid()
+
+    try:
+        t0 = time.time()
+        actors = []
+        while len(actors) < n_actors:
+            batch = [Member.options(num_cpus=0).remote()
+                     for _ in range(min(wave, n_actors - len(actors)))]
+            ray_tpu.get([a.pid.remote() for a in batch], timeout=900)
+            actors += batch
+        mid = time.time()
+        pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=1800)
+        wall = time.time() - t0
+        assert len(set(pids)) == n_actors
+        row("actors on one node (surge)", n_actors, "actors",
+            "40,000+ (4,096 cores)",
+            f"all LIVE simultaneously; built in {wall:.1f}s "
+            f"({n_actors / wall:.1f} actors/s, forkserver warm forks, "
+            f"1 core), one {n_actors}-wide fan-out call in "
+            f"{time.time() - mid:.1f}s")
+        t0 = time.time()
+        for a in actors:
+            ray_tpu.kill(a)
+        print(f"  killed in {time.time() - t0:.1f}s")
+    finally:
+        ray_tpu.shutdown()
+
+
 def real_cluster(n_nodes: int, n_tasks: int, n_queued: int, n_pgs: int,
                  n_actors: int, broadcast_mb: int) -> None:
     """Full-stack rows on a real multi-raylet cluster: every node is a live
@@ -165,8 +265,8 @@ def real_cluster(n_nodes: int, n_tasks: int, n_queued: int, n_pgs: int,
         out = ray_tpu.get(refs, timeout=900)
         drain_wall = time.time() - t0
         assert len(out) == n_queued
-        row("tasks queued in one owner", n_queued, "tasks",
-            "1,000,000+ queued on one node",
+        row("tasks queued (50-raylet fixture)", n_queued, "tasks",
+            "(cluster variant of the 1M owner-depth row)",
             f"submitted in {submit_wall:.1f}s, drained in {drain_wall:.1f}s "
             f"({n_queued / drain_wall:,.0f}/s)")
 
@@ -337,11 +437,15 @@ def main() -> None:
     t0 = time.time()
     if args.quick:
         control_plane(500)
+        owner_queue_depth(20000)
+        actor_surge(100)
         real_cluster(n_nodes=20, n_tasks=1000, n_queued=2000, n_pgs=50,
                      n_actors=20, broadcast_mb=16)
         single_node_objects(2000, 500, 2000, 0.25)
     else:
         control_plane(2000)
+        owner_queue_depth(1_000_000)
+        actor_surge(3000)
         real_cluster(n_nodes=50, n_tasks=5000, n_queued=20000, n_pgs=1000,
                      n_actors=1000, broadcast_mb=256)
         single_node_objects(10000, 3000, 10000, 10.0)

@@ -348,10 +348,15 @@ class StandardAutoscaler:
             if (now - first_idle > self._idle_timeout_s
                     and remaining > self._min_nodes
                     and pid in provider_ids):
+                # Count the DECISION before executing it: terminate_node
+                # can take seconds (socket teardown, thread joins) while
+                # the provider's list already shows the node gone —
+                # observers polling (provider empty, counter) must never
+                # see the torn intermediate state.
+                self._idle_since.pop(n["node_id"], None)
+                self.num_terminations += 1
+                remaining -= 1
                 if self._im is not None:
                     self._im.terminate(pid)
                 else:
                     self._provider.terminate_node(pid)
-                self._idle_since.pop(n["node_id"], None)
-                self.num_terminations += 1
-                remaining -= 1

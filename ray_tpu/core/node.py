@@ -238,6 +238,7 @@ class WorkerHandle:
         self.reported_active = -1      # worker-reported in-flight tasks
         self.actor_started = False     # worker-reported actor runtime up
         self.last_ping_ts = 0.0        # when that report arrived
+        self.last_progress_ts = 0.0    # when tasks_received last advanced
         self.lease_ts = 0.0            # when the current lease was granted
         # Lease generation: bumped on every grant AND reclamation, echoed
         # in return_worker so a duplicated or stale return (lost reply
@@ -855,11 +856,26 @@ class Node:
         with self._lock:
             handle = self._workers.get(WorkerID(worker_id_bytes))
             if handle is not None and tasks_received >= 0:
+                if tasks_received != handle.tasks_received:
+                    # The worker executed something since the last ping:
+                    # a pipelined lease (owner pushes task after task on
+                    # one grant) is ALIVE, however old its lease_ts.
+                    handle.last_progress_ts = time.monotonic()
                 handle.tasks_received = tasks_received
                 handle.reported_active = active_tasks
                 handle.actor_started = actor_started
                 handle.last_ping_ts = time.monotonic()
-        return {"known": handle is not None}
+            # Fleet-size-adaptive cadence: 2,000 workers at the default
+            # 2 s interval is 1,000 pings/s on one supervisor — pings
+            # starve, workers count misses, and the orphan-suicide guard
+            # kills LIVE actors (the envelope-scale cascade). Capping the
+            # aggregate rate at ~50/s keeps the control plane flat at any
+            # fleet size.
+            interval = self._suggested_ping_interval_locked()
+        return {"known": handle is not None, "interval": interval}
+
+    def _suggested_ping_interval_locked(self) -> float:
+        return max(2.0, 0.02 * len(self._workers))
 
     def validate_lease(self, worker_id_bytes: bytes, lease_seq: int) -> bool:
         """Is ``lease_seq`` still the worker's CURRENT lease? Late task
@@ -1059,15 +1075,20 @@ class Node:
             return
         victims: List[WorkerHandle] = []
         with self._lock:
+            ping_fresh = max(6.0, 3 * self._suggested_ping_interval_locked())
             for handle in list(self._workers.values()):
                 if (handle.lease_resources is None or not handle.lease_ts
                         or handle.reported_active != 0
                         or handle.last_ping_ts < handle.lease_ts + 2.0
-                        or now - handle.last_ping_ts > 6.0
+                        or now - handle.last_ping_ts > ping_fresh
                         or handle.proc.poll() is not None):
                     continue
                 if (not handle.dedicated
-                        and now - handle.lease_ts > timeout_s):
+                        and now - handle.lease_ts > timeout_s
+                        # A pipelined lease (owner pushes task after task
+                        # on one grant) shows recent execution progress —
+                        # it is alive however old the grant is.
+                        and now - handle.last_progress_ts > timeout_s):
                     self._credit_lease_locked(handle)
                     handle.lease_ts = 0.0
                     handle.lease_seq += 1  # invalidate straggler returns

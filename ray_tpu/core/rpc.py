@@ -94,6 +94,12 @@ def loads_frame(frame) -> Any:
     return pickle.loads(meta, buffers=buffers)
 
 
+def _struct_pack_timeval(seconds: int) -> bytes:
+    import struct as _struct
+
+    return _struct.pack("ll", seconds, 0)
+
+
 def send_frame(sock: socket.socket, payload) -> None:
     if isinstance(payload, (bytes, bytearray)):
         _chaos_gate(sock, len(payload))
@@ -224,49 +230,125 @@ class RpcServer:
         self._stopped = threading.Event()
         self._conns: list[socket.socket] = []
         self._conns_lock = threading.Lock()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"{name}-accept", daemon=True)
-        self._accept_thread.start()
+        # Reactor, not thread-per-connection: ONE selector thread reads
+        # every connection (a 5,000-worker fleet means 5,000 inbound
+        # sockets on a node/controller — a reader thread each breaks the
+        # process's thread/mmap budget long before CPU does). Inline
+        # methods run on the reactor; the rest dispatch to the pool.
+        import selectors as _selectors
+
+        self._selector = _selectors.DefaultSelector()
+        # The listening socket lives in the same selector (data=None
+        # marks it): one thread accepts AND reads — at 5,000 workers per
+        # box, every thread per process counts against kernel.pid_max.
+        self._sock.setblocking(False)
+        self._selector.register(self._sock, 1, None)
+        self._reactor_thread = threading.Thread(
+            target=self._reactor, name=f"{name}-reactor", daemon=True)
+        self._reactor_thread.start()
 
     def register(self, method: str, fn: Callable) -> None:
         self._handlers[method] = fn
 
-    def _accept_loop(self) -> None:
-        while not self._stopped.is_set():
+    class _Conn:
+        __slots__ = ("sock", "buf", "send_lock")
+
+        def __init__(self, sock):
+            self.sock = sock
+            self.buf = bytearray()
+            self.send_lock = threading.Lock()
+
+    def _accept(self) -> None:
+        while True:
             try:
                 conn, _ = self._sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Bounded sends: inline replies go out on the reactor thread,
+            # and an unbounded sendall to one stalled peer would freeze
+            # EVERY connection. A send that can't complete in 15s drops
+            # the peer (partial frame = torn stream, the conn must die).
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            _struct_pack_timeval(15))
             with self._conns_lock:
                 self._conns.append(conn)
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             name=f"{self._name}-conn", daemon=True).start()
-
-    def _conn_loop(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()
-        try:
-            while not self._stopped.is_set():
-                frame = recv_frame(conn)
-                msg = loads_frame(frame)
-                if msg.get("method") in self._inline:
-                    self._handle(conn, send_lock, msg)
-                else:
-                    try:
-                        self._pool.submit(self._handle, conn, send_lock, msg)
-                    except RuntimeError:
-                        # Pool shut down while a request was in flight:
-                        # server stopping, or interpreter exit (the
-                        # concurrent.futures atexit hook kills all pools
-                        # before daemon threads die). Drop the request.
-                        break
-        except (ConnectionError, OSError):
-            pass
-        finally:
             try:
-                conn.close()
-            except OSError:
+                self._selector.register(conn, 1,  # EVENT_READ
+                                        RpcServer._Conn(conn))
+            except (OSError, ValueError):
                 pass
+
+    def _drop(self, st: "_Conn") -> None:
+        try:
+            self._selector.unregister(st.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            if st.sock in self._conns:
+                self._conns.remove(st.sock)
+
+    def _reactor(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                events = self._selector.select(timeout=0.5)
+            except OSError:
+                return
+            for key, _mask in events:
+                st = key.data
+                if st is None:  # the listening socket
+                    self._accept()
+                    continue
+                try:
+                    # Blocking socket + MSG_DONTWAIT: reads never park the
+                    # reactor, writes (replies) stay simple blocking sends.
+                    data = st.sock.recv(1 << 20, socket.MSG_DONTWAIT)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    self._drop(st)
+                    continue
+                if not data:
+                    self._drop(st)
+                    continue
+                st.buf += data
+                self._pump(st)
+
+    def _pump(self, st: "_Conn") -> None:
+        """Dispatch every complete frame buffered on the connection."""
+        hdr = _LEN.size
+        while True:
+            if len(st.buf) < hdr:
+                return
+            (length,) = _LEN.unpack_from(st.buf)
+            if len(st.buf) < hdr + length:
+                return
+            frame = bytes(st.buf[hdr:hdr + length])
+            del st.buf[:hdr + length]
+            try:
+                msg = loads_frame(memoryview(frame))
+            except Exception:
+                self._drop(st)
+                return
+            if msg.get("method") in self._inline:
+                self._handle(st.sock, st.send_lock, msg)
+            else:
+                try:
+                    self._pool.submit(self._handle, st.sock, st.send_lock,
+                                      msg)
+                except RuntimeError:
+                    # Pool shut down while a request was in flight:
+                    # server stopping, or interpreter exit (the
+                    # concurrent.futures atexit hook kills all pools
+                    # before daemon threads die). Drop the request.
+                    self._drop(st)
+                    return
 
     def _handle(self, conn, send_lock, msg) -> None:
         req_id = msg.get("id")
@@ -287,7 +369,13 @@ class RpcServer:
             with send_lock:
                 send_frame(conn, payload)
         except OSError:
-            pass
+            # A failed/timed-out send may have written a PARTIAL frame —
+            # the stream is torn, so the connection must die (the
+            # reactor's next recv observes the close and unregisters it).
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def stop(self) -> None:
         self._stopped.set()
@@ -305,13 +393,17 @@ class RpcServer:
             self._sock.close()
         except OSError:
             pass
-        self._accept_thread.join(timeout=2.0)
+        self._reactor_thread.join(timeout=2.0)
         with self._conns_lock:
             for c in self._conns:
                 try:
                     c.close()
                 except OSError:
                     pass
+        try:
+            self._selector.close()
+        except (OSError, RuntimeError):
+            pass
         self._pool.shutdown(wait=False)
 
 
@@ -495,24 +587,60 @@ class ReconnectingClient:
 
 
 class ClientPool:
-    """Caches one RpcClient per address; thread-safe.
+    """Caches one RpcClient per address; thread-safe, LRU-capped.
 
-    Mirrors the reference's per-address gRPC client caching in the core worker
-    (``core_worker_client_pool.h``).
+    Mirrors the reference's per-address gRPC client caching in the core
+    worker (``core_worker_client_pool.h``, incl. its idle-connection
+    reclaim). The cap matters at actor-surge scale: every cached client
+    owns a reader THREAD, and a driver talking to thousands of actor workers
+    would otherwise hold 5,000 threads/connections — past
+    vm.max_map_count that breaks thread creation process-wide. Only
+    clients with no in-flight calls are evicted; reconnecting later is a
+    cheap localhost dial.
     """
 
-    def __init__(self):
-        self._clients: Dict[Addr, RpcClient] = {}
+    def __init__(self, max_clients: int = 1024):
+        from collections import OrderedDict
+
+        self._clients: "OrderedDict[Addr, RpcClient]" = OrderedDict()
+        self._max = max_clients
         self._lock = threading.Lock()
 
     def get(self, addr: Addr) -> RpcClient:
+        import time as _time
+
         addr = tuple(addr)
+        evicted: List[RpcClient] = []
+        now = _time.monotonic()
         with self._lock:
             client = self._clients.get(addr)
-            if client is None or client._closed:
-                client = RpcClient(addr)
-                self._clients[addr] = client
-            return client
+            if client is not None and not client._closed:
+                self._clients.move_to_end(addr)
+                client._last_handout = now
+                return client
+            client = RpcClient(addr)
+            client._last_handout = now
+            self._clients[addr] = client
+            if len(self._clients) > self._max:
+                for key in list(self._clients):
+                    if len(self._clients) <= self._max:
+                        break
+                    if key == addr:
+                        continue
+                    cand = self._clients[key]
+                    # Evict only clients that are idle AND haven't been
+                    # handed out recently: a thread that just got this
+                    # client may not have registered its call yet, and a
+                    # point-in-time _pending check alone would close the
+                    # connection under it.
+                    if (not cand._pending
+                            and now - getattr(cand, "_last_handout", 0.0)
+                            > 5.0):
+                        del self._clients[key]
+                        evicted.append(cand)
+        for c in evicted:
+            c.close()
+        return client
 
     def invalidate(self, addr: Addr) -> None:
         with self._lock:

@@ -32,6 +32,7 @@ import heapq
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -174,6 +175,7 @@ class CoreWorker:
                 "ref_update": self._handle_ref_update,
                 "reconstruct_object": self._handle_reconstruct,
                 "push_task": self._handle_push_task,
+                "push_task_batch": self._handle_push_task_batch,
                 "stream_item": self._handle_stream_item,
                 "start_actor": self._handle_start_actor,
                 "push_actor_task": self._handle_push_actor_task,
@@ -1044,6 +1046,34 @@ class CoreWorker:
             self._current_task_desc.value = None
             self.active_tasks -= 1
 
+    def _handle_push_task_batch(self, specs: List[Dict[str, Any]]):
+        """Execute a pipelined batch serially on this worker: one RPC for
+        N same-lease tasks (the owner's lease-pipelining runner batches
+        small ready tasks — per-task RPC overhead is the throughput
+        ceiling for fine-grained work). All specs share one lease; the
+        late-push staleness check runs once."""
+        first = specs[0]
+        lease_seq = first.get("lease_seq")
+        lease_ts = first.get("lease_ts")
+        if (lease_seq is not None and lease_ts is not None
+                and config.lease_undelivered_timeout_s > 0
+                and time.monotonic() - lease_ts
+                > max(0.5, config.lease_undelivered_timeout_s - 2.0)):
+            try:
+                still_mine = self.clients.get(self.node_addr).call(
+                    "validate_lease", self.worker_id.binary(), lease_seq,
+                    timeout=5.0)
+            except Exception:
+                still_mine = True
+            if not still_mine:
+                return {"stale_lease": True}
+        replies = []
+        for spec in specs:
+            spec.pop("lease_seq", None)  # checked once above
+            spec.pop("lease_ts", None)
+            replies.append(self._handle_push_task(spec))
+        return replies
+
     def _pack_results(self, results: List[Any],
                       force_shm: bool = False) -> List[tuple]:
         """Serialize task returns; large frames go into this node's shm store
@@ -1232,15 +1262,53 @@ class TaskSubmitter:
         self._pool = ThreadPoolExecutor(max_workers=32,
                                         thread_name_prefix="submit")
         self._stopped = False
+        # Lease pipelining: ready same-shape tasks queue here and a
+        # BOUNDED set of runner threads drains them, each holding one
+        # lease (see submit/_runner). Unbounded runners would degenerate
+        # to one-lease-per-task (every pool thread grabs its own item).
+        self._reuse_lock = threading.Lock()
+        self._reuse_queues: Dict[tuple, deque] = {}
+        self._runners: Dict[tuple, int] = {}
+
+    _RUNNER_CAP = 16  # max concurrent pipelining leases per shape
 
     def submit(self, spec, options, return_ids: List[ObjectID],
                arg_refs: List[ObjectRef],
                held_refs: Optional[List[ObjectRef]] = None) -> None:
         # held_refs: every ref serialized into the args (incl. nested) —
-        # passing them through the closure keeps their handles registered
-        # until _run returns, which is exactly the in-flight window.
-        self._pool.submit(self._run, spec, options, return_ids, arg_refs,
-                          held_refs)
+        # passing them through the work item keeps their handles
+        # registered until execution finishes, exactly the in-flight
+        # window.
+        core = self._core
+        key = self._reuse_key(spec, options)
+        # RETRIABLE items whose deps are ALREADY ready enter the shared
+        # pipeline: runner threads execute queued items back-to-back on
+        # leased workers (one push per task instead of
+        # pick+lease+push+return). Anything with unresolved deps takes
+        # the solo path, which may block on them without holding a lease
+        # (the original no-lease-holding-deadlock rule); non-retriable
+        # tasks also go solo — a reused worker that died since its last
+        # task would convert their never-executed push into a terminal
+        # crash, where the solo path's fresh lease gets a live worker.
+        if (key is not None
+                and options.get("max_retries", 3) > 0
+                and options.get("retry_on_crash", True)
+                and all(core.store.is_ready(r.id) for r in arg_refs)):
+            item = (spec, options, return_ids, arg_refs, held_refs)
+            with self._reuse_lock:
+                q = self._reuse_queues.setdefault(key, deque())
+                q.append(item)
+                n_runners = self._runners.get(key, 0)
+                spawn = (n_runners < self._RUNNER_CAP
+                         and (n_runners == 0
+                              or len(q) > 4 * n_runners))
+                if spawn:
+                    self._runners[key] = n_runners + 1
+            if spawn:
+                self._pool.submit(self._runner, key)
+            return
+        self._pool.submit(self._run_item, spec, options, return_ids,
+                          arg_refs, held_refs, None, False)
 
     def stop(self) -> None:
         self._stopped = True
@@ -1271,12 +1339,151 @@ class TaskSubmitter:
             except (RpcError, RemoteCallError, TimeoutError):
                 self._core.clients.invalidate(tuple(node_addr))
 
-    def _run(self, spec, options, return_ids, arg_refs,
-             held_refs=None) -> None:
+    # ------------------------------------------------ lease pipelining
+
+    @staticmethod
+    def _reuse_key(spec, options):
+        """Tasks that can share a leased worker back-to-back (reference:
+        direct_task_transport's lease reuse + pipelining): plain tasks
+        only — no PG bundle, no scheduling strategy, no runtime env. The
+        key is the resource shape the lease was granted for."""
+        if (options.get("placement") is not None
+                or options.get("scheduling_strategy") is not None
+                or options.get("runtime_env") is not None):
+            return None
+        res = options.get("resources", {"CPU": 1.0})
+        return tuple(sorted(res.items()))
+
+    _BATCH_MAX = 16
+
+    def _runner(self, key) -> None:
+        """Pool entry for one pipelining runner (accounted in
+        self._runners). The exit race — runner sees an empty queue and
+        leaves exactly as an enqueuer declines to spawn because it saw
+        this runner alive — is healed in the finally: the LAST runner out
+        respawns itself if items remain."""
+        try:
+            self._drain_pipeline(key)
+        finally:
+            respawn = False
+            with self._reuse_lock:
+                self._runners[key] = self._runners.get(key, 1) - 1
+                q = self._reuse_queues.get(key)
+                if q and self._runners[key] == 0:
+                    self._runners[key] = 1
+                    respawn = True
+            if respawn:
+                self._pool.submit(self._runner, key)
+
+    def _drain_pipeline(self, key) -> None:
+        """Runner: pop queued same-shape items and execute them on ONE
+        leased worker until the queue drains (then return the lease).
+        Once a lease is held, RETRIABLE items ship as push_task_batch
+        groups (one RPC per up-to-16 tasks). Concurrency comes from the
+        pool: up to pool-width runners per shape, each with its own
+        lease."""
+        state = None
+        try:
+            while True:
+                with self._reuse_lock:
+                    q = self._reuse_queues.get(key)
+                    item = q.popleft() if q else None
+                if item is None:
+                    return
+                if state is None:
+                    spec, options, return_ids, arg_refs, held_refs = item
+                    state = self._run_item(spec, options, return_ids,
+                                           arg_refs, held_refs, None,
+                                           True)
+                    continue
+                def batchable(it):
+                    # Non-retriable tasks never batch (a mid-batch crash
+                    # can't attribute execution); streaming replies need
+                    # the solo reply shape.
+                    return (it[1].get("max_retries", 3) > 0
+                            and it[1].get("retry_on_crash", True)
+                            and not it[0].get("streaming"))
+
+                batch = [item]
+                if batchable(item):
+                    with self._reuse_lock:
+                        q = self._reuse_queues.get(key)
+                        while (q and len(batch) < self._BATCH_MAX
+                               and batchable(q[0])):
+                            batch.append(q.popleft())
+                if len(batch) == 1:
+                    spec, options, return_ids, arg_refs, held_refs = item
+                    state = self._run_item(spec, options, return_ids,
+                                           arg_refs, held_refs, state,
+                                           True)
+                else:
+                    state = self._push_batch(batch, state)
+        finally:
+            if state is not None:
+                self._return_worker_safely(
+                    state["node_addr"], state["worker_id"],
+                    state["resources"], None, False, state["lease_seq"])
+
+    def _push_batch(self, batch, state):
+        """Ship a batch of retriable items to the held worker in one RPC.
+        Any transport failure or stale lease falls back to per-item solo
+        execution (their normal retry budgets intact)."""
+        core = self._core
+        t_submit = time.time()
+        specs = []
+        for spec, _o, _r, _a, _h in batch:
+            spec["lease_seq"] = state["lease_seq"]
+            spec["lease_ts"] = state["lease_ts"]
+            specs.append(spec)
+        try:
+            replies = core.clients.get(state["worker_addr"]).call(
+                "push_task_batch", specs, timeout=None)
+        except (RpcError, RemoteCallError, TimeoutError):
+            self._return_worker_safely(
+                state["node_addr"], state["worker_id"],
+                state["resources"], None, True, state["lease_seq"])
+            core.clients.invalidate(state["worker_addr"])
+            self._resubmit_solo(batch)
+            return None
+        if isinstance(replies, dict) and replies.get("stale_lease"):
+            self._resubmit_solo(batch)
+            return None
+        t_done = time.time()
+        worker_hex = WorkerID(state["worker_id"]).hex()
+        for (spec, _o, return_ids, _a, _h), reply in zip(batch, replies):
+            if reply["ok"]:
+                for oid, packed in zip(return_ids, reply["results"]):
+                    core.fulfil_result(oid, packed)
+            else:
+                for oid in return_ids:
+                    core.store.put_serialized(oid, reply["error_frame"])
+            core.record_task_event({
+                "task_id": TaskID(spec["task_id"]).hex(),
+                "desc": spec.get("desc", ""),
+                "state": "FINISHED" if reply["ok"] else "FAILED",
+                "submitted_ts": t_submit, "lease_ts": t_submit,
+                "end_ts": t_done, "worker": worker_hex,
+                "owner": core.addr,
+                "trace_id": (spec.get("trace") or {}).get("trace_id")})
+        return state
+
+    def _resubmit_solo(self, batch) -> None:
+        for spec, options, return_ids, arg_refs, held_refs in batch:
+            self._pool.submit(self._run_item, spec, options, return_ids,
+                              arg_refs, held_refs, None, False)
+
+    def _run_item(self, spec, options, return_ids, arg_refs,
+                  held_refs, state, keep_lease: bool):
+        """Execute one task. ``state`` (from a previous item) short-cuts
+        pick+lease and pushes straight to the already-leased worker; any
+        failure there falls back to the full path with normal retry
+        semantics. Returns the (possibly new) lease state when
+        ``keep_lease`` and the push succeeded, else None."""
         core = self._core
         t_submit = time.time()
         t_lease = t_run = None
         worker_hex = None
+        new_state = None
         try:
             # 1. Resolve dependencies BEFORE leasing a worker
             #    (dependency_resolver.h — avoids lease-holding deadlock).
@@ -1289,95 +1496,120 @@ class TaskSubmitter:
             stale_leases = 0
             deadline = time.monotonic() + config.worker_lease_timeout_s
             while True:
-                # 2. Cluster-level node selection. Transport errors to the
-                #    controller (lossy network, head blip) are retried
-                #    within the lease deadline like any other transient —
-                #    the ReconnectingClient reopens the socket underneath.
-                placement = options.get("placement")  # (pg_id_bytes, index)
-                picked_node_id: Optional[bytes] = None
-                try:
-                    if placement is not None:
-                        target = core.controller.call(
-                            "get_placement_group", placement[0])
-                    else:
-                        pick = core.controller.call(
-                            "pick_node",
-                            options.get("resources", {"CPU": 1.0}),
-                            options.get("scheduling_strategy"),
-                            core.node_id.binary(), excluded)
-                except (RpcError, TimeoutError):
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(0.2)
-                    continue
-                if placement is not None:
-                    if target is None or placement[1] not in target["placement"]:
-                        raise RayTpuError(
-                            f"placement group bundle {placement} not ready")
-                    node_addr = target["placement"][placement[1]][1]
-                    bundle = (placement[0], placement[1])
-                else:
-                    if pick is None:
-                        if time.monotonic() > deadline:
-                            raise RayTpuError(
-                                f"no feasible node for resources "
-                                f"{options.get('resources')}")
-                        time.sleep(0.2)
-                        excluded = []
-                        continue
-                    node_addr = pick["addr"]
-                    picked_node_id = pick["node_id"]
+                reused = state is not None
+                if reused:
+                    # Lease-reuse fast path: the runner already holds a
+                    # compatible worker.
+                    node_addr = state["node_addr"]
+                    worker_id = state["worker_id"]
+                    worker_addr = state["worker_addr"]
+                    lease_seq = state["lease_seq"]
+                    lease_ts_val = state["lease_ts"]
                     bundle = None
-                # 3. Worker lease from the chosen node. Transport errors
-                #    (node died between pick and lease) count as lease
-                #    failures: exclude the node and re-pick.
-                # Spillback (reference: hybrid_scheduling_policy.cc
-                # redirects): the first two lease attempts use a SHORT
-                # patience — if the picked node is busy, the quick "lease
-                # timeout" reply excludes it and re-picks another node
-                # instead of queueing behind a stale choice. Later attempts
-                # wait out the owner's remaining deadline (genuinely
-                # saturated cluster). Both are clamped to that deadline.
-                remaining = max(0.2, deadline - time.monotonic())
-                early_attempt = lease_attempts < 2 and bundle is None
-                patience = (min(5.0, remaining) if early_attempt
-                            else remaining)
-                lease_attempts += 1
-                try:
-                    node_client = core.clients.get(node_addr)
-                    lease = node_client.call(
-                        "lease_worker", options.get("resources", {"CPU": 1.0}),
-                        bundle, patience, False,
-                        options.get("runtime_env"),
-                        {"retriable": retries_left > 0
-                            and options.get("retry_on_crash", True),
-                         "owner": core.node_id.hex()},
-                        # Early attempts may be spillback-rejected by a
-                        # backlogged node (re-pick elsewhere); later
-                        # attempts settle into the queue so a saturated or
-                        # single-node cluster still makes progress.
-                        early_attempt,
-                        # Track the attempt's patience, not the global
-                        # lease deadline: a LOST REPLY on a 5s-patience
-                        # spillback probe must not block 40s (one lost
-                        # packet would eat the whole lease budget).
-                        timeout=patience + 10.0)
-                except (RpcError, RemoteCallError, TimeoutError) as e:
-                    core.clients.invalidate(tuple(node_addr))
-                    lease = {"error": f"node unreachable: {e}"}
-                if "error" in lease:
-                    if picked_node_id is not None:
-                        excluded.append(picked_node_id)
-                    if lease.get("permanent") or time.monotonic() > deadline:
-                        raise RayTpuError(f"worker lease failed: {lease['error']}")
-                    # PG-bundle leases don't go through the pick_node backoff
-                    # above; sleep here so a busy node isn't RPC-hammered.
-                    time.sleep(0.2)
-                    continue
-                worker_id, worker_addr = lease["worker_id"], lease["addr"]
-                lease_seq = lease.get("lease_seq")
+                    node_client = core.clients.get(tuple(node_addr))
+                    state = None  # consumed; errors below re-lease fresh
+                else:
+                    # 2. Cluster-level node selection. Transport errors to
+                    #    the controller (lossy network, head blip) are
+                    #    retried within the lease deadline like any other
+                    #    transient — the ReconnectingClient reopens the
+                    #    socket underneath.
+                    placement = options.get("placement")
+                    picked_node_id: Optional[bytes] = None
+                    try:
+                        if placement is not None:
+                            target = core.controller.call(
+                                "get_placement_group", placement[0])
+                        else:
+                            pick = core.controller.call(
+                                "pick_node",
+                                options.get("resources", {"CPU": 1.0}),
+                                options.get("scheduling_strategy"),
+                                core.node_id.binary(), excluded)
+                    except (RpcError, TimeoutError):
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.2)
+                        continue
+                    if placement is not None:
+                        if (target is None
+                                or placement[1] not in target["placement"]):
+                            raise RayTpuError(
+                                f"placement group bundle {placement} "
+                                f"not ready")
+                        node_addr = target["placement"][placement[1]][1]
+                        bundle = (placement[0], placement[1])
+                    else:
+                        if pick is None:
+                            if time.monotonic() > deadline:
+                                raise RayTpuError(
+                                    f"no feasible node for resources "
+                                    f"{options.get('resources')}")
+                            time.sleep(0.2)
+                            excluded = []
+                            continue
+                        node_addr = pick["addr"]
+                        picked_node_id = pick["node_id"]
+                        bundle = None
+                    # 3. Worker lease from the chosen node. Transport
+                    #    errors (node died between pick and lease) count
+                    #    as lease failures: exclude the node and re-pick.
+                    # Spillback (reference: hybrid_scheduling_policy.cc
+                    # redirects): the first two lease attempts use a SHORT
+                    # patience — if the picked node is busy, the quick
+                    # "lease timeout" reply excludes it and re-picks
+                    # another node instead of queueing behind a stale
+                    # choice. Later attempts wait out the owner's
+                    # remaining deadline (genuinely saturated cluster).
+                    # Both are clamped to that deadline.
+                    remaining = max(0.2, deadline - time.monotonic())
+                    early_attempt = lease_attempts < 2 and bundle is None
+                    patience = (min(5.0, remaining) if early_attempt
+                                else remaining)
+                    lease_attempts += 1
+                    try:
+                        node_client = core.clients.get(node_addr)
+                        lease = node_client.call(
+                            "lease_worker",
+                            options.get("resources", {"CPU": 1.0}),
+                            bundle, patience, False,
+                            options.get("runtime_env"),
+                            {"retriable": retries_left > 0
+                                and options.get("retry_on_crash", True),
+                             "owner": core.node_id.hex()},
+                            # Early attempts may be spillback-rejected by
+                            # a backlogged node (re-pick elsewhere); later
+                            # attempts settle into the queue so a
+                            # saturated or single-node cluster still makes
+                            # progress.
+                            early_attempt,
+                            # Track the attempt's patience, not the global
+                            # lease deadline: a LOST REPLY on a
+                            # 5s-patience spillback probe must not block
+                            # 40s (one lost packet would eat the whole
+                            # lease budget).
+                            timeout=patience + 10.0)
+                    except (RpcError, RemoteCallError, TimeoutError) as e:
+                        core.clients.invalidate(tuple(node_addr))
+                        lease = {"error": f"node unreachable: {e}"}
+                    if "error" in lease:
+                        if picked_node_id is not None:
+                            excluded.append(picked_node_id)
+                        if (lease.get("permanent")
+                                or time.monotonic() > deadline):
+                            raise RayTpuError(
+                                f"worker lease failed: {lease['error']}")
+                        # PG-bundle leases don't go through the pick_node
+                        # backoff above; sleep here so a busy node isn't
+                        # RPC-hammered.
+                        time.sleep(0.2)
+                        continue
+                    worker_id = lease["worker_id"]
+                    worker_addr = lease["addr"]
+                    lease_seq = lease.get("lease_seq")
+                    lease_ts_val = lease.get("lease_ts")
                 spec["lease_seq"] = lease_seq
-                spec["lease_ts"] = lease.get("lease_ts")
+                spec["lease_ts"] = lease_ts_val
                 t_lease = time.time()
                 worker_hex = WorkerID(worker_id).hex()
                 # 4. Direct push to the leased worker.
@@ -1390,14 +1622,16 @@ class TaskSubmitter:
                         options.get("resources", {"CPU": 1.0}), bundle,
                         True, lease_seq)
                     core.clients.invalidate(worker_addr)
-                    if retries_left > 0 and options.get("retry_on_crash", True):
+                    if (retries_left > 0
+                            and options.get("retry_on_crash", True)):
                         retries_left -= 1
                         time.sleep(config.task_retry_delay_ms / 1000.0)
-                        deadline = time.monotonic() + config.worker_lease_timeout_s
+                        deadline = (time.monotonic()
+                                    + config.worker_lease_timeout_s)
                         continue
                     # Terminal attempt: was this a node-initiated kill
-                    # (memory monitor)? Surface the recorded cause instead
-                    # of a generic crash.
+                    # (memory monitor)? Surface the recorded cause
+                    # instead of a generic crash.
                     try:
                         cause = node_client.call("worker_death_cause",
                                                  worker_id, timeout=2.0)
@@ -1412,10 +1646,10 @@ class TaskSubmitter:
                 if reply.get("stale_lease"):
                     # The node reclaimed this lease while the push crawled
                     # over the network; the worker refused to run it. The
-                    # lease credit already happened at reclamation — take a
-                    # fresh lease and push again, but BOUNDED: a link whose
-                    # every push outlives the reclamation window would
-                    # otherwise livelock here forever.
+                    # lease credit already happened at reclamation — take
+                    # a fresh lease and push again, but BOUNDED: a link
+                    # whose every push outlives the reclamation window
+                    # would otherwise livelock here forever.
                     stale_leases += 1
                     if stale_leases > 5:
                         raise RayTpuError(
@@ -1427,14 +1661,28 @@ class TaskSubmitter:
                     deadline = (time.monotonic()
                                 + config.worker_lease_timeout_s)
                     continue
-                # Best-effort with one fresh-socket retry: the task already
-                # SUCCEEDED — a lossy link must not convert a lost lease
-                # return into a task failure (the node's reaper re-credits
-                # the lease when the worker idles out or dies).
-                self._return_worker_safely(
-                    node_addr, worker_id,
-                    options.get("resources", {"CPU": 1.0}), bundle, False,
-                    lease_seq)
+                if keep_lease and bundle is None:
+                    # The runner keeps this lease for the next queued
+                    # item (returned below); the node sees continuous
+                    # task progress through worker_ping, which exempts
+                    # the lease from idle reclamation.
+                    new_state = {"node_addr": node_addr,
+                                 "worker_id": worker_id,
+                                 "worker_addr": worker_addr,
+                                 "lease_seq": lease_seq,
+                                 "lease_ts": lease_ts_val,
+                                 "resources": options.get(
+                                     "resources", {"CPU": 1.0})}
+                else:
+                    # Best-effort with one fresh-socket retry: the task
+                    # already SUCCEEDED — a lossy link must not convert a
+                    # lost lease return into a task failure (the node's
+                    # reaper re-credits the lease when the worker idles
+                    # out or dies).
+                    self._return_worker_safely(
+                        node_addr, worker_id,
+                        options.get("resources", {"CPU": 1.0}), bundle,
+                        False, lease_seq)
                 t_run = time.time()
                 break
             # 5. Fulfil owned return objects.
@@ -1446,7 +1694,8 @@ class TaskSubmitter:
                                         reply.get("stream_len"), None)
             else:
                 for oid in return_ids:
-                    self._core.store.put_serialized(oid, reply["error_frame"])
+                    self._core.store.put_serialized(oid,
+                                                    reply["error_frame"])
                 if spec.get("streaming"):
                     core._finish_stream(
                         spec["task_id"], None,
@@ -1459,6 +1708,7 @@ class TaskSubmitter:
                 "end_ts": t_run, "worker": worker_hex,
                 "owner": core.addr,
                 "trace_id": (spec.get("trace") or {}).get("trace_id")})
+            return new_state
         except BaseException as e:  # noqa: BLE001
             core.record_task_event({
                 "task_id": TaskID(spec["task_id"]).hex(),
@@ -1469,6 +1719,7 @@ class TaskSubmitter:
             self._fail(return_ids, e)
             if spec.get("streaming"):
                 core._finish_stream(spec["task_id"], None, e)
+            return None
 
 
 class ObjectRefGenerator:

@@ -45,16 +45,45 @@ def run(node_addr, controller_addr, node_id_hex: str,
     # load (e.g. a 1000-actor storm starving the node's reader threads)
     # must not make healthy workers mass-suicide — that cascaded into
     # dead actors at envelope scale. known=False stays authoritative.
+    # The node suggests the cadence (fleet-size adaptive, ~50 pings/s
+    # aggregate); jitter spreads the fleet so intervals don't phase-lock
+    # into synchronized bursts.
+    import random as _random
+
     misses = 0
+    interval = 2.0
+    transient = False
     while not core._shutdown.is_set():
-        time.sleep(2.0)
+        time.sleep(interval * (0.75 + 0.5 * _random.random()))
         try:
-            reply = node_client.call(
-                "worker_ping", core.worker_id.binary(),
-                core.tasks_received, core.active_tasks,
-                core._actor_runtime is not None, timeout=10.0)
+            # Long intervals (big fleets) use a transient connection per
+            # ping: a persistent socket per worker means a reader THREAD
+            # per worker inside the node supervisor — at 5,000 actors
+            # that alone exhausts the node's thread/mmap budget.
+            if transient:
+                client = RpcClient(node_addr)
+            else:
+                client = node_client
+            try:
+                reply = client.call(
+                    "worker_ping", core.worker_id.binary(),
+                    core.tasks_received, core.active_tasks,
+                    core._actor_runtime is not None,
+                    timeout=max(10.0, interval))
+            finally:
+                if transient:
+                    client.close()
             if not reply.get("known", True):
                 break
+            interval = float(reply.get("interval", 2.0))
+            go_transient = interval > 10.0
+            if go_transient and not transient:
+                node_client.close()  # free the node-side reader thread
+            elif transient and not go_transient:
+                # Fleet shrank back: re-dial the persistent connection
+                # (the old one was closed when we went transient).
+                node_client = RpcClient(node_addr)
+            transient = go_transient
             misses = 0
         except (RpcError, TimeoutError):
             misses += 1

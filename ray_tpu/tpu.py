@@ -27,26 +27,44 @@ _PEAK_BF16_FLOPS = {
 }
 
 
-def detect_chip_count() -> Tuple[int, Optional[str]]:
+def detect_chip_count(timeout_s: float = 20.0) -> Tuple[int, Optional[str]]:
     """Return (local chip count, pod type) without initializing distributed
-    JAX. Returns (0, None) when no TPU is attached."""
-    pod_type = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5e-16"
-    try:
-        import jax
+    JAX. Returns (0, None) when no TPU is attached.
 
-        devices = jax.local_devices()
-        chips = sum(1 for d in devices if "tpu" in d.platform.lower()
-                    or "TPU" in getattr(d, "device_kind", ""))
-        if chips == 0:
+    Detection runs under a TIMEOUT: backend discovery talks to the
+    accelerator plumbing (driver/tunnel), and a wedged or half-dead
+    transport would otherwise hang ``ray_tpu.init`` forever — a cluster
+    must come up CPU-only when its accelerator is broken, not freeze."""
+    import threading
+
+    pod_type = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5e-16"
+    result: list = []
+
+    def probe():
+        try:
+            import jax
+
+            devices = jax.local_devices()
+            result.append(sum(
+                1 for d in devices if "tpu" in d.platform.lower()
+                or "TPU" in getattr(d, "device_kind", "")))
+        except Exception:
+            result.append(None)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if result and result[0]:
+        return result[0], pod_type
+    if result and result[0] == 0:
+        return 0, pod_type
+    # Probe failed or timed out: fall back to the environment's claim.
+    if pod_type:
+        try:
+            return int(pod_type.rsplit("-", 1)[1]), pod_type
+        except (ValueError, IndexError):
             return 0, pod_type
-        return chips, pod_type
-    except Exception:
-        if pod_type:
-            try:
-                return int(pod_type.rsplit("-", 1)[1]), pod_type
-            except (ValueError, IndexError):
-                return 0, pod_type
-        return 0, None
+    return 0, None
 
 
 def device_kind() -> Optional[str]:

@@ -153,3 +153,34 @@ def test_util_state_api(ray_start_regular):
         total = state.summarize_tasks()["total"]
         _t.sleep(0.2)
     assert total >= 1
+
+
+def test_lease_pipelined_batches_isolate_errors(ray_start_regular):
+    """Same-shape ready tasks ride the lease-pipelined batch path (round
+    5: push_task_batch); a failing task inside a batch must fail ONLY
+    itself, and results keep their identities."""
+    @ray_tpu.remote
+    def maybe_fail(i):
+        if i % 50 == 7:
+            raise ValueError(f"boom{i}")
+        return i * 3
+
+    refs = [maybe_fail.remote(i) for i in range(200)]
+    for i, r in enumerate(refs):
+        if i % 50 == 7:
+            with pytest.raises(Exception, match=f"boom{i}"):
+                ray_tpu.get(r, timeout=60)
+        else:
+            assert ray_tpu.get(r, timeout=60) == i * 3
+
+
+def test_non_retriable_tasks_bypass_pipeline(ray_start_regular):
+    """max_retries=0 tasks take the solo lease path (a reused dead worker
+    would otherwise turn a never-executed push into a terminal crash) —
+    and still execute correctly."""
+    @ray_tpu.remote(max_retries=0)
+    def once(i):
+        return i + 100
+
+    assert ray_tpu.get([once.remote(i) for i in range(20)],
+                       timeout=60) == list(range(100, 120))
