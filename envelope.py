@@ -256,7 +256,10 @@ def real_cluster(n_nodes: int, n_tasks: int, n_queued: int, n_pgs: int,
         if sched:
             row("scheduling latency p50", round(pctl(sched, 0.5), 1), "ms",
                 "(not published per-task)",
-                f"p99={pctl(sched, 0.99):.1f}ms over {len(sched)} tasks")
+                f"p99={pctl(sched, 0.99):.1f}ms over {len(sched)} tasks "
+                f"(0 ms = lease-pipelined: the runner already held a "
+                f"compatible worker lease, so the task paid no per-task "
+                f"pick+lease round trip at all)")
 
         # --- tasks queued in one owner (client-side queue depth)
         t0 = time.time()
@@ -429,26 +432,59 @@ def write_report(path: str, quick: bool) -> None:
     print(f"wrote {path}")
 
 
+PHASES = {
+    "control": lambda q: control_plane(500 if q else 2000),
+    "queue": lambda q: owner_queue_depth(20000 if q else 1_000_000),
+    "surge": lambda q: actor_surge(100 if q else 3000),
+    "cluster": lambda q: real_cluster(
+        n_nodes=20 if q else 50, n_tasks=1000 if q else 5000,
+        n_queued=2000 if q else 20000, n_pgs=50 if q else 1000,
+        n_actors=20 if q else 1000, broadcast_mb=16 if q else 256),
+    "objects": lambda q: single_node_objects(
+        2000 if q else 10000, 500 if q else 3000,
+        2000 if q else 10000, 0.25 if q else 10.0),
+}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="reduced sizes (CI-scale smoke)")
+    ap.add_argument("--phase", choices=sorted(PHASES),
+                    help="run ONE phase and dump its rows as JSON "
+                         "(internal: the parent isolates phases in "
+                         "subprocesses)")
+    ap.add_argument("--rows-out", default=None)
     args = ap.parse_args()
     t0 = time.time()
-    if args.quick:
-        control_plane(500)
-        owner_queue_depth(20000)
-        actor_surge(100)
-        real_cluster(n_nodes=20, n_tasks=1000, n_queued=2000, n_pgs=50,
-                     n_actors=20, broadcast_mb=16)
-        single_node_objects(2000, 500, 2000, 0.25)
-    else:
-        control_plane(2000)
-        owner_queue_depth(1_000_000)
-        actor_surge(3000)
-        real_cluster(n_nodes=50, n_tasks=5000, n_queued=20000, n_pgs=1000,
-                     n_actors=1000, broadcast_mb=256)
-        single_node_objects(10000, 3000, 10000, 10.0)
+    if args.phase:
+        PHASES[args.phase](args.quick)
+        if args.rows_out:
+            with open(args.rows_out, "w") as f:
+                json.dump(RESULTS, f)
+        return
+    # Each phase runs in its own SUBPROCESS: a million dead ObjectRefs
+    # (or 3,000 reaped actor handles) from one phase must not pollute the
+    # next phase's timings or control RPCs — and a phase crash can't take
+    # the report down with it.
+    import subprocess
+    import tempfile
+
+    for name in ("control", "queue", "surge", "cluster", "objects"):
+        out = tempfile.mktemp(suffix=f"_{name}.json")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--phase", name, "--rows-out", out]
+        if args.quick:
+            cmd.append("--quick")
+        res = subprocess.run(cmd)
+        if res.returncode == 0 and os.path.exists(out):
+            with open(out) as f:
+                RESULTS.extend(json.load(f))
+            os.unlink(out)
+        else:
+            RESULTS.append({"metric": f"phase {name}", "value": "FAILED",
+                            "unit": "", "baseline": "",
+                            "note": f"exit code {res.returncode}"})
     write_report(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "ENVELOPE.md"), args.quick)
     print(json.dumps({"rows": len(RESULTS),
