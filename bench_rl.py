@@ -20,6 +20,17 @@ import time
 # actors; the tunneled TPU chip adds ~ms of round-trip per tiny policy op).
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# CartPole-scale MLP learners and samplers are CPU-bound by design (a
+# tunneled chip adds a fixed dispatch floor per tiny jitted call);
+# pinning the platform — overriding the machine-wide JAX_PLATFORMS=axon
+# — also keeps the bench runnable when the accelerator transport is
+# down. Workers inherit the env at fork; THIS process needs the config
+# update too because a site hook imports jax before this line runs.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import ray_tpu  # noqa: E402
 
 

@@ -31,33 +31,35 @@ def detect_chip_count(timeout_s: float = 20.0) -> Tuple[int, Optional[str]]:
     """Return (local chip count, pod type) without initializing distributed
     JAX. Returns (0, None) when no TPU is attached.
 
-    Detection runs under a TIMEOUT: backend discovery talks to the
-    accelerator plumbing (driver/tunnel), and a wedged or half-dead
-    transport would otherwise hang ``ray_tpu.init`` forever — a cluster
-    must come up CPU-only when its accelerator is broken, not freeze."""
-    import threading
+    Detection probes in a SUBPROCESS under a timeout: backend discovery
+    talks to the accelerator plumbing (driver/tunnel), and a wedged
+    transport would otherwise hang ``ray_tpu.init`` forever — worse, an
+    in-process probe thread that hangs POISONS jax's process-wide
+    backend-init lock, so every later jax call in the driver would hang
+    too. A killed subprocess leaves this process's jax untouched and the
+    cluster comes up CPU-only (reference analogue: accelerator managers
+    shell out to nvidia-smi / GCE metadata with timeouts)."""
+    import subprocess
+    import sys
 
     pod_type = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5e-16"
-    result: list = []
-
-    def probe():
-        try:
-            import jax
-
-            devices = jax.local_devices()
-            result.append(sum(
-                1 for d in devices if "tpu" in d.platform.lower()
-                or "TPU" in getattr(d, "device_kind", "")))
-        except Exception:
-            result.append(None)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if result and result[0]:
-        return result[0], pod_type
-    if result and result[0] == 0:
-        return 0, pod_type
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return 0, pod_type  # explicitly CPU-pinned: nothing to probe
+    probe_src = (
+        "import jax, sys\n"
+        "n = sum(1 for d in jax.local_devices()\n"
+        "        if 'tpu' in d.platform.lower()\n"
+        "        or 'TPU' in getattr(d, 'device_kind', ''))\n"
+        "sys.stdout.write(str(n))\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe_src], capture_output=True,
+            timeout=timeout_s, text=True)
+        if out.returncode == 0 and out.stdout.strip().isdigit():
+            return int(out.stdout.strip()), pod_type
+    except (subprocess.TimeoutExpired, OSError):
+        pass
     # Probe failed or timed out: fall back to the environment's claim.
     if pod_type:
         try:
