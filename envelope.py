@@ -21,6 +21,7 @@ import statistics
 import sys
 import threading
 import time
+from typing import Optional
 
 os.environ.setdefault("RAY_TPU_object_store_memory_bytes",
                       str(512 * 1024 * 1024))
@@ -149,24 +150,33 @@ def owner_queue_depth(n_queued: int) -> None:
         ray_tpu.shutdown()
 
 
-def actor_surge(n_actors: int, wave: int = 500) -> None:
+def actor_surge(n_actors: int, wave: int = 500,
+                raise_pid_max: Optional[bool] = None) -> None:
     """Dedicated single-node actor surge (the 50-raylet fixture shares one
     core across every subsystem; this row isolates the worker-pool path:
     forkserver warm forks + dedicated actor processes). Created in waves
     (bounding control-RPC queue depth the way any loader at this scale
     does); the row's claim is N actors LIVE simultaneously, all callable
     in one fan-out. Needs kernel.pid_max above the stock 32,768 — every
-    worker is a process with ~5 threads; the harness raises it
-    best-effort (standard tuning for high worker counts)."""
+    worker is a process with ~5 threads; raising it is a SYSTEM-WIDE
+    host reconfiguration, so it only happens when explicitly requested
+    (``--raise-pid-max`` / ENVELOPE_RAISE_PID_MAX=1) and is logged."""
     import ray_tpu
 
-    try:  # 3,000+ workers x ~5 threads each outgrows the stock pid space
-        with open("/proc/sys/kernel/pid_max", "r+") as f:
-            if int(f.read()) < 4_194_304:
-                f.seek(0)
-                f.write("4194304")
-    except OSError:
-        pass
+    if raise_pid_max is None:
+        raise_pid_max = os.environ.get("ENVELOPE_RAISE_PID_MAX") == "1"
+    if raise_pid_max:
+        try:  # 3,000+ workers x ~5 threads outgrow the stock pid space
+            with open("/proc/sys/kernel/pid_max", "r+") as f:
+                old = int(f.read())
+                if old < 4_194_304:
+                    f.seek(0)
+                    f.write("4194304")
+                    print(f"[actor_surge] raised kernel.pid_max "
+                          f"{old} -> 4194304 (system-wide; persists after "
+                          f"this benchmark)", flush=True)
+        except OSError:
+            pass
 
     print(f"[actor surge @ {n_actors:,} actors]")
     ray_tpu.init(num_cpus=8)
@@ -455,7 +465,14 @@ def main() -> None:
                          "(internal: the parent isolates phases in "
                          "subprocesses)")
     ap.add_argument("--rows-out", default=None)
+    ap.add_argument("--raise-pid-max", action="store_true",
+                    help="allow the surge phase to raise kernel.pid_max "
+                         "system-wide (logged; off by default)")
     args = ap.parse_args()
+    if args.raise_pid_max:
+        # Exported so the phase SUBPROCESSES (which re-run this script
+        # with --phase) see the opt-in too.
+        os.environ["ENVELOPE_RAISE_PID_MAX"] = "1"
     t0 = time.time()
     if args.phase:
         PHASES[args.phase](args.quick)

@@ -31,6 +31,49 @@ def timeit(name, fn, n, unit="ops/s"):
     return name, rate, unit
 
 
+def _bench_rpc(results, q):
+    """Raw transport rows (no cluster): framed-pickle RPC throughput over
+    the reactor write path, and the stalled-peer head-of-line bound —
+    a peer that requests a multi-MB inline reply and never reads it must
+    not stall other connections (the reply parks in its own per-conn
+    outbound queue; the old blocking-sendall design froze the reactor
+    for up to 15 s per stalled reply)."""
+    import socket as _socket
+
+    from ray_tpu.core.rpc import _LEN, RpcClient, RpcServer, dumps
+
+    srv = RpcServer({"ping": lambda: "pong",
+                     "blob": lambda n: b"x" * n},
+                    name="bench", inline_methods={"ping", "blob"})
+    try:
+        cli = RpcClient(srv.addr)
+        n = 1000 if q else 10000
+        results.append(timeit(
+            "rpc_inline_calls_per_s",
+            lambda: [cli.call("ping") for _ in range(n)], n))
+
+        stalled = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        stalled.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096)
+        stalled.connect(srv.addr)
+        req = dumps({"id": 1, "method": "blob", "args": (8 << 20,)})
+        stalled.sendall(_LEN.pack(len(req)) + req)
+        time.sleep(0.3)  # let the reactor queue the 8 MiB reply
+        lat = []
+        for _ in range(50 if q else 200):
+            t0 = time.perf_counter()
+            cli.call("ping", timeout=30.0)
+            lat.append(time.perf_counter() - t0)
+        worst = max(lat) * 1e3
+        print(json.dumps({"metric": "rpc_ping_ms_while_peer_stalled",
+                          "value": round(worst, 2), "unit": "ms (max)",
+                          "n": len(lat)}), flush=True)
+        results.append(("rpc_ping_ms_while_peer_stalled", worst, "ms (max)"))
+        stalled.close()
+        cli.close()
+    finally:
+        srv.stop()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -40,8 +83,10 @@ def main():
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=8)
     results = []
+    _bench_rpc(results, q)
+
+    ray_tpu.init(num_cpus=8)
 
     @ray_tpu.remote
     def nop():

@@ -23,7 +23,18 @@ def _load():
     if _lib is not None:
         return _lib
     path = build_library("shm_store", ["shm_store.cpp"])
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        # A prebuilt .so that doesn't load on THIS host (e.g. linked
+        # against a newer glibc) is stale regardless of mtime: rebuild
+        # from source with the local toolchain and retry.
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        path = build_library("shm_store", ["shm_store.cpp"])
+        lib = ctypes.CDLL(path)
     lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                      ctypes.c_uint64]
     lib.shm_store_create.restype = ctypes.c_int

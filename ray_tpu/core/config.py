@@ -70,6 +70,11 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "Polling interval for blocking get on remote objects."),
     "rpc_connect_retries": (int, 20,
         "TCP connect attempts (50ms apart) before an RPC endpoint is dead."),
+    "rpc_outbound_cap_bytes": (int, 64 * 1024 * 1024,
+        "Per-connection cap on bytes queued for send by an RpcServer's "
+        "non-blocking write path. A peer that stops reading accumulates "
+        "its replies here; past the cap the connection is dropped "
+        "(backpressure — the reactor must never block on one slow peer)."),
     "log_to_driver": (bool, True,
         "Forward worker stdout/stderr lines to the driver process."),
     "dag_channels_enabled": (bool, True,

@@ -178,7 +178,11 @@ class Controller:
             port=port,
             name="controller",
             max_workers=256,  # long-polls park handler threads
-            inline_methods={"heartbeat"},
+            # The reactor write path queues replies (non-blocking sendmsg
+            # flush), so inline handlers can answer slow peers without
+            # stalling other connections — heartbeats and pings must make
+            # progress even when the pool is saturated with long-polls.
+            inline_methods={"heartbeat", "ping"},
         )
         if persist_path:
             self._restore_state()

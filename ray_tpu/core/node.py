@@ -323,10 +323,14 @@ class Node:
             host=host,
             name="node",
             max_workers=128,
+            # All quick map/list updates; the reactor write path queues
+            # their replies (non-blocking sendmsg flush), so a stalled
+            # peer can no longer freeze the node's reactor for 15 s per
+            # reply — inlining is bounded by handler CPU only.
             inline_methods={"return_worker", "register_worker",
                             "worker_ping", "validate_lease", "reserve_bundle",
                             "release_bundle", "kill_worker",
-                            "worker_death_cause"},
+                            "worker_death_cause", "ping"},
         )
         self.address: Addr = self._server.addr
 

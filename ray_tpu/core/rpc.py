@@ -8,23 +8,41 @@ prefix followed by a pickled message dict — because on a TPU VM every hop is
 localhost or DCN-with-TLS-terminated-elsewhere; there is no cross-language
 requirement (the reference needs protobuf for its Java/C++ frontends).
 
-Concurrency model: ``RpcServer`` runs one accept thread, one reader thread per
-connection, and dispatches each request to a shared thread pool so a blocking
-handler (e.g. task execution) never head-of-line-blocks control messages on
-the same connection. ``RpcClient`` multiplexes concurrent in-flight calls over
+Concurrency model: ``RpcServer`` is a single-threaded reactor. ONE selector
+thread accepts and reads every connection; inline methods run on the reactor,
+the rest dispatch to a shared thread pool so a blocking handler (e.g. task
+execution) never head-of-line-blocks control messages on the same connection.
+
+Write model: the reactor owns the writes (the async-gRPC / asio
+``async_write`` discipline). Handlers never send on the socket themselves —
+they ENQUEUE serialized reply parts on the connection's outbound queue and
+the queue is flushed with non-blocking scatter-gather ``sendmsg`` (one
+syscall covers the length header, any number of small frames, and large
+out-of-band buffers straight from their backing memory). A flush that would
+block arms ``EVENT_WRITE`` and resumes when the kernel says the socket is
+writable, so a stalled peer parks ITS OWN queue while every other
+connection's round-trips continue unimpeded. Queues are capped
+(``config.rpc_outbound_cap_bytes``, ~64 MiB): a peer that stops reading past
+the cap is dropped. Every teardown — read EOF, read error, flush error,
+over-cap, handler-thread failure — routes through ``_drop`` so the selector
+can never retain a stale fd (fd reuse after an un-unregistered close would
+kill the reactor). ``RpcClient`` multiplexes concurrent in-flight calls over
 one socket with a response-reader thread, mirroring the async client-call
-pattern of ``src/ray/rpc/client_call.h``.
+pattern of ``src/ray/rpc/client_call.h``; its sends are blocking
+scatter-gather ``sendmsg`` on the caller's thread.
 """
 
 from __future__ import annotations
 
 import pickle
+import selectors
 import socket
 import struct
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
@@ -33,6 +51,10 @@ from ray_tpu.core.config import config
 Addr = Tuple[str, int]
 
 _LEN = struct.Struct(">Q")
+
+# iovec window per sendmsg: far under Linux's UIO_MAXIOV (1024), large
+# enough that a header + meta + a dozen OOB buffers go in one syscall.
+_IOV_CAP = 64
 
 
 def dumps(obj: Any) -> bytes:
@@ -94,33 +116,58 @@ def loads_frame(frame) -> Any:
     return pickle.loads(meta, buffers=buffers)
 
 
-def _struct_pack_timeval(seconds: int) -> bytes:
-    import struct as _struct
+def _byte_view(p) -> memoryview:
+    mv = p if isinstance(p, memoryview) else memoryview(p)
+    if mv.ndim != 1 or mv.format != "B":
+        mv = mv.cast("B")
+    return mv
 
-    return _struct.pack("ll", seconds, 0)
+
+def _sendmsg_all(sock: socket.socket, bufs: List[memoryview]) -> None:
+    """Blocking scatter-gather send of every buffer, in order. Handles
+    partial sends and iovec windows; zero copies on the Python side."""
+    idx, off = 0, 0
+    n = len(bufs)
+    while idx < n:
+        window: List[memoryview] = []
+        total = 0
+        i, cur_off = idx, off
+        while i < n and len(window) < _IOV_CAP and total < (8 << 20):
+            mv = bufs[i]
+            if cur_off:
+                mv = mv[cur_off:]
+                cur_off = 0
+            window.append(mv)
+            total += mv.nbytes
+            i += 1
+        sent = sock.sendmsg(window)
+        while sent > 0:
+            rem = bufs[idx].nbytes - off
+            if sent >= rem:
+                sent -= rem
+                idx += 1
+                off = 0
+            else:
+                off += sent
+                sent = 0
 
 
 def send_frame(sock: socket.socket, payload) -> None:
-    if isinstance(payload, (bytes, bytearray)):
-        _chaos_gate(sock, len(payload))
-        sock.sendall(_LEN.pack(len(payload)) + payload)
-        return
-    # Scatter path: length header, then parts in order. Small parts
-    # coalesce into one syscall; big buffers go straight from their
-    # backing memory (an mmap'd store chunk never lands in a pickle copy).
-    total = sum(memoryview(p).nbytes for p in payload)
-    _chaos_gate(sock, total)
-    head = bytearray(_LEN.pack(total))
+    """Client-side framed send: ONE scatter-gather ``sendmsg`` covers the
+    length header and every payload part (header copy eliminated; large
+    OOB buffers go straight from their backing memory, e.g. an mmap'd
+    store chunk never lands in an intermediate bytearray)."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        payload = [payload]
+    bufs: List[memoryview] = []
+    total = 0
     for p in payload:
-        if memoryview(p).nbytes < 65536 and len(head) < (1 << 20):
-            head += p
-        else:
-            if head:
-                sock.sendall(head)
-                head = bytearray()
-            sock.sendall(p)
-    if head:
-        sock.sendall(head)
+        mv = _byte_view(p)
+        if mv.nbytes:
+            bufs.append(mv)
+            total += mv.nbytes
+    _chaos_gate(sock, total)
+    _sendmsg_all(sock, [memoryview(_LEN.pack(total))] + bufs)
 
 
 def recv_exact(sock: socket.socket, n: int) -> memoryview:
@@ -144,9 +191,11 @@ def recv_frame(sock: socket.socket) -> memoryview:
 # Network-chaos injection seam (reference: tc-based latency/bandwidth
 # chaos, tests/chaos/chaos_network_delay.yaml + chaos_network_bandwidth
 # .yaml — here in-process so the multi-node-in-one-machine fixture can
-# exercise slow/lossy links without root/tc). Applied on the CLIENT send
-# path of the process that called set_network_chaos (per-process, like tc
-# on one host's egress).
+# exercise slow/lossy links without root/tc). Client sends apply it as a
+# blocking gate on the caller's thread; server replies apply it as
+# NON-BLOCKING per-connection pacing in the reactor flush (delay and
+# bandwidth push out the conn's next_send_t, drop severs the conn), so a
+# throttled peer never stalls the reactor for other connections.
 _chaos = {"delay_s": 0.0, "jitter_s": 0.0, "drop_prob": 0.0, "rng": None,
           "bandwidth_bps": 0.0}
 
@@ -196,12 +245,20 @@ class RemoteCallError(Exception):
         super().__init__(repr(cause))
 
 
+# Selector-key sentinel for the reactor's self-wake socket.
+_WAKE = object()
+
+
 class RpcServer:
-    """Threaded request/response server.
+    """Reactor request/response server.
 
     ``handlers`` maps method name -> callable(*args, **kwargs). Handlers run
-    on a thread pool; their return value (or raised exception) is shipped back
-    to the caller. A request with ``id is None`` is a one-way notification.
+    on a thread pool (or inline on the reactor for ``inline_methods``); their
+    return value (or raised exception) is shipped back to the caller. A
+    request with ``id is None`` is a one-way notification. Replies are queued
+    per connection and flushed by the reactor with non-blocking ``sendmsg``
+    (see module docstring) — no code path ever blocks in ``send`` on the
+    reactor thread.
     """
 
     def __init__(
@@ -212,14 +269,19 @@ class RpcServer:
         name: str = "rpc",
         max_workers: int = 64,
         inline_methods: Optional[set] = None,
+        outbound_cap_bytes: Optional[int] = None,
     ):
         self._handlers = dict(handlers)
         # Methods run directly on the connection reader thread instead of the
         # shared pool. Use for quick, never-blocking handlers that must make
         # progress even when the pool is saturated with blocking calls (e.g.
-        # a node's return_worker while many lease_worker calls wait).
+        # a node's return_worker while many lease_worker calls wait). Since
+        # replies are enqueued (never sent blocking), an inline handler can
+        # reply to an arbitrarily slow peer without stalling the reactor.
         self._inline = set(inline_methods or ())
         self._name = name
+        self._out_cap = (outbound_cap_bytes if outbound_cap_bytes is not None
+                         else config.rpc_outbound_cap_bytes)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -235,14 +297,25 @@ class RpcServer:
         # sockets on a node/controller — a reader thread each breaks the
         # process's thread/mmap budget long before CPU does). Inline
         # methods run on the reactor; the rest dispatch to the pool.
-        import selectors as _selectors
-
-        self._selector = _selectors.DefaultSelector()
+        self._selector = selectors.DefaultSelector()
         # The listening socket lives in the same selector (data=None
         # marks it): one thread accepts AND reads — at 5,000 workers per
         # box, every thread per process counts against kernel.pid_max.
         self._sock.setblocking(False)
-        self._selector.register(self._sock, 1, None)
+        self._selector.register(self._sock, selectors.EVENT_READ, None)
+        # Self-wake pipe: handler threads post selector work (arm a
+        # conn's EVENT_WRITE, drop a conn) to _ops and poke the reactor.
+        # Only the reactor touches the selector — stdlib selectors are
+        # not thread-safe for concurrent modify/select.
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._selector.register(self._wake_r, selectors.EVENT_READ, _WAKE)
+        self._ops: deque = deque()
+        self._ops_lock = threading.Lock()
+        # Connections with queued data deferred by chaos pacing
+        # (reactor-private; see _flush).
+        self._paced: List[RpcServer._Conn] = []
         self._reactor_thread = threading.Thread(
             target=self._reactor, name=f"{name}-reactor", daemon=True)
         self._reactor_thread.start()
@@ -251,12 +324,20 @@ class RpcServer:
         self._handlers[method] = fn
 
     class _Conn:
-        __slots__ = ("sock", "buf", "send_lock")
+        __slots__ = ("sock", "buf", "out", "out_bytes", "lock", "writing",
+                     "dead", "next_send_t")
 
         def __init__(self, sock):
             self.sock = sock
-            self.buf = bytearray()
-            self.send_lock = threading.Lock()
+            self.buf = bytearray()          # inbound partial frames
+            self.out = deque()              # outbound memoryviews
+            self.out_bytes = 0
+            self.lock = threading.Lock()    # guards out/out_bytes/dead
+            self.writing = False            # EVENT_WRITE armed (reactor-only)
+            self.dead = False
+            self.next_send_t = 0.0          # chaos pacing gate
+
+    # ----------------------------------------------------------- accept/read
 
     def _accept(self) -> None:
         while True:
@@ -267,21 +348,38 @@ class RpcServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            # Bounded sends: inline replies go out on the reactor thread,
-            # and an unbounded sendall to one stalled peer would freeze
-            # EVERY connection. A send that can't complete in 15s drops
-            # the peer (partial frame = torn stream, the conn must die).
-            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                            _struct_pack_timeval(15))
+            conn.setblocking(False)
+            st = RpcServer._Conn(conn)
             with self._conns_lock:
                 self._conns.append(conn)
             try:
-                self._selector.register(conn, 1,  # EVENT_READ
-                                        RpcServer._Conn(conn))
+                self._selector.register(conn, selectors.EVENT_READ, st)
+            except KeyError:
+                # A stale key under this fd number means some teardown
+                # bypassed _drop (must not happen — but a dead entry here
+                # would otherwise kill the reactor on the NEXT register).
+                # Evict it and retry.
+                try:
+                    self._selector.unregister(conn)
+                except (KeyError, OSError, ValueError):
+                    pass
+                try:
+                    self._selector.register(conn, selectors.EVENT_READ, st)
+                except (KeyError, OSError, ValueError):
+                    self._drop(st)
             except (OSError, ValueError):
-                pass
+                self._drop(st)
 
     def _drop(self, st: "_Conn") -> None:
+        """The single teardown path: marks the conn dead, clears its queue,
+        unregisters it, closes it. Reactor-thread only (handler threads
+        post a 'drop' op instead)."""
+        with st.lock:
+            st.dead = True
+            st.out.clear()
+            st.out_bytes = 0
+        if st in self._paced:
+            self._paced.remove(st)
         try:
             self._selector.unregister(st.sock)
         except (KeyError, OSError, ValueError):
@@ -294,36 +392,90 @@ class RpcServer:
             if st.sock in self._conns:
                 self._conns.remove(st.sock)
 
+    # ------------------------------------------------------------- wake/ops
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # wake buffer full => reactor is already waking
+
+    def _post_op(self, op: str, st: "_Conn") -> None:
+        with self._ops_lock:
+            self._ops.append((op, st))
+        self._wake()
+
+    def _drain_ops(self) -> None:
+        while True:
+            with self._ops_lock:
+                if not self._ops:
+                    return
+                op, st = self._ops.popleft()
+            if op == "drop":
+                self._drop(st)
+            elif not st.dead:  # "arm": flush now; arm/pace as needed
+                self._flush(st)
+
+    # ---------------------------------------------------------------- reactor
+
     def _reactor(self) -> None:
         while not self._stopped.is_set():
+            self._drain_ops()
+            timeout = 0.5
+            if self._paced:
+                now = time.monotonic()
+                due = [st for st in self._paced
+                       if st.dead or st.next_send_t <= now]
+                for st in due:
+                    self._paced.remove(st)
+                    if not st.dead:
+                        self._flush(st)
+                if self._paced:
+                    soonest = min(st.next_send_t for st in self._paced)
+                    timeout = min(timeout,
+                                  max(0.001, soonest - time.monotonic()))
             try:
-                events = self._selector.select(timeout=0.5)
+                events = self._selector.select(timeout=timeout)
             except OSError:
                 return
-            for key, _mask in events:
+            for key, mask in events:
                 st = key.data
                 if st is None:  # the listening socket
                     self._accept()
                     continue
-                try:
-                    # Blocking socket + MSG_DONTWAIT: reads never park the
-                    # reactor, writes (replies) stay simple blocking sends.
-                    data = st.sock.recv(1 << 20, socket.MSG_DONTWAIT)
-                except (BlockingIOError, InterruptedError):
+                if st is _WAKE:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                    self._drain_ops()
                     continue
-                except OSError:
-                    self._drop(st)
+                if st.dead:  # dropped earlier in this event batch
                     continue
-                if not data:
-                    self._drop(st)
-                    continue
-                st.buf += data
-                self._pump(st)
+                if mask & selectors.EVENT_WRITE:
+                    self._flush(st)
+                if (mask & selectors.EVENT_READ) and not st.dead:
+                    self._read(st)
+
+    def _read(self, st: "_Conn") -> None:
+        try:
+            data = st.sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(st)
+            return
+        if not data:
+            self._drop(st)
+            return
+        st.buf += data
+        self._pump(st)
 
     def _pump(self, st: "_Conn") -> None:
         """Dispatch every complete frame buffered on the connection."""
         hdr = _LEN.size
-        while True:
+        while not st.dead:
             if len(st.buf) < hdr:
                 return
             (length,) = _LEN.unpack_from(st.buf)
@@ -337,11 +489,10 @@ class RpcServer:
                 self._drop(st)
                 return
             if msg.get("method") in self._inline:
-                self._handle(st.sock, st.send_lock, msg)
+                self._handle(st, msg)
             else:
                 try:
-                    self._pool.submit(self._handle, st.sock, st.send_lock,
-                                      msg)
+                    self._pool.submit(self._handle, st, msg)
                 except RuntimeError:
                     # Pool shut down while a request was in flight:
                     # server stopping, or interpreter exit (the
@@ -350,7 +501,9 @@ class RpcServer:
                     self._drop(st)
                     return
 
-    def _handle(self, conn, send_lock, msg) -> None:
+    # ------------------------------------------------------------ write path
+
+    def _handle(self, st: "_Conn", msg) -> None:
         req_id = msg.get("id")
         try:
             handler = self._handlers[msg["method"]]
@@ -361,45 +514,139 @@ class RpcServer:
         if req_id is None:
             return
         try:
-            payload = dumps_parts(reply)
+            parts = dumps_parts(reply)
         except Exception as e:
-            payload = dumps({"id": req_id, "ok": False,
-                             "error": RpcError(f"unpicklable reply: {e!r}")})
-        try:
-            with send_lock:
-                send_frame(conn, payload)
-        except OSError:
-            # A failed/timed-out send may have written a PARTIAL frame —
-            # the stream is torn, so the connection must die (the
-            # reactor's next recv observes the close and unregisters it).
+            parts = [dumps({"id": req_id, "ok": False,
+                            "error": RpcError(f"unpicklable reply: {e!r}")})]
+        self._send_reply(st, parts)
+
+    def _send_reply(self, st: "_Conn", parts: list) -> None:
+        """Enqueue one framed reply on the connection's outbound queue and
+        flush opportunistically (non-blocking). Residue is flushed by the
+        reactor on EVENT_WRITE. Never raises; never blocks."""
+        bufs = [_byte_view(p) for p in parts]
+        total = sum(mv.nbytes for mv in bufs)
+        rng = _chaos["rng"]
+        if rng is not None:
+            if _chaos["drop_prob"] and rng.random() < _chaos["drop_prob"]:
+                with st.lock:
+                    st.dead = True
+                self._post_op("drop", st)
+                return
+            delay = _chaos["delay_s"]
+            if _chaos["jitter_s"]:
+                delay += rng.uniform(0.0, _chaos["jitter_s"])
+        else:
+            delay = 0.0
+        with st.lock:
+            if st.dead:
+                return
+            if st.out_bytes + _LEN.size + total > self._out_cap:
+                # Backpressure: the peer stopped reading and its queue hit
+                # the cap. A partial frame may already be on the wire, so
+                # the stream is torn either way — drop the conn.
+                st.dead = True
+                status = "error"
+            else:
+                st.out.append(memoryview(_LEN.pack(total)))
+                st.out.extend(bufs)
+                st.out_bytes += _LEN.size + total
+                if delay > 0:
+                    st.next_send_t = max(st.next_send_t,
+                                         time.monotonic() + delay)
+                status = self._flush_locked(st)
+        if status == "error":
+            self._post_op("drop", st)
+        elif status != "drained":
+            self._post_op("arm", st)
+
+    def _flush_locked(self, st: "_Conn") -> str:
+        """Send as much queued data as the socket (and chaos pacing) allows.
+        Caller holds ``st.lock``. Returns 'drained' | 'blocked' | 'paced' |
+        'error'; on 'error' the conn is marked dead (caller routes to
+        _drop). Never blocks: the socket is non-blocking."""
+        bps = _chaos["bandwidth_bps"] if _chaos["rng"] is not None else 0.0
+        while st.out:
+            now = time.monotonic()
+            if now < st.next_send_t:
+                return "paced"
+            window: List[memoryview] = []
+            total = 0
+            limit = max(4096, int(bps * 0.05)) if bps else (8 << 20)
+            for mv in st.out:
+                if total + mv.nbytes > limit and window:
+                    break
+                if mv.nbytes > limit - total:
+                    mv = mv[:limit - total]
+                window.append(mv)
+                total += mv.nbytes
+                if len(window) >= _IOV_CAP:
+                    break
             try:
-                conn.close()
+                sent = st.sock.sendmsg(window)
+            except (BlockingIOError, InterruptedError):
+                return "blocked"
             except OSError:
-                pass
+                st.dead = True
+                return "error"
+            st.out_bytes -= sent
+            if bps:
+                st.next_send_t = max(st.next_send_t, now) + sent / bps
+            while sent > 0:
+                head = st.out[0]
+                if sent >= head.nbytes:
+                    sent -= head.nbytes
+                    st.out.popleft()
+                else:
+                    st.out[0] = head[sent:]
+                    sent = 0
+        return "drained"
+
+    def _flush(self, st: "_Conn") -> None:
+        """Reactor-side flush + interest-set bookkeeping."""
+        with st.lock:
+            status = self._flush_locked(st)
+        if status == "error":
+            self._drop(st)
+        elif status == "drained":
+            self._set_writing(st, False)
+        elif status == "blocked":
+            self._set_writing(st, True)
+        else:  # paced: park off the selector so a writable socket doesn't spin
+            self._set_writing(st, False)
+            if not st.dead and st not in self._paced:
+                self._paced.append(st)
+
+    def _set_writing(self, st: "_Conn", on: bool) -> None:
+        if st.writing == on or st.dead:
+            return
+        mask = selectors.EVENT_READ | (selectors.EVENT_WRITE if on else 0)
+        try:
+            self._selector.modify(st.sock, mask, st)
+        except (KeyError, OSError, ValueError):
+            self._drop(st)
+            return
+        st.writing = on
 
     def stop(self) -> None:
         self._stopped.set()
-        # Wake the accept thread: a thread blocked in accept() holds a
-        # kernel reference to the listening socket, so close() alone leaves
-        # the port bound (a restarted peer could never rebind the same
-        # address). A self-connect makes accept() return; the loop then
-        # sees _stopped and exits, releasing the fd for real.
-        try:
-            with socket.create_connection(self.addr, timeout=1.0):
-                pass
-        except OSError:
-            pass
+        self._wake()  # pop the reactor out of select() immediately
+        self._reactor_thread.join(timeout=2.0)
         try:
             self._sock.close()
         except OSError:
             pass
-        self._reactor_thread.join(timeout=2.0)
         with self._conns_lock:
             for c in self._conns:
                 try:
                     c.close()
                 except OSError:
                     pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
         try:
             self._selector.close()
         except (OSError, RuntimeError):
@@ -419,20 +666,26 @@ class RpcClient:
         self._pending: Dict[int, _PendingCall] = {}
         self._pending_lock = threading.Lock()
         self._closed = False
+        self._pool_evicted = False
+        self._lifecycle_lock = threading.Lock()
         self._reader = threading.Thread(target=self._read_loop,
+                                        args=(self._sock,),
                                         name="rpc-client-read", daemon=True)
         self._reader.start()
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
-                msg = loads_frame(recv_frame(self._sock))
+                msg = loads_frame(recv_frame(sock))
                 with self._pending_lock:
                     call = self._pending.pop(msg["id"], None)
                 if call is not None:
                     call.complete(msg)
         except (ConnectionError, OSError):
-            self._fail_all(RpcError(f"connection to {self.addr} lost"))
+            # Guard against a stale reader (pre-redial socket) failing the
+            # fresh connection's in-flight calls.
+            if sock is self._sock:
+                self._fail_all(RpcError(f"connection to {self.addr} lost"))
 
     def _fail_all(self, err: Exception) -> None:
         self._closed = True
@@ -441,9 +694,29 @@ class RpcClient:
         for call in pending.values():
             call.fail(err)
 
+    def _ensure_open(self) -> None:
+        if not self._closed:
+            return
+        with self._lifecycle_lock:
+            if not self._closed:
+                return
+            if not self._pool_evicted:
+                raise RpcError(f"client to {self.addr} is closed")
+            # The pool reclaimed this idle connection while a caller still
+            # held the handle (the get()/call() race): transparently
+            # re-dial. Eviction requires no in-flight calls, so nothing is
+            # lost; any stragglers were failed by the old reader.
+            self._sock = _connect(self.addr, None)
+            self._pool_evicted = False
+            self._closed = False
+            self._reader = threading.Thread(target=self._read_loop,
+                                            args=(self._sock,),
+                                            name="rpc-client-read",
+                                            daemon=True)
+            self._reader.start()
+
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
-        if self._closed:
-            raise RpcError(f"client to {self.addr} is closed")
+        self._ensure_open()
         with self._id_lock:
             self._next_id += 1
             req_id = self._next_id
@@ -469,6 +742,7 @@ class RpcClient:
 
     def notify(self, method: str, *args, **kwargs) -> None:
         """Fire-and-forget one-way message."""
+        self._ensure_open()
         payload = dumps_parts({"id": None, "method": method,
                                "args": args, "kwargs": kwargs})
         try:
@@ -595,8 +869,10 @@ class ClientPool:
     owns a reader THREAD, and a driver talking to thousands of actor workers
     would otherwise hold 5,000 threads/connections — past
     vm.max_map_count that breaks thread creation process-wide. Only
-    clients with no in-flight calls are evicted; reconnecting later is a
-    cheap localhost dial.
+    clients with no in-flight calls are evicted, and an evicted client a
+    caller still holds re-dials transparently on its next call (the pool
+    marks it ``_pool_evicted`` — closing the get()/call() preemption race
+    where eviction used to fail a healthy caller).
     """
 
     def __init__(self, max_clients: int = 1024):
@@ -637,6 +913,7 @@ class ClientPool:
                             and now - getattr(cand, "_last_handout", 0.0)
                             > 5.0):
                         del self._clients[key]
+                        cand._pool_evicted = True
                         evicted.append(cand)
         for c in evicted:
             c.close()

@@ -194,8 +194,12 @@ class CoreWorker:
                             # Broadcast slot releases must make progress
                             # while the pool is saturated with blocked
                             # get_object long-polls — else each tree round
-                            # stalls a full long-poll window.
-                            "pull_done", "pull_failed"},
+                            # stalls a full long-poll window. Replies are
+                            # queued (never sent blocking) by the reactor
+                            # write path, so inlining is safe even when a
+                            # peer reads slowly; ping rides inline so
+                            # liveness probes skip the pool hop entirely.
+                            "pull_done", "pull_failed", "ping"},
         )
         self.addr: Addr = self.server.addr
         self.submitter = TaskSubmitter(self)

@@ -139,6 +139,16 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({len(req.tokens)}) must be shorter than the "
                 f"cache capacity ({self.capacity})")
+        if len(req.tokens) + req.max_new_tokens > self.capacity:
+            # Past capacity the K/V scatter at pos=length goes out of
+            # bounds and JAX silently drops it — the request would return
+            # wrong tokens, not an error. generate() sizes its cache as
+            # cache_bucket(S + max_new_tokens); the engine's cache is
+            # fixed, so the same budget must hold at admission.
+            raise ValueError(
+                f"prompt ({len(req.tokens)}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the cache capacity "
+                f"({self.capacity})")
         self._pending.put(req)
         self._work.set()
         return req

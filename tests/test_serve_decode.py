@@ -168,3 +168,24 @@ def test_chunked_decode_matches_per_token():
         eng.step()
     assert req.output[-1] == eos
     assert len(req.output) <= 4 + 3  # truncated at/before the eos chunk
+
+
+def test_submit_rejects_over_capacity_budget():
+    """ADVICE medium: a request whose prompt + max_new_tokens exceeds the
+    cache capacity must be rejected at submit — past capacity the K/V
+    scatter silently drops writes and the engine would return wrong
+    tokens instead of an error."""
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=2, capacity=32)
+    with pytest.raises(ValueError):
+        eng.submit(list(range(1, 26)), max_new_tokens=10)  # 25 + 10 > 32
+    # Exactly at the budget is admitted and completes.
+    req = eng.submit([1, 2, 3], max_new_tokens=29)  # 3 + 29 == 32
+    for _ in range(60):
+        if req.done.is_set():
+            break
+        eng.step()
+    assert req.done.is_set()
+    assert len(req.output) == 29
