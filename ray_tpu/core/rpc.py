@@ -524,7 +524,11 @@ class RpcServer:
         """Enqueue one framed reply on the connection's outbound queue and
         flush opportunistically (non-blocking). Residue is flushed by the
         reactor on EVENT_WRITE. Never raises; never blocks."""
-        bufs = [_byte_view(p) for p in parts]
+        # Zero-length parts (e.g. the 0-byte OOB PickleBuffer an empty
+        # numpy array yields) must never reach the queue: sendmsg consumes
+        # 0 bytes of them, so an unfiltered one would sit at the queue
+        # head forever and wedge the flush loop.
+        bufs = [mv for mv in map(_byte_view, parts) if mv.nbytes]
         total = sum(mv.nbytes for mv in bufs)
         rng = _chaos["rng"]
         if rng is not None:
@@ -592,7 +596,9 @@ class RpcServer:
             st.out_bytes -= sent
             if bps:
                 st.next_send_t = max(st.next_send_t, now) + sent / bps
-            while sent > 0:
+            # `sent >= head.nbytes` holds for a 0-byte head even when
+            # sent == 0, so stray empty views can never pin the queue.
+            while sent > 0 or (st.out and st.out[0].nbytes == 0):
                 head = st.out[0]
                 if sent >= head.nbytes:
                     sent -= head.nbytes
@@ -642,15 +648,19 @@ class RpcServer:
                     c.close()
                 except OSError:
                     pass
-        for s in (self._wake_r, self._wake_w):
+        if not self._reactor_thread.is_alive():
+            # Only reap the wake fds and selector once the reactor has
+            # actually exited: a reactor wedged past the join timeout
+            # would otherwise select() on closed — soon reused — fds.
+            for s in (self._wake_r, self._wake_w):
+                try:
+                    s.close()
+                except OSError:
+                    pass
             try:
-                s.close()
-            except OSError:
+                self._selector.close()
+            except (OSError, RuntimeError):
                 pass
-        try:
-            self._selector.close()
-        except (OSError, RuntimeError):
-            pass
         self._pool.shutdown(wait=False)
 
 
@@ -720,19 +730,30 @@ class RpcClient:
         with self._id_lock:
             self._next_id += 1
             req_id = self._next_id
-        call = _PendingCall()
-        with self._pending_lock:
-            self._pending[req_id] = call
         payload = dumps_parts({"id": req_id, "method": method,
                                "args": args, "kwargs": kwargs})
-        try:
-            with self._send_lock:
-                send_frame(self._sock, payload)
-        except OSError as e:
+        for attempt in (0, 1):
+            # Fresh per attempt: a failure is sticky on _PendingCall, and
+            # the evicted socket's dying reader may have failed the first
+            # registration via _fail_all before the retry resends.
+            call = _PendingCall()
             with self._pending_lock:
-                self._pending.pop(req_id, None)
-            self._fail_all(RpcError(f"send to {self.addr} failed: {e}"))
-            raise RpcError(f"send to {self.addr} failed: {e}") from e
+                self._pending[req_id] = call
+            try:
+                with self._send_lock:
+                    send_frame(self._sock, payload)
+                break
+            except OSError as e:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                if attempt == 0 and self._pool_evicted:
+                    # Eviction closed the socket between our open-check
+                    # and the send: re-dial and resend. Any partial frame
+                    # died with the old connection, so no duplicate.
+                    self._ensure_open()
+                    continue
+                self._fail_all(RpcError(f"send to {self.addr} failed: {e}"))
+                raise RpcError(f"send to {self.addr} failed: {e}") from e
         try:
             return call.wait(timeout)
         except TimeoutError:
@@ -745,18 +766,46 @@ class RpcClient:
         self._ensure_open()
         payload = dumps_parts({"id": None, "method": method,
                                "args": args, "kwargs": kwargs})
-        try:
-            with self._send_lock:
-                send_frame(self._sock, payload)
-        except OSError as e:
-            raise RpcError(f"send to {self.addr} failed: {e}") from e
+        for attempt in (0, 1):
+            try:
+                with self._send_lock:
+                    send_frame(self._sock, payload)
+                return
+            except OSError as e:
+                if attempt == 0 and self._pool_evicted:
+                    self._ensure_open()  # send overlapped pool eviction
+                    continue
+                raise RpcError(f"send to {self.addr} failed: {e}") from e
 
     def close(self) -> None:
-        self._closed = True
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        """Permanent close (owner teardown, pool invalidate/close_all).
+        Serialized with ``_ensure_open``'s re-dial via ``_lifecycle_lock``
+        so it can never clobber a half-built fresh connection; pool
+        eviction goes through ``_evict`` instead and stays re-dialable."""
+        with self._lifecycle_lock:
+            self._pool_evicted = False
+            self._closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _evict(self) -> None:
+        """Pool-side close of an idle client a caller may still hold.
+        The evicted mark and the socket close happen atomically under
+        ``_lifecycle_lock``: a holder's re-dial can only run before this
+        (impossible — only ``_evict`` sets ``_pool_evicted``) or after the
+        OLD socket is closed, so eviction can never shut a fresh socket
+        and strand the client permanently closed."""
+        with self._lifecycle_lock:
+            if self._closed:
+                return  # already dead (connection loss or real close)
+            self._pool_evicted = True
+            self._closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
 
 class _PendingCall:
@@ -913,10 +962,9 @@ class ClientPool:
                             and now - getattr(cand, "_last_handout", 0.0)
                             > 5.0):
                         del self._clients[key]
-                        cand._pool_evicted = True
                         evicted.append(cand)
         for c in evicted:
-            c.close()
+            c._evict()  # mark+close atomically; holders re-dial
         return client
 
     def invalidate(self, addr: Addr) -> None:

@@ -113,6 +113,29 @@ def test_server_roundtrip_mixed_payload():
         srv.stop()
 
 
+def test_zero_length_oob_reply_does_not_wedge_connection():
+    """Regression (REVIEW high): an empty numpy array pickles to a 0-byte
+    OOB buffer. Enqueued unfiltered, it sat at the outbound queue head
+    forever — sendmsg consumes 0 bytes of it — spinning the flush loop at
+    100% CPU under st.lock and wedging every later call on the conn."""
+    empty = np.array([], dtype=np.float64)
+    parts = dumps_parts({"id": 1, "ok": True, "result": empty})
+    assert any(memoryview(p).nbytes == 0 for p in parts)  # premise holds
+    srv = _server()
+    try:
+        cli = RpcClient(srv.addr)
+        got = cli.call("echo", empty, timeout=5.0)
+        assert got.shape == (0,)
+        got = cli.call("echo", {"e": np.array([], np.int32), "x": 1},
+                       timeout=5.0)
+        assert got["e"].shape == (0,) and got["x"] == 1
+        for _ in range(3):  # the connection must still be healthy
+            assert cli.call("ping", timeout=5.0) == "pong"
+        cli.close()
+    finally:
+        srv.stop()
+
+
 # ------------------------------------------------- head-of-line blocking
 
 
@@ -253,3 +276,68 @@ def test_client_pool_eviction_redials_transparently():
     finally:
         srv1.stop()
         srv2.stop()
+
+
+def test_eviction_between_open_check_and_send_retries():
+    """Regression (REVIEW medium): a holder whose send overlaps eviction
+    — eviction lands after _ensure_open's check but before send_frame —
+    must retry on a fresh connection instead of failing with RpcError."""
+    srv = _server()
+    try:
+        pool = ClientPool(max_clients=1)
+        c = pool.get(srv.addr)
+        assert c.call("ping") == "pong"
+        orig = c.__class__._ensure_open
+        fired = []
+
+        def hooked(self=c):
+            orig(self)
+            if not fired:  # evict exactly once, right after the check
+                fired.append(1)
+                self._evict()
+
+        c._ensure_open = hooked
+        assert c.call("ping", timeout=5.0) == "pong"  # retried, not failed
+        assert c.notify("ping") is None
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_evict_is_noop_on_closed_client():
+    """_evict after a real close (or connection loss) must not resurrect
+    the client as re-dialable."""
+    srv = _server()
+    try:
+        c = RpcClient(srv.addr)
+        assert c.call("ping") == "pong"
+        c.close()
+        c._evict()
+        assert not c._pool_evicted
+        with pytest.raises(RpcError):
+            c.call("ping")
+    finally:
+        srv.stop()
+
+
+def test_stop_with_wedged_reactor_keeps_selector_fds_open():
+    """Regression (REVIEW low): stop() must not close the wake socketpair
+    and selector while the reactor thread is still alive — a wedged
+    reactor would then select() on closed (and soon reused) fds."""
+    srv = _server()
+    real = srv._reactor_thread
+    wedge = threading.Event()
+    dummy = threading.Thread(target=wedge.wait, daemon=True)
+    dummy.start()
+    srv._reactor_thread = dummy  # simulate a reactor stuck past the join
+    try:
+        srv.stop()
+        assert srv._wake_r.fileno() != -1 and srv._wake_w.fileno() != -1
+        assert srv._selector.get_map() is not None
+    finally:
+        wedge.set()
+        dummy.join(5)
+        real.join(5)  # _stopped is set; the real reactor exits promptly
+        srv._reactor_thread = real
+        srv.stop()  # second stop reaps the selector and wake fds
+        assert srv._wake_r.fileno() == -1 and srv._wake_w.fileno() == -1
