@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import statistics
 import threading
@@ -30,8 +31,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 
 
 def pctl(xs, p):
+    """Nearest-rank percentile: the value at 1-indexed rank ceil(p*n).
+    The old ``int(len(xs) * p)`` index was biased one rank high (p50 of
+    an even-sized sample read above the median; p100 depended on the
+    min() clamp), which skews small-sample p50/p99 rows."""
     xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(len(xs) * p))]
+    return xs[max(0, min(len(xs) - 1, math.ceil(p * len(xs)) - 1))]
 
 
 def engine_rows(params, cfg, quick: bool):
@@ -49,8 +54,12 @@ def engine_rows(params, cfg, quick: bool):
                for _ in range(n_requests)]
 
     for chunk in (1, 8):
+        # Prefix cache off: this workload is zero-share random prompts
+        # (every insert would be futile) — the shared_prefix section is
+        # the one that measures the cache.
         eng = DecodeEngine(params, cfg, slots=slots,
-                           capacity=256, decode_chunk=chunk)
+                           capacity=256, decode_chunk=chunk,
+                           prefix_pool_entries=0)
         # Warm every program before timing: each admission batch size
         # (n = 1..slots, powers of two), the decode step, and (for
         # chunked mode) the whole k ladder — a solo request's
@@ -100,6 +109,103 @@ def engine_rows(params, cfg, quick: bool):
         })
         eng.shutdown()
     return rows
+
+
+def shared_prefix_rows(params, cfg, quick: bool, platform: str):
+    """Shared-prefix workload (hot system prompt): TTFT with the prefix
+    KV cache off vs on, plus hit rate and prefill tokens saved. Models
+    RLAX-style rollout generation / templated chat traffic where >=50%
+    of every prompt is a shared prefix."""
+    from ray_tpu.serve.decode import DecodeEngine
+
+    import numpy as np
+
+    # shared_len sits ON the power-of-two insert grid so the pool entry
+    # covers exactly the shared region (prefix_capacity = capacity//2).
+    slots = 4 if quick else 8
+    shared_len = 32 if quick else 128
+    suffix_len = 12 if quick else 32
+    gen = 4 if quick else 4
+    n_requests = 8 if quick else 32
+    capacity = 128 if quick else 256
+    rng = np.random.default_rng(2)
+    shared = rng.integers(0, cfg.vocab_size, shared_len).tolist()
+    prompts = [shared + rng.integers(0, cfg.vocab_size,
+                                     suffix_len).tolist()
+               for _ in range(n_requests)]
+    prompt_len = shared_len + suffix_len
+
+    results = {}
+    for mode, entries in (("off", 0), ("on", 8)):
+        eng = DecodeEngine(params, cfg, slots=slots, capacity=capacity,
+                           prefix_pool_entries=entries,
+                           prefix_match_min_tokens=16)
+        # Warm every program (admission n ladder, both prefill paths,
+        # decode step) AND the prefix pool itself: the row measures
+        # steady-state serving of a hot prefix, not the cold insert.
+        w = eng.submit(prompts[0], max_new_tokens=2)
+        while not w.done.is_set():
+            eng.step()
+        n_warm = 1
+        while n_warm <= slots:
+            burst = [eng.submit(prompts[i % len(prompts)],
+                                max_new_tokens=1) for i in range(n_warm)]
+            while not all(b.done.is_set() for b in burst):
+                eng.step()
+            n_warm *= 2
+        pre = eng.prefix.stats() if eng.prefix is not None else None
+
+        reqs = [eng.submit(p, max_new_tokens=gen) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            if eng.step() == 0:
+                time.sleep(0.001)
+        ttfts = [1e3 * (r.first_token_at - r.submitted_at) for r in reqs]
+        stats = {"p50": pctl(ttfts, 0.5), "p99": pctl(ttfts, 0.99)}
+        if pre is not None:
+            post = eng.prefix.stats()
+            queries = post["queries"] - pre["queries"]
+            hits = post["hits"] - pre["hits"]
+            stats["hit_rate"] = hits / max(1, queries)
+            stats["tokens_saved"] = (post["prefill_tokens_saved"]
+                                     - pre["prefill_tokens_saved"])
+        eng.shutdown()
+        results[mode] = stats
+
+    speedup = results["off"]["p50"] / max(1e-9, results["on"]["p50"])
+    workload = (f"{n_requests} reqs, prompt {prompt_len} "
+                f"({shared_len} shared / {100 * shared_len // prompt_len}%"
+                f"), {gen} new tokens, {slots} slots; {platform}")
+    return [
+        {
+            "metric": "decode_shared_prefix_ttft_p50_off",
+            "value": round(results["off"]["p50"], 1),
+            "unit": "ms",
+            "note": (f"prefix cache OFF; p99="
+                     f"{results['off']['p99']:.1f}ms; {workload}"),
+        },
+        {
+            "metric": "decode_shared_prefix_ttft_p50_on",
+            "value": round(results["on"]["p50"], 1),
+            "unit": "ms",
+            "note": (f"prefix cache ON (suffix-only prefill); p99="
+                     f"{results['on']['p99']:.1f}ms; {speedup:.1f}x TTFT "
+                     f"p50 vs off; {workload}"),
+        },
+        {
+            "metric": "decode_prefix_hit_rate",
+            "value": round(100 * results["on"]["hit_rate"], 1),
+            "unit": "%",
+            "note": (f"prefix-cache hits / admissions over the timed "
+                     f"workload (warm pool); {workload}"),
+        },
+        {
+            "metric": "decode_prefix_prefill_tokens_saved",
+            "value": int(results["on"]["tokens_saved"]),
+            "unit": "tokens",
+            "note": (f"prompt tokens spliced from the prefix pool "
+                     f"instead of re-prefilled; {workload}"),
+        },
+    ]
 
 
 def serve_stack_row(cfg, quick: bool):
@@ -174,11 +280,25 @@ def serve_stack_row(cfg, quick: bool):
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--sections", default="engine,serve,shared_prefix",
+        help="comma-set of row groups to (re)measure: engine, serve, "
+             "shared_prefix. Only the selected groups' rows are "
+             "replaced in BENCH_SERVE.json; the rest are preserved.")
+    parser.add_argument(
+        "--model", default=None,
+        help="llama preset override (default: debug if --quick else "
+             "160m)")
+    parser.add_argument(
+        "--cpu", action="store_true",
+        help="force JAX_PLATFORMS=cpu but still write BENCH_SERVE.json "
+             "(rows are annotated with the platform)")
     args = parser.parse_args()
+    sections = {s.strip() for s in args.sections.split(",") if s.strip()}
 
     import jax
 
-    if args.quick:
+    if args.quick or args.cpu:
         # Env var too: serve replica workers inherit it at fork, so the
         # whole quick path (driver + replicas) stays on CPU.
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -187,24 +307,33 @@ def main() -> None:
     import ray_tpu
     from ray_tpu.models import llama
 
-    cfg = llama.PRESETS["debug"] if args.quick else llama.PRESETS["160m"]
+    preset = args.model or ("debug" if args.quick else "160m")
+    cfg = llama.PRESETS[preset]
     params = llama.init_params(cfg, jax.random.key(0))
+    platform = jax.devices()[0].platform
+    plat_note = f"{preset} model, {platform} backend"
 
-    rows = engine_rows(params, cfg, args.quick)
-
-    ray_tpu.init(num_cpus=4)
-    try:
-        rows += serve_stack_row(cfg, args.quick)
-    finally:
-        ray_tpu.shutdown()
+    rows = []
+    if "engine" in sections:
+        rows += engine_rows(params, cfg, args.quick)
+    if "shared_prefix" in sections:
+        rows += shared_prefix_rows(params, cfg, args.quick, plat_note)
+    if "serve" in sections:
+        ray_tpu.init(num_cpus=4)
+        try:
+            rows += serve_stack_row(cfg, args.quick)
+        finally:
+            ray_tpu.shutdown()
 
     out_path = "BENCH_SERVE.json"
     doc = {"artifact": "BENCH_SERVE", "rows": []}
     if os.path.exists(out_path) and not args.quick:
         with open(out_path) as f:
             doc = json.load(f)
-        doc["rows"] = [r for r in doc["rows"]
-                       if not r["metric"].startswith("decode_")]
+        # Replace exactly the rows this run re-measured.
+        emitted = {r["metric"] for r in rows}
+        doc["rows"] = [r for r in doc.get("rows", [])
+                       if r["metric"] not in emitted]
     if args.quick:
         out_path = "/tmp/bench_decode_quick.json"
     doc.setdefault("decode_model",

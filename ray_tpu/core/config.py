@@ -183,6 +183,23 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "Dead actor records (and their pubsub entries) retained for late "
         "callers before being reaped (reference: "
         "maximum_gcs_destroyed_actor_cached_count, ray_config_def.h)."),
+    "prefix_pool_entries": (int, 8,
+        "Entries in a DecodeEngine's device-resident prefix KV pool "
+        "(serve/prefix_cache.py): cached prompt prefixes spliced into a "
+        "request's slot at admission so only the uncached suffix is "
+        "prefilled (vLLM/SGLang-style prefix caching on static buckets). "
+        "Each entry costs 2 * L * C_prefix * KV * D cache bytes. "
+        "0 disables the prefix cache."),
+    "prefix_match_min_tokens": (int, 16,
+        "Minimum shared-prefix length (tokens) for a prefix-cache hit; "
+        "prompts shorter than this are neither matched nor inserted "
+        "(splicing a tiny prefix costs more dispatch than it saves)."),
+    "prefix_affinity_enabled": (bool, True,
+        "Serve routers hash a request's leading token buckets and prefer "
+        "the replica advertising that prefix in its cache (falling back "
+        "to pow-2 least-loaded), so hot system prompts stay resident on "
+        "one replica's prefix pool instead of re-prefilling on every "
+        "replica."),
 }
 
 

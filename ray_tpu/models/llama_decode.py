@@ -147,6 +147,74 @@ def prefill(params: Dict[str, Any], tokens: jax.Array, cache: Cache,
     return logits, {"k": new_k, "v": new_v, "length": lengths}
 
 
+def prefill_suffix(params: Dict[str, Any], tokens: jax.Array,
+                   cache: Cache, config: LlamaConfig,
+                   prefix_lens: jax.Array, lengths: jax.Array
+                   ) -> Tuple[jax.Array, Cache]:
+    """Suffix-only prefill: process right-padded suffix ``tokens`` (B, S)
+    starting at ``pos = prefix_lens`` against cache rows whose first
+    ``prefix_lens`` positions are ALREADY populated (spliced from a
+    prefix pool — the serve-plane prefix cache's other half).
+
+    ``lengths`` is each row's TOTAL length (prefix + real suffix); the
+    real suffix length is ``lengths - prefix_lens``. Shapes stay static
+    (one program per (B, S) bucket pair); prefix offsets are traced, so
+    the compiled program set does not grow with prefix lengths.
+
+    Masking is exact for the spliced region: a suffix query at absolute
+    position p attends key positions <= p — the cached prefix plus the
+    causal part of the suffix. Stale positions beyond the written suffix
+    are causally invisible here and masked by ``length`` at decode time.
+    Suffix K/V scatters past the padded tail land out of bounds and are
+    dropped by XLA (never clamped into live rows).
+
+    Returns ``(last_logits (B, V) fp32, cache)`` with ``last_logits``
+    taken at each row's final REAL token, exactly like ``prefill``."""
+    c = config
+    B, S = tokens.shape
+    capacity = cache["k"].shape[2]
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_embed"].astype(c.dtype)[tokens]        # (B, S, E)
+    abs_pos = prefix_lens[:, None] + jnp.arange(S)[None, :]  # (B, S)
+    kv_groups = c.n_heads // c.n_kv_heads
+    scale = c.head_dim ** -0.5
+    rows = jnp.arange(B)
+    valid = (jnp.arange(capacity)[None, None, :]
+             <= abs_pos[:, :, None])                        # (B, S, C)
+
+    def body(x, inp):
+        layer, k_c, v_c = inp                # k_c/v_c: (B, C, KV, D)
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q, k_new, v_new = _qkv(layer, h, c)  # (B, S, H/KV, D)
+        q = apply_rope(q, cos, sin, positions=abs_pos)
+        k_new = apply_rope(k_new, cos, sin, positions=abs_pos)
+        k_c = k_c.at[rows[:, None], abs_pos].set(k_new.astype(k_c.dtype))
+        v_c = v_c.at[rows[:, None], abs_pos].set(v_new.astype(v_c.dtype))
+        qg = q.reshape(B, S, c.n_kv_heads, kv_groups, c.head_dim)
+        scores = jnp.einsum("bskgd,bckd->bkgsc", qg, k_c,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bkgsc,bckd->bkgsd", probs.astype(v_c.dtype), v_c)
+        att = att.transpose(0, 3, 1, 2, 4).reshape(
+            B, S, c.n_heads, c.head_dim).astype(x.dtype)
+        out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
+        x = x + out
+        x = _mlp(layer, x, c)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    idx = jnp.clip(lengths - prefix_lens - 1, 0, S - 1)
+    x_last = jnp.take_along_axis(
+        x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = jnp.einsum("be,ev->bv", x_last,
+                        params["lm_head"].astype(c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": lengths}
+
+
 def decode_step(params: Dict[str, Any], cache: Cache, tokens: jax.Array,
                 config: LlamaConfig) -> Tuple[jax.Array, Cache]:
     """Append one token per slot and return next-token logits.

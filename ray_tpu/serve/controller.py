@@ -171,7 +171,8 @@ class ServeController:
             "replicas": [
                 {"actor_id": r.handle.actor_id.binary(),
                  "replica_id": r.replica_id,
-                 "models": r.last_stats.get("models", [])}
+                 "models": r.last_stats.get("models", []),
+                 "prefixes": r.last_stats.get("prefixes", [])}
                 for r in rec.replicas],
             "max_ongoing_requests": rec.cfg.get("max_ongoing_requests", 8),
             "deleted": rec.deleting,
@@ -197,6 +198,10 @@ class ServeController:
                     "replica_ids": [r.replica_id for r in rec.replicas],
                     "ongoing": sum(
                         r.last_stats.get("ongoing", 0)
+                        for r in rec.replicas),
+                    "load": sum(
+                        max(r.last_stats.get("ongoing", 0),
+                            r.last_stats.get("load", 0))
                         for r in rec.replicas),
                 }
                 for name, rec in self._deployments.items()
@@ -499,7 +504,12 @@ class ServeController:
         auto = rec.cfg.get("autoscaling")
         if auto:
             with rec.lock:
-                ongoing = sum(r.last_stats.get("ongoing", 0)
+                # Replica load = max(HTTP concurrency, replica-reported
+                # backlog): a decode engine with a full pending queue and
+                # every slot busy must scale OUT even when each request
+                # occupies only one "ongoing" call slot.
+                ongoing = sum(max(r.last_stats.get("ongoing", 0),
+                                  r.last_stats.get("load", 0))
                               for r in rec.replicas)
                 desired = max(auto["min_replicas"],
                               min(auto["max_replicas"],
@@ -550,7 +560,11 @@ class ServeController:
                 else rec.cfg.get("num_replicas", 1))
 
     def _models_changed(self, rec: DeploymentRecord) -> bool:
-        cur = {r.replica_id: tuple(r.last_stats.get("models", []))
+        """Model OR prefix residency drift: both route affinity, so both
+        need a snapshot push when they change."""
+        cur = {r.replica_id: (tuple(r.last_stats.get("models", [])),
+                              tuple(sorted(r.last_stats.get("prefixes",
+                                                            []))))
                for r in rec.replicas}
         if self._last_models.get(rec.name) != cur:
             self._last_models[rec.name] = cur

@@ -24,6 +24,7 @@ the serial consumer), matching how a chip is actually scheduled.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -31,6 +32,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -45,9 +48,12 @@ class _Request:
     slot: int = -1
     generated: int = 0
     error: Optional[str] = None
+    on_token_error: Optional[str] = None   # first on_token callback failure
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    prefix_entry: int = -1                 # prefix-pool row spliced in
+    prefix_len: int = 0                    # cached tokens NOT re-prefilled
 
 
 class DecodeEngine:
@@ -61,10 +67,15 @@ class DecodeEngine:
 
     def __init__(self, params, config, slots: int = 4,
                  capacity: int = 1024, prefill_bucket: int = 128,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1,
+                 prefix_pool_entries: Optional[int] = None,
+                 prefix_capacity: Optional[int] = None,
+                 prefix_match_min_tokens: Optional[int] = None):
         import jax
 
+        from ray_tpu.core.config import config as rt_config
         from ray_tpu.models import llama_decode as ld
+        from ray_tpu.serve.prefix_cache import PrefixCache
 
         self._jax = jax
         self._ld = ld
@@ -81,6 +92,34 @@ class DecodeEngine:
         self._rng = np.random.default_rng(0)
         self._stop = threading.Event()
         self._work = threading.Event()
+        # Prefix KV cache: a device-resident pool of cached prompt-prefix
+        # K/V (P entries x C_prefix tokens) indexed by a host-side trie.
+        # At admission the longest cached prefix is spliced into the
+        # request's slot and only the suffix is prefilled.
+        entries = (rt_config.prefix_pool_entries
+                   if prefix_pool_entries is None else prefix_pool_entries)
+        min_tokens = (rt_config.prefix_match_min_tokens
+                      if prefix_match_min_tokens is None
+                      else prefix_match_min_tokens)
+        if prefix_capacity is None:
+            prefix_capacity = 1
+            while prefix_capacity * 2 <= capacity // 2:
+                prefix_capacity *= 2
+        self.prefix: Optional[PrefixCache] = None
+        self._pool = None
+        if entries > 0 and prefix_capacity >= max(2, min_tokens):
+            self.prefix = PrefixCache(entries, prefix_capacity,
+                                      min_tokens=min_tokens)
+            c = config
+            pool_shape = (c.n_layers, entries, prefix_capacity,
+                          c.n_kv_heads, c.head_dim)
+            import jax.numpy as jnp
+            self._pool = {"k": jnp.zeros(pool_shape, c.dtype),
+                          "v": jnp.zeros(pool_shape, c.dtype)}
+        # Suffix prefills bucket on a finer grid than full prefills: the
+        # whole point is that the suffix is short, so padding it back up
+        # to prefill_bucket would refund most of the win.
+        self._suffix_bucket_min = max(8, min(16, prefill_bucket))
         # Per-(bucket) jitted single-slot prefill: writes one row of the
         # shared cache. Donating the cache makes the slot insert in-place.
         # Params are ARGUMENTS (not closure captures), or jit would bake
@@ -88,6 +127,15 @@ class DecodeEngine:
         self._prefill_many = jax.jit(
             self._prefill_many_impl, static_argnames=("n", "bucket"),
             donate_argnums=(1,))
+        # Prefix-hit admission: splice pool entries into the wave's slots
+        # and prefill only the suffixes — one program per (n, bucket)
+        # power-of-two pair, like _prefill_many. Pool insert copies a
+        # freshly prefilled slot's leading positions into a pool row.
+        self._prefill_suffix_many = jax.jit(
+            self._prefill_suffix_many_impl,
+            static_argnames=("n", "bucket"), donate_argnums=(1,))
+        self._pool_insert = jax.jit(self._pool_insert_impl,
+                                    donate_argnums=(1, 2))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         # K greedy steps per device call (dispatch amortization); chunking
         # only engages when no admissions are pending and every active
@@ -118,6 +166,44 @@ class DecodeEngine:
             "length": cache["length"].at[slot_ids].set(lengths),
         }
         return logits, new
+
+    def _prefill_suffix_many_impl(self, params, cache, pool_k, pool_v,
+                                  entry_ids, slot_ids, suffix_rows,
+                                  prefix_lens, lengths, n, bucket):
+        """Prefix-hit admission in ONE device call: gather the wave's
+        slot rows, splice the matched pool entries over their leading
+        ``C_prefix`` positions, suffix-prefill from ``pos=prefix_lens``,
+        and scatter the rows back. The splice copies the WHOLE entry
+        region unconditionally (static shape): positions past the match
+        are overwritten by the suffix or causally masked, never read."""
+        ld = self._ld
+        cp = pool_k.shape[2]
+        # Every read/write in this program lands below prefix+suffix
+        # (prefix_lens <= C_prefix, suffix spans `bucket`), so the
+        # gather, attention, and scatter run over that STATIC bound
+        # instead of the full capacity — the suffix path's cost scales
+        # with what it touches, not with the engine's max context.
+        lim = min(self.capacity, cp + bucket)
+        rows_k = cache["k"][:, slot_ids, :lim]    # (L, n, lim, KV, D)
+        rows_v = cache["v"][:, slot_ids, :lim]
+        rows_k = rows_k.at[:, :, :cp].set(pool_k[:, entry_ids])
+        rows_v = rows_v.at[:, :, :cp].set(pool_v[:, entry_ids])
+        row_cache = {"k": rows_k, "v": rows_v, "length": lengths}
+        logits, row_cache = ld.prefill_suffix(
+            params, suffix_rows[:, :bucket], row_cache, self.config,
+            prefix_lens, lengths)
+        new = {
+            "k": cache["k"].at[:, slot_ids, :lim].set(row_cache["k"]),
+            "v": cache["v"].at[:, slot_ids, :lim].set(row_cache["v"]),
+            "length": cache["length"].at[slot_ids].set(lengths),
+        }
+        return logits, new
+
+    def _pool_insert_impl(self, cache, pool_k, pool_v, slot, entry):
+        cp = pool_k.shape[2]
+        new_k = pool_k.at[:, entry].set(cache["k"][:, slot, :cp])
+        new_v = pool_v.at[:, entry].set(cache["v"][:, slot, :cp])
+        return new_k, new_v
 
     def _decode_impl(self, params, cache, tokens):
         return self._ld.decode_step(params, cache, tokens, self.config)
@@ -156,12 +242,10 @@ class DecodeEngine:
     # -------------------------------------------------------- the loop
 
     def _admit(self) -> None:
-        import jax.numpy as jnp
-
-        ld = self._ld
         while self._free and not self._pending.empty():
-            # Drain up to len(free) pending requests and prefill them as
-            # ONE batched device call per prompt bucket.
+            # Drain up to len(free) pending requests, split them into
+            # prefix-cache hits and misses, and prefill each group as
+            # ONE batched device call per prompt/suffix bucket.
             wave: List[_Request] = []
             while len(wave) < len(self._free):
                 try:
@@ -170,46 +254,126 @@ class DecodeEngine:
                     break
             if not wave:
                 return
-            by_bucket: Dict[int, List[_Request]] = {}
+            hits: List[_Request] = []
+            misses: List[_Request] = []
             for req in wave:
-                bucket = min(ld.cache_bucket(len(req.tokens),
-                                             self.prefill_bucket),
-                             self.capacity)
-                by_bucket.setdefault(bucket, []).append(req)
-            for bucket, reqs in by_bucket.items():
-                slots = [self._free.pop() for _ in reqs]
-                # Pad the admission count to a power of two (bounded
-                # program set); pad rows REPEAT the last real row into
-                # the same slot — an idempotent overwrite.
-                n = 1
-                while n < len(reqs):
-                    n *= 2
-                rows = np.zeros((n, bucket), np.int32)
-                lengths = np.zeros((n,), np.int32)
-                slot_ids = np.full((n,), slots[-1], np.int32)
-                for i, req in enumerate(reqs):
-                    rows[i, :len(req.tokens)] = req.tokens
-                    lengths[i] = len(req.tokens)
-                    slot_ids[i] = slots[i]
-                for i in range(len(reqs), n):  # idempotent pad rows
-                    rows[i] = rows[len(reqs) - 1]
-                    lengths[i] = lengths[len(reqs) - 1]
-                logits, self.cache = self._prefill_many(
-                    self.params, self.cache, jnp.asarray(rows),
-                    jnp.asarray(lengths), jnp.asarray(slot_ids),
-                    n=n, bucket=bucket)
-                logits = np.asarray(logits)
-                now = time.monotonic()
-                for i, req in enumerate(reqs):
-                    tok = self._sample_host(logits[i], req)
-                    req.slot = slots[i]
-                    req.first_token_at = now
-                    self._emit(req, tok)
-                    self._tokens[slots[i]] = tok
-                    self._active[slots[i]] = req
-                    if req.generated >= req.max_new_tokens or (
-                            req.eos_id is not None and tok == req.eos_id):
-                        self._finish(slots[i])
+                m = (self.prefix.match(req.tokens)
+                     if self.prefix is not None else None)
+                if m is not None:
+                    req.prefix_entry, req.prefix_len = m
+                    hits.append(req)
+                else:
+                    misses.append(req)
+            self._admit_full(misses)
+            self._admit_suffix(hits)
+
+    def _admit_full(self, reqs: List[_Request]) -> None:
+        import jax.numpy as jnp
+
+        ld = self._ld
+        by_bucket: Dict[int, List[_Request]] = {}
+        for req in reqs:
+            bucket = min(ld.cache_bucket(len(req.tokens),
+                                         self.prefill_bucket),
+                         self.capacity)
+            by_bucket.setdefault(bucket, []).append(req)
+        for bucket, group in by_bucket.items():
+            slots = [self._free.pop() for _ in group]
+            # Pad the admission count to a power of two (bounded
+            # program set); pad rows REPEAT the last real row into
+            # the same slot — an idempotent overwrite.
+            n = 1
+            while n < len(group):
+                n *= 2
+            rows = np.zeros((n, bucket), np.int32)
+            lengths = np.zeros((n,), np.int32)
+            slot_ids = np.full((n,), slots[-1], np.int32)
+            for i, req in enumerate(group):
+                rows[i, :len(req.tokens)] = req.tokens
+                lengths[i] = len(req.tokens)
+                slot_ids[i] = slots[i]
+            for i in range(len(group), n):  # idempotent pad rows
+                rows[i] = rows[len(group) - 1]
+                lengths[i] = lengths[len(group) - 1]
+            logits, self.cache = self._prefill_many(
+                self.params, self.cache, jnp.asarray(rows),
+                jnp.asarray(lengths), jnp.asarray(slot_ids),
+                n=n, bucket=bucket)
+            self._post_admit(group, slots, np.asarray(logits))
+
+    def _admit_suffix(self, reqs: List[_Request]) -> None:
+        """Prefix-hit admissions: splice the matched pool entry into each
+        request's slot and prefill only the uncached suffix."""
+        import jax.numpy as jnp
+
+        ld = self._ld
+        by_bucket: Dict[int, List[_Request]] = {}
+        for req in reqs:
+            suffix_len = len(req.tokens) - req.prefix_len
+            bucket = min(ld.cache_bucket(suffix_len,
+                                         self._suffix_bucket_min),
+                         self.capacity)
+            by_bucket.setdefault(bucket, []).append(req)
+        for bucket, group in by_bucket.items():
+            slots = [self._free.pop() for _ in group]
+            n = 1
+            while n < len(group):
+                n *= 2
+            rows = np.zeros((n, bucket), np.int32)
+            plens = np.zeros((n,), np.int32)
+            lengths = np.zeros((n,), np.int32)
+            entries = np.zeros((n,), np.int32)
+            slot_ids = np.full((n,), slots[-1], np.int32)
+            for i, req in enumerate(group):
+                suffix = req.tokens[req.prefix_len:]
+                rows[i, :len(suffix)] = suffix
+                plens[i] = req.prefix_len
+                lengths[i] = len(req.tokens)
+                entries[i] = req.prefix_entry
+                slot_ids[i] = slots[i]
+            for i in range(len(group), n):  # idempotent pad rows
+                rows[i] = rows[len(group) - 1]
+                plens[i] = plens[len(group) - 1]
+                lengths[i] = lengths[len(group) - 1]
+                entries[i] = entries[len(group) - 1]
+            logits, self.cache = self._prefill_suffix_many(
+                self.params, self.cache, self._pool["k"], self._pool["v"],
+                jnp.asarray(entries), jnp.asarray(slot_ids),
+                jnp.asarray(rows), jnp.asarray(plens),
+                jnp.asarray(lengths), n=n, bucket=bucket)
+            for req in group:
+                # The splice program holding the entry is dispatched (and
+                # device order is program order), so the row may now be
+                # recycled without racing the read.
+                self.prefix.release(req.prefix_entry)
+            self._post_admit(group, slots, np.asarray(logits))
+
+    def _post_admit(self, group: List[_Request], slots: List[int],
+                    logits: np.ndarray) -> None:
+        now = time.monotonic()
+        for i, req in enumerate(group):
+            tok = self._sample_host(logits[i], req)
+            req.slot = slots[i]
+            req.first_token_at = now
+            self._emit(req, tok)
+            self._tokens[slots[i]] = tok
+            self._active[slots[i]] = req
+            if req.generated >= req.max_new_tokens or (
+                    req.eos_id is not None and tok == req.eos_id):
+                self._finish(slots[i])
+        # Insert the freshly prefilled prompts back into the prefix pool
+        # NOW, before any later admission can recycle these slots: the
+        # slot rows still hold the full prompt K/V (a _finish only parks
+        # ``length``), and pool inserts dedup on the token key.
+        if self.prefix is not None:
+            for req, slot in zip(group, slots):
+                ins = self.prefix.insert(req.tokens,
+                                         matched_len=req.prefix_len)
+                if ins is not None:
+                    row, _ins_len = ins
+                    self._pool["k"], self._pool["v"] = self._pool_insert(
+                        self.cache, self._pool["k"], self._pool["v"],
+                        slot, row)
 
     def _sample_host(self, logits: np.ndarray, req: _Request) -> int:
         if req.temperature <= 0.0:
@@ -220,15 +384,31 @@ class DecodeEngine:
         p /= p.sum()
         return int(self._rng.choice(len(p), p=p))
 
+    _last_cb_log = 0.0  # class-wide rate limit for callback-failure logs
+
     def _emit(self, req: _Request, tok: int) -> None:
         req.output.append(tok)
         req.generated += 1
         self.tokens_out += 1
-        if req.on_token is not None:
-            try:
-                req.on_token(tok)
-            except Exception:
-                pass
+        if req.on_token is None:
+            return
+        try:
+            req.on_token(tok)
+        except Exception as e:  # noqa: BLE001 — the decode loop must
+            # survive a broken streaming consumer, but silently eating
+            # the error made streaming failures undiagnosable. Record
+            # the FIRST failure on the request and log once per request
+            # (rate-limited across requests: a wedged consumer fails on
+            # every token of every request).
+            if req.on_token_error is None:
+                req.on_token_error = f"{type(e).__name__}: {e}"
+                now = time.monotonic()
+                if now - DecodeEngine._last_cb_log > 1.0:
+                    DecodeEngine._last_cb_log = now
+                    logger.warning(
+                        "on_token callback failed (slot %d, %d tokens "
+                        "emitted): %s", req.slot, req.generated,
+                        req.on_token_error, exc_info=True)
 
     def _finish(self, slot: int) -> None:
         req = self._active.pop(slot)
@@ -313,13 +493,23 @@ class DecodeEngine:
     # ------------------------------------------------------------ stats
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        active = len(self._active)
+        queued = self._pending.qsize()
+        out = {
             "steps": self.steps,
             "tokens_out": self.tokens_out,
-            "active": len(self._active),
+            "active": active,
+            "slots": self.slots,
             "free_slots": len(self._free),
-            "queued": self._pending.qsize(),
+            "queued": queued,
+            # Decode backlog as replica load: occupied slots + pending
+            # queue depth. A full queue behind idle HTTP must read as
+            # load to the serve autoscaler, not zero.
+            "load": active + queued,
         }
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
+        return out
 
 
 class LlamaDecodeDeployment:
@@ -330,7 +520,10 @@ class LlamaDecodeDeployment:
 
     def __init__(self, preset: str = "debug", slots: int = 4,
                  capacity: int = 1024, seed: int = 0,
-                 config=None, decode_chunk: int = 1):
+                 config=None, decode_chunk: int = 1,
+                 prefix_pool_entries: Optional[int] = None,
+                 prefix_capacity: Optional[int] = None,
+                 prefix_match_min_tokens: Optional[int] = None):
         import jax
 
         from ray_tpu.models import llama
@@ -338,12 +531,27 @@ class LlamaDecodeDeployment:
         cfg = config or llama.PRESETS[preset]
         self.cfg = cfg
         params = llama.init_params(cfg, jax.random.key(seed))
-        self.engine = DecodeEngine(params, cfg, slots=slots,
-                                   capacity=capacity,
-                                   decode_chunk=decode_chunk)
+        self.engine = DecodeEngine(
+            params, cfg, slots=slots, capacity=capacity,
+            decode_chunk=decode_chunk,
+            prefix_pool_entries=prefix_pool_entries,
+            prefix_capacity=prefix_capacity,
+            prefix_match_min_tokens=prefix_match_min_tokens)
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()
+
+    def replica_metrics(self) -> Dict[str, Any]:
+        """Replica-reported load + prefix residency, merged into
+        ``ReplicaActor.stats()``: the autoscaler scales on decode backlog
+        and the router steers shared prefixes to the replica already
+        holding them."""
+        s = self.engine.stats()
+        out: Dict[str, Any] = {"load": s["load"]}
+        if self.engine.prefix is not None:
+            out["prefix"] = s.get("prefix", {})
+            out["prefixes"] = self.engine.prefix.hashes()
+        return out
 
     def __call__(self, request: Dict[str, Any]):
         if request.get("stream"):

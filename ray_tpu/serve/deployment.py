@@ -105,9 +105,33 @@ def deployment(_cls=None, **kwargs):
     return wrap
 
 
+def _affinity_hashes(args: tuple):
+    """Candidate prefix hashes for a generation-shaped request (a dict
+    with a ``tokens`` sequence as the first positional arg). Returns
+    None when affinity is disabled or the request has no token prompt —
+    routing then falls through to pure pow-2 least-loaded."""
+    from ray_tpu.core.config import config as rt_config
+
+    if not rt_config.prefix_affinity_enabled:
+        return None
+    req = args[0] if args else None
+    if not isinstance(req, dict):
+        return None
+    tokens = req.get("tokens")
+    if tokens is None:
+        return None
+    try:
+        from ray_tpu.serve.prefix_cache import candidate_hashes
+
+        return candidate_hashes(
+            tokens, rt_config.prefix_match_min_tokens) or None
+    except Exception:
+        return None
+
+
 class _Router:
     """Per-process router for one deployment: pubsub-fed replica snapshot +
-    client-side pow-2 routing with model affinity."""
+    client-side pow-2 routing with model and prefix-cache affinity."""
 
     _instances: Dict[str, "_Router"] = {}
     _instances_lock = threading.Lock()
@@ -148,7 +172,8 @@ class _Router:
             self._replicas = [
                 {"handle": ActorHandle(ActorID(r["actor_id"])),
                  "id": r["replica_id"],
-                 "models": set(r.get("models", []))}
+                 "models": set(r.get("models", [])),
+                 "prefixes": set(r.get("prefixes", []))}
                 for r in snapshot.get("replicas", [])]
             live = {r["id"] for r in self._replicas}
             self._inflight = {k: v for k, v in self._inflight.items()
@@ -218,9 +243,13 @@ class _Router:
 
     # ---------------------------------------------------------- routing
 
-    def _pick(self, model_id: str):
+    def _pick(self, model_id: str, prefix_hashes=None):
         """Pow-2 choices on local in-flight counts; with a model id,
-        replicas that already hold the model win (multiplex affinity)."""
+        replicas that already hold the model win (multiplex affinity);
+        with prefix hashes, replicas advertising the request's leading
+        token bucket win (prefix-cache affinity) — a hot system prompt
+        stays resident on ONE replica's prefix pool instead of being
+        re-prefilled on every replica."""
         with self._lock:
             replicas = self._replicas
             if not replicas:
@@ -234,6 +263,17 @@ class _Router:
                         if self._inflight.get(r["id"], 0) < self._max_ongoing]
                 if warm:
                     pool = warm
+            if prefix_hashes:
+                # Longest advertised bucket wins; same saturation escape
+                # valve as model affinity (least-loaded beats affinity
+                # once the warm replica is at max_ongoing).
+                for h in prefix_hashes:
+                    warm = [r for r in pool if h in r["prefixes"]
+                            and self._inflight.get(r["id"], 0)
+                            < self._max_ongoing]
+                    if warm:
+                        pool = warm
+                        break
             if len(pool) == 1:
                 chosen = pool[0]
             else:
@@ -260,9 +300,10 @@ class _Router:
     def _run_one(self, fut: Future, method, args, kwargs, model_id) -> None:
         try:
             self.wait_ready()
+            prefix_hashes = _affinity_hashes(args)
             last_err: Optional[BaseException] = None
             for _attempt in range(3):
-                replica = self._pick(model_id)
+                replica = self._pick(model_id, prefix_hashes)
                 if replica is None:
                     if self._deleted:
                         raise RuntimeError(
@@ -294,7 +335,7 @@ class _Router:
         in-flight slot and this router's count are held for the stream's
         lifetime (autoscaling sees streams as load)."""
         self.wait_ready()
-        replica = self._pick(model_id)
+        replica = self._pick(model_id, _affinity_hashes(args))
         if replica is None:
             raise RuntimeError(
                 f"deployment {self.name!r} has no replicas")

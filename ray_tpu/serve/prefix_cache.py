@@ -1,0 +1,263 @@
+"""Host-side prefix KV-cache index for the decode serving plane.
+
+vLLM/SGLang-style prefix caching, adapted to this repo's static-bucket
+TPU engine (``serve/decode.py``): requests that share a prompt prefix
+(system prompts, few-shot templates, RL rollout generation) should pay
+prefill only for their uncached SUFFIX. The split of responsibilities:
+
+* THIS module is the host-side index: a token-level trie mapping cached
+  prefixes to rows of a device-resident prefix pool, with refcounted LRU
+  eviction and hit/saved-token accounting. It never touches device
+  memory — the engine owns the pool arrays and the jitted gather/scatter
+  programs that splice an entry into a request's slot.
+* Entries are inserted at BUCKET-ALIGNED lengths (largest power of two
+  <= the prompt length, capped at the pool's per-entry capacity) and
+  deduplicated on their token key, so the compiled-program set and the
+  router's affinity hash grid stay bounded.
+* A match may be PARTIAL: a request sharing only the first 40 tokens of
+  a 64-token entry still splices the whole entry — the suffix prefill
+  overwrites positions >= 40 and the per-slot length mask hides the
+  rest, so correctness never depends on the match covering the entry.
+
+``prefix_hash``/``candidate_hashes`` are shared with the serve router:
+replicas advertise the hashes of their resident entries, and routers
+hash a request's leading token buckets to find the replica whose pool
+already holds the prompt (prefix-affinity routing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def prefix_hash(tokens) -> str:
+    """Stable short hash of a token-id sequence (router <-> replica
+    affinity key; also the pool's dedup identity)."""
+    arr = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    return hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
+
+
+def bucket_lengths(n: int, min_tokens: int,
+                   cap: Optional[int] = None) -> List[int]:
+    """Power-of-two prefix lengths <= n (>= min_tokens, <= cap),
+    DESCENDING — the grid on which entries are inserted and affinity
+    hashes computed."""
+    out: List[int] = []
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    while b >= max(1, min_tokens):
+        if cap is None or b <= cap:
+            out.append(b)
+        b //= 2
+    return out
+
+
+def candidate_hashes(tokens, min_tokens: int,
+                     cap: Optional[int] = None) -> List[str]:
+    """Hashes of a prompt's leading buckets, longest first: the router
+    probes these against replicas' advertised prefix sets."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    return [prefix_hash(toks[:b])
+            for b in bucket_lengths(len(toks), min_tokens, cap)]
+
+
+class _Node:
+    __slots__ = ("children", "count", "entry")
+
+    def __init__(self):
+        self.children: Dict[int, "_Node"] = {}
+        self.count = 0                 # entries terminating in this subtree
+        self.entry: Optional[int] = None  # pool row terminating HERE
+
+
+@dataclass
+class _Entry:
+    row: int                 # pool row holding this prefix's K/V
+    tokens: np.ndarray       # the cached token prefix, (length,)
+    length: int
+    key_hash: str
+    refcount: int = 0        # in-flight splices pinning the row
+    last_used: int = 0       # logical LRU clock
+
+
+class PrefixCache:
+    """Trie index over token-id prefixes -> refcounted pool rows.
+
+    ``entries`` pool rows of up to ``capacity`` tokens each. ``match``
+    ACQUIRES the returned entry (the caller releases after the splice is
+    dispatched); eviction only ever picks rows with refcount == 0, so a
+    row can never be recycled under an in-flight splice."""
+
+    def __init__(self, entries: int, capacity: int, min_tokens: int = 16):
+        self.capacity = int(capacity)
+        self.min_tokens = max(1, int(min_tokens))
+        self._root = _Node()
+        self._entries: Dict[int, _Entry] = {}
+        self._free: List[int] = list(range(int(entries)))
+        self._clock = 0
+        self.queries = 0
+        self.hits = 0
+        self.tokens_matched = 0  # prefill tokens saved by splicing
+        self.inserts = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ----------------------------------------------------------- match
+
+    def match(self, tokens) -> Optional[Tuple[int, int]]:
+        """Longest cached-prefix match: ``(entry_row, matched_len)`` with
+        the entry acquired (caller MUST ``release``), or None. The match
+        is capped at ``len(tokens) - 1``: at least one real suffix token
+        must remain to produce next-token logits."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        self.queries += 1
+        limit = min(len(toks) - 1, self.capacity)
+        node = self._root
+        depth = 0
+        while depth < limit:
+            child = node.children.get(int(toks[depth]))
+            if child is None or child.count == 0:
+                break
+            node = child
+            depth += 1
+        if depth < self.min_tokens or node is self._root:
+            return None
+        row = self._find_entry(node)
+        if row is None:
+            return None
+        ent = self._entries[row]
+        ent.refcount += 1
+        self._clock += 1
+        ent.last_used = self._clock
+        self.hits += 1
+        self.tokens_matched += depth
+        return row, depth
+
+    def _find_entry(self, node: _Node) -> Optional[int]:
+        """Any entry in ``node``'s subtree: every entry below shares the
+        walked prefix, and the splice + suffix overwrite makes them all
+        equally correct donors."""
+        while node.entry is None:
+            for child in node.children.values():
+                if child.count > 0:
+                    node = child
+                    break
+            else:
+                return None
+        return node.entry
+
+    def release(self, row: int) -> None:
+        ent = self._entries.get(row)
+        if ent is not None and ent.refcount > 0:
+            ent.refcount -= 1
+
+    # ---------------------------------------------------------- insert
+
+    def insert(self, tokens,
+               matched_len: int = 0) -> Optional[Tuple[int, int]]:
+        """Offer a completed prompt to the pool. Returns ``(row,
+        insert_len)`` — the caller must then copy the slot's first
+        ``capacity`` cache positions into pool row ``row`` — or None
+        (prefix too short, already cached, covered, or every row is
+        pinned). ``insert_len`` is bucket-aligned (largest power of two
+        <= the prompt length).
+
+        ``matched_len`` is the prompt's own prefix-cache match at
+        admission: inserting is skipped unless it would at least DOUBLE
+        the cached coverage for this prompt. Without this, a hot shared
+        prefix followed by per-request random suffixes inserts a
+        distinct (never-deduped) entry per request — a device copy per
+        admission plus pool thrash that costs more than the cache saves."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        lens = bucket_lengths(len(toks), self.min_tokens, self.capacity)
+        if not lens:
+            return None
+        ins_len = lens[0]
+        if matched_len * 2 >= ins_len:
+            return None
+        key = toks[:ins_len]
+        node = self._root
+        for t in key:
+            child = node.children.get(int(t))
+            if child is None:
+                break
+            node = child
+        else:
+            if node.entry is not None:  # dedup: refresh recency only
+                ent = self._entries[node.entry]
+                self._clock += 1
+                ent.last_used = self._clock
+                return None
+        row = self._alloc_row()
+        if row is None:
+            return None
+        ent = _Entry(row, np.array(key, np.int32), ins_len,
+                     prefix_hash(key))
+        self._clock += 1
+        ent.last_used = self._clock
+        self._entries[row] = ent
+        node = self._root
+        for t in key:
+            child = node.children.get(int(t))
+            if child is None:
+                child = _Node()
+                node.children[int(t)] = child
+            child.count += 1
+            node = child
+        node.entry = row
+        self.inserts += 1
+        return row, ins_len
+
+    def _alloc_row(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim: Optional[_Entry] = None
+        for ent in self._entries.values():
+            if ent.refcount == 0 and (victim is None
+                                      or ent.last_used < victim.last_used):
+                victim = ent
+        if victim is None:
+            return None  # every row pinned by an in-flight splice
+        self._evict(victim)
+        return victim.row
+
+    def _evict(self, ent: _Entry) -> None:
+        node = self._root
+        for t in ent.tokens:
+            child = node.children[int(t)]
+            child.count -= 1
+            if child.count == 0:
+                del node.children[int(t)]
+                break
+            node = child
+        else:
+            node.entry = None
+        del self._entries[ent.row]
+        self.evictions += 1
+
+    # ----------------------------------------------------------- stats
+
+    def hashes(self) -> List[str]:
+        """Resident entry hashes, for replica advertisement. Called from
+        the replica's stats thread while the decode thread mutates the
+        index: list() snapshots the dict atomically under the GIL."""
+        return [ent.key_hash for ent in list(self._entries.values())]
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "entries": len(self._entries),
+            "queries": self.queries,
+            "hits": self.hits,
+            "hit_rate": round(self.hits / self.queries, 4)
+            if self.queries else 0.0,
+            "prefill_tokens_saved": self.tokens_matched,
+            "inserts": self.inserts,
+            "evictions": self.evictions,
+        }

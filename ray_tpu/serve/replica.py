@@ -185,10 +185,23 @@ class ReplicaActor:
 
     def stats(self) -> Dict[str, Any]:
         models = loaded_model_ids(self._instance)
+        # Instance-reported metrics (e.g. a DecodeEngine's backlog as
+        # "load" and its prefix-cache residency as "prefixes"): merged in
+        # so the controller autoscales on decode backlog — a full decode
+        # queue behind idle HTTP concurrency is NOT zero load — and the
+        # router can steer shared prefixes to the replica holding them.
+        out: Dict[str, Any] = {}
+        metrics = getattr(self._instance, "replica_metrics", None)
+        if callable(metrics):
+            try:
+                out = dict(metrics())
+            except Exception:
+                out = {}
         with self._lock:
-            return {"ongoing": self._ongoing, "total": self._total,
-                    "models": models,
-                    "uptime_s": time.monotonic() - self._started}
+            out.update({"ongoing": self._ongoing, "total": self._total,
+                        "models": models,
+                        "uptime_s": time.monotonic() - self._started})
+        return out
 
     def ping(self) -> str:
         return "pong"

@@ -1,0 +1,302 @@
+"""Prefix KV cache: trie match/insert/evict + refcount-vs-evict on the
+host index (``serve/prefix_cache.py``), suffix-only prefill equivalence
+vs full prefill (``llama_decode.prefill_suffix``), the decode engine's
+splice + suffix-prefill admission path, and prefix-affinity routing.
+All CPU, tiny configs — tier-1 safe."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.prefix_cache import (PrefixCache, bucket_lengths,
+                                        candidate_hashes, prefix_hash)
+
+
+def _tiny():
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=61, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, mlp_dim=64, max_seq_len=128)
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+# ------------------------------------------------------------ host index
+
+
+def test_bucket_lengths_grid():
+    assert bucket_lengths(100, 16) == [64, 32, 16]
+    assert bucket_lengths(64, 16) == [64, 32, 16]
+    assert bucket_lengths(15, 16) == []
+    assert bucket_lengths(100, 16, cap=32) == [32, 16]
+
+
+def test_match_insert_dedup():
+    pc = PrefixCache(entries=4, capacity=32, min_tokens=4)
+    toks = list(range(10, 30))  # 20 tokens
+    assert pc.match(toks) is None
+    row, ins_len = pc.insert(toks)
+    assert ins_len == 16  # largest power of two <= 20
+    assert pc.insert(toks) is None  # dedup on the token key
+    m = pc.match(toks)
+    assert m == (row, 16)
+    pc.release(row)
+    assert pc.stats()["hit_rate"] == 0.5  # 1 hit / 2 queries
+
+
+def test_partial_match_and_min_tokens():
+    pc = PrefixCache(4, 32, min_tokens=4)
+    toks = list(range(100, 132))
+    pc.insert(toks)  # 32-token entry
+    # A request sharing only the first 7 tokens still matches (the
+    # splice + suffix overwrite makes partial donors correct).
+    m = pc.match(toks[:7] + [999] * 20)
+    assert m is not None and m[1] == 7
+    pc.release(m[0])
+    # Below min_tokens: no hit.
+    assert pc.match(toks[:3] + [5, 6, 7, 8]) is None
+
+
+def test_match_leaves_one_suffix_token():
+    pc = PrefixCache(4, 32, min_tokens=4)
+    toks = list(range(8))
+    pc.insert(toks)
+    m = pc.match(toks)  # identical prompt: next-token logits still need
+    assert m is not None and m[1] == 7  # >= 1 real suffix token
+    pc.release(m[0])
+
+
+def test_nested_entries():
+    pc = PrefixCache(4, 32, min_tokens=2)
+    long = list(range(16))
+    r_long, _ = pc.insert(long)
+    short = pc.insert(long[:8])  # strict prefix of an existing entry
+    assert short is not None and short[1] == 8
+    assert len(pc) == 2
+
+
+def test_lru_eviction_prunes_trie():
+    pc = PrefixCache(2, 32, min_tokens=2)
+    a, b, c = [1] * 4, [2] * 4, [3] * 4
+    pc.insert(a)
+    row_b, _ = pc.insert(b)
+    m = pc.match(a + [9])  # touch a: b becomes LRU
+    pc.release(m[0])
+    row_c, _ = pc.insert(c)
+    assert row_c == row_b  # b's row recycled
+    assert pc.evictions == 1
+    assert pc.match(b + [9]) is None  # b's trie path pruned
+    assert pc.match(a + [9]) is not None
+
+
+def test_refcount_blocks_eviction():
+    """The refcount-vs-evict race: a row pinned by an in-flight splice
+    must never be recycled, even when it is the LRU victim."""
+    pc = PrefixCache(1, 32, min_tokens=2)
+    row, _ = pc.insert([1] * 4)
+    m = pc.match([1, 1, 1, 1, 9])  # acquires the only row
+    assert m is not None
+    assert pc.insert([2] * 4) is None  # every row pinned: insert refused
+    pc.release(m[0])
+    replacement = pc.insert([2] * 4)
+    assert replacement is not None and replacement[0] == row
+
+
+def test_candidate_hashes_match_advertised_entries():
+    """The router's candidate grid and the pool's insert grid agree, so
+    an advertised entry hash is discoverable from the raw prompt."""
+    toks = list(range(100))
+    pc = PrefixCache(4, 64, min_tokens=16)
+    pc.insert(toks)  # entry at length 64
+    assert pc.hashes() == [candidate_hashes(toks, 16)[0]]
+    assert prefix_hash(toks[:64]) == pc.hashes()[0]
+
+
+# ------------------------------------------- suffix-prefill equivalence
+
+
+def test_suffix_prefill_matches_full_prefill():
+    """Greedy tokens are identical whether a prompt is prefilled whole
+    or spliced (prefix from cache) + suffix-prefilled: the mask over the
+    spliced region is exact."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama_decode as ld
+
+    cfg, params = _tiny()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, 24).astype(np.int32)
+    full = ld.init_cache(cfg, 1, 64)
+    logits_full, full = ld.prefill(params, jnp.asarray(prompt[None]),
+                                   full, cfg)
+    p = 16
+    spliced = ld.init_cache(cfg, 1, 64)
+    _, spliced = ld.prefill(params, jnp.asarray(prompt[None, :p]),
+                            spliced, cfg)
+    suffix = np.zeros((1, 16), np.int32)
+    suffix[0, :len(prompt) - p] = prompt[p:]
+    logits_suf, spliced = ld.prefill_suffix(
+        params, jnp.asarray(suffix), spliced, cfg,
+        jnp.array([p], np.int32), jnp.array([len(prompt)], np.int32))
+    np.testing.assert_allclose(np.asarray(logits_suf),
+                               np.asarray(logits_full),
+                               rtol=2e-2, atol=2e-2)
+    # Greedy continuation is token-for-token identical.
+    ta = jnp.argmax(logits_full, -1).astype(jnp.int32)
+    tb = jnp.argmax(logits_suf, -1).astype(jnp.int32)
+    for _ in range(6):
+        assert int(ta[0]) == int(tb[0])
+        la, full = ld.decode_step(params, full, ta, cfg)
+        lb, spliced = ld.decode_step(params, spliced, tb, cfg)
+        ta = jnp.argmax(la, -1).astype(jnp.int32)
+        tb = jnp.argmax(lb, -1).astype(jnp.int32)
+
+
+def test_engine_prefix_hits_bit_exact():
+    """Continuous batching with the prefix cache ON produces exactly the
+    solo-generate stream for every request, across cold insert, full-hit
+    and partial-hit admissions."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    rng = np.random.default_rng(1)
+    shared = rng.integers(0, cfg.vocab_size, 20).tolist()
+    prompts = [shared + rng.integers(0, cfg.vocab_size, 6).tolist()
+               for _ in range(3)]
+    # Partial hit: diverges inside the cached entry.
+    prompts.append(shared[:9] + rng.integers(0, cfg.vocab_size,
+                                             8).tolist())
+    eng = DecodeEngine(params, cfg, slots=2, capacity=64,
+                       prefix_pool_entries=4, prefix_match_min_tokens=4)
+    hits = 0
+    for p in prompts:
+        req = eng.submit(p, max_new_tokens=5)
+        for _ in range(40):
+            if req.done.is_set():
+                break
+            eng.step()
+        solo = np.asarray(llama_decode.generate(
+            params, np.array([p], np.int32), cfg, max_new_tokens=5))[0]
+        assert req.output == list(solo), (req.output, list(solo))
+        hits += req.prefix_len > 0
+    assert hits == 3  # all but the cold first admission
+    stats = eng.prefix.stats()
+    assert stats["hits"] == 3 and stats["prefill_tokens_saved"] > 0
+    # Partial-hit request matched at the divergence point, not beyond.
+    assert prompts[-1][:9] == shared[:9]
+    eng.shutdown()
+
+
+def test_engine_prefix_batched_hit_wave():
+    """A whole admission wave of prefix hits (batched suffix prefill,
+    padded to a power of two) stays bit-exact."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    rng = np.random.default_rng(2)
+    shared = rng.integers(0, cfg.vocab_size, 16).tolist()
+    eng = DecodeEngine(params, cfg, slots=4, capacity=64,
+                       prefix_pool_entries=4, prefix_match_min_tokens=4)
+    warm = eng.submit(shared + [7, 7], max_new_tokens=1)
+    while not warm.done.is_set():
+        eng.step()
+    prompts = [shared + rng.integers(0, cfg.vocab_size, 5).tolist()
+               for _ in range(3)]  # wave of 3 -> padded to n=4
+    reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    for _ in range(40):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    assert all(r.prefix_len == 16 for r in reqs)
+    for req, p in zip(reqs, prompts):
+        solo = np.asarray(llama_decode.generate(
+            params, np.array([p], np.int32), cfg, max_new_tokens=4))[0]
+        assert req.output == list(solo), (req.output, list(solo))
+    eng.shutdown()
+
+
+def test_engine_disabled_pool_allocates_nothing():
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=2, capacity=64,
+                       prefix_pool_entries=0)
+    assert eng.prefix is None and eng._pool is None
+    req = eng.submit([1, 2, 3], max_new_tokens=3)
+    for _ in range(10):
+        if req.done.is_set():
+            break
+        eng.step()
+    assert len(req.output) == 3
+    assert "prefix" not in eng.stats()
+    eng.shutdown()
+
+
+def test_engine_load_counts_backlog():
+    """Replica load = occupied slots + pending queue depth: a saturated
+    engine with a deep queue must not look idle to the autoscaler."""
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=2, capacity=64,
+                       prefix_pool_entries=0)
+    reqs = [eng.submit([i + 1, 2], max_new_tokens=8) for i in range(5)]
+    eng.step()  # admit 2, leave 3 queued
+    s = eng.stats()
+    assert s["active"] == 2 and s["queued"] == 3 and s["load"] == 5
+    assert s["slots"] == 2
+    for _ in range(60):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    assert eng.stats()["load"] == 0
+    eng.shutdown()
+
+
+# ------------------------------------------------------ affinity routing
+
+
+def test_router_prefers_prefix_resident_replica():
+    from ray_tpu.serve.deployment import _Router
+
+    toks = list(range(64))
+    h = prefix_hash(np.asarray(toks[:64], np.int32))
+    router = object.__new__(_Router)
+    router._lock = threading.Lock()
+    router._max_ongoing = 8
+    router._inflight = {}
+    router._replicas = [
+        {"id": "cold", "models": set(), "prefixes": set()},
+        {"id": "warm", "models": set(), "prefixes": {h}},
+    ]
+    hashes = candidate_hashes(toks, 16)
+    assert hashes[0] == h
+    for _ in range(4):
+        assert router._pick("", hashes)["id"] == "warm"
+    # Saturated warm replica: affinity yields to least-loaded.
+    router._inflight["warm"] = 8
+    assert router._pick("", hashes)["id"] == "cold"
+
+
+def test_affinity_hashes_extraction():
+    from ray_tpu.core.config import config as rt_config
+    from ray_tpu.serve.deployment import _affinity_hashes
+
+    toks = list(range(40))
+    hashes = _affinity_hashes(({"tokens": toks},))
+    assert hashes == candidate_hashes(toks,
+                                      rt_config.prefix_match_min_tokens)
+    assert _affinity_hashes(()) is None
+    assert _affinity_hashes(("not-a-dict",)) is None
+    assert _affinity_hashes(({"no_tokens": 1},)) is None
+    old = rt_config.prefix_affinity_enabled
+    try:
+        rt_config.prefix_affinity_enabled = False
+        assert _affinity_hashes(({"tokens": toks},)) is None
+    finally:
+        rt_config.prefix_affinity_enabled = old

@@ -170,6 +170,142 @@ def test_chunked_decode_matches_per_token():
     assert len(req.output) <= 4 + 3  # truncated at/before the eos chunk
 
 
+def test_slot_reuse_after_mid_chunk_eos_has_no_stale_kv():
+    """Chunked decode writes K/V for the remaining chunk steps PAST a
+    request's EOS before _finish resets the slot's length. A request
+    re-admitted into that slot must see none of the stale K/V: prefill
+    overwrites its positions and the length mask hides the rest."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=1, capacity=64, decode_chunk=4,
+                       prefix_pool_entries=0)
+    first_prompt = [3, 1, 4]
+    solo_first = np.asarray(llama_decode.generate(
+        params, np.array([first_prompt], np.int32), cfg,
+        max_new_tokens=9))[0]
+    eos = int(solo_first[2])  # EOS lands mid-chunk (chunk of 4, idx 2)
+    r1 = eng.submit(first_prompt, max_new_tokens=9, eos_id=eos)
+    for _ in range(20):
+        if r1.done.is_set():
+            break
+        eng.step()
+    assert r1.done.is_set() and r1.output[-1] == eos
+    assert len(r1.output) < 9  # actually truncated mid-stream
+    # Re-admit into the SAME slot (slots=1): longer than the first
+    # request so its decode walks through the stale positions.
+    second_prompt = [9, 9, 2, 7]
+    r2 = eng.submit(second_prompt, max_new_tokens=12)
+    for _ in range(40):
+        if r2.done.is_set():
+            break
+        eng.step()
+    assert r2.slot == r1.slot
+    solo_second = np.asarray(llama_decode.generate(
+        params, np.array([second_prompt], np.int32), cfg,
+        max_new_tokens=12))[0]
+    assert r2.output == list(solo_second), (r2.output, list(solo_second))
+    eng.shutdown()
+
+
+def test_admission_wave_pad_rows_idempotent():
+    """_admit pads a non-power-of-two admission wave by repeating the
+    last real row into the SAME slot: the duplicate prefill must be an
+    idempotent overwrite (no fourth slot consumed, last request exact)."""
+    from ray_tpu.models import llama_decode
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=4, capacity=64,
+                       prefix_pool_entries=0)
+    prompts = [[5, 9, 2], [7, 1], [11, 3, 4]]  # wave of 3 -> n=4 padded
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.step()  # single admission wave
+    assert eng.stats()["free_slots"] == 1  # pad row consumed NO slot
+    for _ in range(40):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    for req, p in zip(reqs, prompts):
+        solo = np.asarray(llama_decode.generate(
+            params, np.array([p], np.int32), cfg, max_new_tokens=6))[0]
+        assert req.output == list(solo), (req.output, list(solo))
+    assert eng.stats()["free_slots"] == 4
+    eng.shutdown()
+
+
+def test_on_token_failure_recorded_not_swallowed():
+    """A broken streaming callback must not kill the decode loop, but
+    the failure must be diagnosable: recorded on the request and logged
+    once (rate-limited) instead of silently passed."""
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg, params = _tiny()
+    eng = DecodeEngine(params, cfg, slots=2, capacity=64,
+                       prefix_pool_entries=0)
+    seen = []
+
+    def bad(tok):
+        seen.append(tok)
+        raise RuntimeError("consumer wedged")
+
+    broken = eng.submit([5, 9, 2], max_new_tokens=4, on_token=bad)
+    healthy = eng.submit([7, 1], max_new_tokens=4)
+    for _ in range(20):
+        if broken.done.is_set() and healthy.done.is_set():
+            break
+        eng.step()
+    assert broken.done.is_set() and len(broken.output) == 4
+    assert broken.on_token_error is not None
+    assert "consumer wedged" in broken.on_token_error
+    assert len(seen) == 4  # every token still offered to the callback
+    assert healthy.on_token_error is None
+    assert len(healthy.output) == 4
+    eng.shutdown()
+
+
+@pytest.mark.timeout_s(240)
+def test_prefix_residency_published_to_router(serve_cluster):
+    """Replica prefix residency flows replica_metrics -> ReplicaActor
+    .stats -> controller snapshot -> router, where prefix-affinity
+    routing reads it; replica load (decode backlog) reaches the
+    controller's status the same way."""
+    from ray_tpu.models import llama
+    from ray_tpu.serve.decode import LlamaDecodeDeployment
+    from ray_tpu.serve.deployment import _Router
+
+    cfg = llama.LlamaConfig(vocab_size=61, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, mlp_dim=64, max_seq_len=128)
+    serve.run(
+        serve.deployment(LlamaDecodeDeployment).options(
+            max_concurrency=4).bind(config=cfg, slots=2, capacity=64,
+                                    prefix_pool_entries=4,
+                                    prefix_match_min_tokens=4),
+        name="llm_prefix")
+    handle = serve.get_deployment_handle("llm_prefix")
+    prompt = list(range(1, 25))  # long enough to insert a pool entry
+    out = handle.remote({"tokens": prompt,
+                         "max_new_tokens": 2}).result(timeout=120)
+    assert len(out["tokens"]) == 2
+    # The reconcile loop picks up the new residency and republishes;
+    # the router's snapshot eventually advertises the prefix.
+    router = _Router.get("llm_prefix")
+    deadline = time.monotonic() + 60
+    advertised = set()
+    while time.monotonic() < deadline:
+        with router._lock:
+            advertised = set().union(*(r["prefixes"]
+                                       for r in router._replicas)) \
+                if router._replicas else set()
+        if advertised:
+            break
+        time.sleep(0.25)
+    assert advertised, "prefix residency never reached the router"
+    status = serve.status()["llm_prefix"]
+    assert "load" in status
+
+
 def test_submit_rejects_over_capacity_budget():
     """ADVICE medium: a request whose prompt + max_new_tokens exceeds the
     cache capacity must be rejected at submit — past capacity the K/V
