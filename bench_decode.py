@@ -208,6 +208,125 @@ def shared_prefix_rows(params, cfg, quick: bool, platform: str):
     ]
 
 
+def overload_rows(params, cfg, quick: bool, platform: str):
+    """Load-shedding behavior at 2x slot capacity (ISSUE 3):
+    ``2 * slots`` closed-loop clients against a small pending-queue cap,
+    vs a ``slots``-client non-overloaded baseline measured the same way.
+    Shed clients honor ``Retry-After`` (bounded). The cap is deliberately
+    tight (``max(1, slots // 4)``): under sustained overload ANY queue
+    depth converts straight into accepted-request TTFT (Little's law),
+    so the engine sheds the excess in <1 ms and keeps the queue — and
+    therefore accepted latency — short. Rows record shed-rejection p99
+    (bar: < 50 ms), accepted TTFT p99 vs baseline (bar: < 1.5x), and the
+    max observed queue depth (bar: never exceeds queue_max)."""
+    import threading
+
+    from ray_tpu.core.errors import OverloadedError
+    from ray_tpu.serve.decode import DecodeEngine
+
+    import numpy as np
+
+    slots = 4 if quick else 8
+    prompt_len = 16 if quick else 32
+    gen = 8 if quick else 16
+    duration = 6.0 if quick else 25.0
+    queue_max = max(1, slots // 8)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(4 * slots)]
+
+    eng = DecodeEngine(params, cfg, slots=slots, capacity=128,
+                       prefix_pool_entries=0, queue_max=4 * slots)
+    # Warm the program ladder (loose cap: warm bursts queue up before
+    # the manual step loop drains them).
+    w = eng.submit(prompts[0], max_new_tokens=2)
+    while not w.done.is_set():
+        eng.step()
+    n_warm = 2
+    while n_warm <= slots:
+        burst = [eng.submit(prompts[i], max_new_tokens=1)
+                 for i in range(n_warm)]
+        while not all(b.done.is_set() for b in burst):
+            eng.step()
+        n_warm *= 2
+    eng.queue_max = queue_max  # the measured configuration
+
+    loop = threading.Thread(target=eng.serve_forever, daemon=True)
+    loop.start()
+
+    def run_phase(n_clients: int, phase_s: float):
+        ttfts: list = []
+        sheds: list = []
+        stop = time.monotonic() + phase_s
+        max_queue = [0]
+
+        def client(ci: int) -> None:
+            # Varied generation lengths (gen/2 .. 3*gen/2): equal
+            # lengths complete in synchronized waves, which makes every
+            # queued request wait a FULL generation for a slot — an
+            # artifact no real traffic mix has.
+            crng = np.random.default_rng(100 + ci)
+            while time.monotonic() < stop:
+                t0 = time.perf_counter()
+                n_new = int(crng.integers(max(1, gen // 2),
+                                          gen + gen // 2 + 1))
+                try:
+                    req = eng.submit(prompts[ci % len(prompts)],
+                                     max_new_tokens=n_new)
+                except OverloadedError as e:
+                    sheds.append(1e3 * (time.perf_counter() - t0))
+                    time.sleep(min(e.retry_after_s, 0.25))
+                    continue
+                req.done.wait()
+                if req.first_token_at is not None:
+                    ttfts.append(1e3 * (req.first_token_at
+                                        - req.submitted_at))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        while any(t.is_alive() for t in threads):
+            max_queue[0] = max(max_queue[0], eng.stats()["queued"])
+            time.sleep(0.01)
+        return ttfts, sheds, max_queue[0]
+
+    base_ttft, _, _ = run_phase(slots, duration)
+    accepted, shed_lat, max_queue = run_phase(2 * slots, duration)
+    eng.shutdown()
+    loop.join(timeout=5)
+
+    workload = (f"closed-loop {2 * slots} clients / {slots} slots for "
+                f"{duration:.0f}s, queue_max={queue_max}, prompt "
+                f"{prompt_len}, {gen} new tokens; {platform}")
+    base_p99 = pctl(base_ttft, 0.99) if base_ttft else float("nan")
+    acc_p99 = pctl(accepted, 0.99) if accepted else None
+    return [
+        {
+            "metric": "decode_overload_shed_rejection_p99",
+            "value": round(pctl(shed_lat, 0.99), 3) if shed_lat else None,
+            "unit": "ms",
+            "note": (f"submit()->OverloadedError latency over "
+                     f"{len(shed_lat)} shed requests (p50="
+                     f"{pctl(shed_lat, 0.5):.3f}ms); bar <50ms; "
+                     f"{workload}" if shed_lat else workload),
+        },
+        {
+            "metric": "decode_overload_accepted_ttft_p99",
+            "value": round(acc_p99, 1) if acc_p99 is not None else None,
+            "unit": "ms",
+            "note": (f"TTFT p99 of {len(accepted)} ACCEPTED requests at "
+                     f"2x offered load = "
+                     f"{acc_p99 / max(1e-9, base_p99):.2f}x the "
+                     f"non-overloaded closed-loop baseline p99 "
+                     f"({base_p99:.1f}ms, {len(base_ttft)} reqs); max "
+                     f"pending-queue depth observed {max_queue} (cap "
+                     f"{queue_max}); {workload}"
+                     if acc_p99 is not None else workload),
+        },
+    ]
+
+
 def serve_stack_row(cfg, quick: bool):
     import ray_tpu
     from ray_tpu import serve
@@ -281,10 +400,10 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
-        "--sections", default="engine,serve,shared_prefix",
+        "--sections", default="engine,serve,shared_prefix,overload",
         help="comma-set of row groups to (re)measure: engine, serve, "
-             "shared_prefix. Only the selected groups' rows are "
-             "replaced in BENCH_SERVE.json; the rest are preserved.")
+             "shared_prefix, overload. Only the selected groups' rows "
+             "are replaced in BENCH_SERVE.json; the rest are preserved.")
     parser.add_argument(
         "--model", default=None,
         help="llama preset override (default: debug if --quick else "
@@ -318,6 +437,8 @@ def main() -> None:
         rows += engine_rows(params, cfg, args.quick)
     if "shared_prefix" in sections:
         rows += shared_prefix_rows(params, cfg, args.quick, plat_note)
+    if "overload" in sections:
+        rows += overload_rows(params, cfg, args.quick, plat_note)
     if "serve" in sections:
         ray_tpu.init(num_cpus=4)
         try:

@@ -194,6 +194,28 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "Minimum shared-prefix length (tokens) for a prefix-cache hit; "
         "prompts shorter than this are neither matched nor inserted "
         "(splicing a tiny prefix costs more dispatch than it saves)."),
+    "serve_request_timeout_s": (float, 70.0,
+        "Default end-to-end deadline for a serve request when the client "
+        "sets none (HTTP header X-Request-Timeout-S or "
+        "DeploymentHandle.options(timeout_s=...) override per request). "
+        "Propagated proxy -> handle -> replica -> DecodeEngine, which "
+        "finishes the slot with DeadlineExceededError instead of decoding "
+        "for a caller that already gave up. 0 disables the default (no "
+        "deadline unless the client sends one)."),
+    "decode_queue_max": (int, 0,
+        "Cap on a DecodeEngine's pending (unadmitted) request queue. Past "
+        "it, submit() sheds the request immediately with OverloadedError "
+        "(mapped to HTTP 503 + Retry-After) instead of queueing it into "
+        "minutes of latency. 0 = slots * 8."),
+    "handle_retry_budget": (int, 3,
+        "Per-request attempts a DeploymentHandle router makes when a "
+        "replica dies mid-call (ActorDiedError/ActorUnavailableError). "
+        "Streaming requests never retry after the first item, and no "
+        "retry is attempted past the request deadline."),
+    "handle_retry_backoff_ms": (int, 50,
+        "Base backoff before a handle retry; doubles each attempt with "
+        "+/-50% jitter so a replica death under load heals instead of "
+        "amplifying into a synchronized retry storm on the survivors."),
     "prefix_affinity_enabled": (bool, True,
         "Serve routers hash a request's leading token buckets and prefer "
         "the replica advertising that prefix in its cache (falling back "

@@ -90,3 +90,42 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class OutOfMemoryError(RayTpuError):
     """Object store is out of memory and eviction could not make room."""
+
+
+# ------------------------------------------------- serve request lifecycle
+#
+# Typed terminal outcomes for a serve-plane request (reference: Ray Serve's
+# BackPressureError / RequestCancelledError / deadline handling in
+# serve/_private/proxy.py). These travel from the DecodeEngine / replica
+# through actor-call error shipping to the handle and the HTTP proxy, which
+# maps them onto status codes (503 + Retry-After, 504, 499).
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's deadline passed before generation completed.
+
+    Raised at admission (the deadline expired while queued) or mid-decode
+    (the engine checks at every ``step()`` and frees the slot instead of
+    burning decode steps for a caller that already gave up)."""
+
+
+class RequestCancelledError(RayTpuError):
+    """The request was cancelled (client disconnected / stream closed)
+    before completing."""
+
+
+class OverloadedError(RayTpuError):
+    """The serving queue is at capacity; the request was shed at enqueue.
+
+    Carries ``retry_after_s`` — the replica's estimate (from observed
+    token throughput) of when a slot will free — which the HTTP proxy
+    surfaces as a 503 ``Retry-After`` header."""
+
+    def __init__(self, message: str = "server overloaded",
+                 retry_after_s: float = 1.0):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (OverloadedError, (self.args[0] if self.args else
+                                  "server overloaded", self.retry_after_s))

@@ -41,6 +41,16 @@ from ray_tpu.ops.attention import (
 )
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static ring size inside shard_map. ``jax.lax.axis_size`` only
+    exists on newer jax; on older versions ``psum(1, axis)`` of a Python
+    literal constant-folds to a static int under shard_map, which is what
+    the ring's ``range(n)``/permutation construction needs."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def ring_attention_local(q, k, v, axis_name: str = "seq",
                          causal: bool = True) -> jax.Array:
     """Per-shard ring attention body; call inside shard_map/pjit-manual.
@@ -48,7 +58,7 @@ def ring_attention_local(q, k, v, axis_name: str = "seq",
     Shapes are per-device: q/k/v (B, S_local, H, D) with the global sequence
     laid out contiguously across the ``axis_name`` ring.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     s_local = q.shape[1]
     q_offset = rank * s_local
@@ -114,7 +124,7 @@ def ring_flash_attention_local(q, k, v, axis_name: str = "seq",
     """
     from ray_tpu.ops.flash_attention import flash_attention_stats
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = d ** -0.5

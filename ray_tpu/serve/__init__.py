@@ -31,4 +31,10 @@ from ray_tpu.serve.deployment import (  # noqa: F401
 from ray_tpu.serve.replica import (  # noqa: F401
     get_multiplexed_model_id,
     multiplexed,
+    request_deadline_s,
+)
+from ray_tpu.core.errors import (  # noqa: F401 — request-lifecycle outcomes
+    DeadlineExceededError,
+    OverloadedError,
+    RequestCancelledError,
 )

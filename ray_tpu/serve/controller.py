@@ -203,6 +203,16 @@ class ServeController:
                         max(r.last_stats.get("ongoing", 0),
                             r.last_stats.get("load", 0))
                         for r in rec.replicas),
+                    # Degradation counters (replica-reported, summed):
+                    # shedding/cancellation/deadline expiry show up in
+                    # serve.status() AS the overload happens, not after.
+                    "shed": sum(r.last_stats.get("shed", 0)
+                                for r in rec.replicas),
+                    "cancelled": sum(r.last_stats.get("cancelled", 0)
+                                     for r in rec.replicas),
+                    "deadline_exceeded": sum(
+                        r.last_stats.get("deadline_exceeded", 0)
+                        for r in rec.replicas),
                 }
                 for name, rec in self._deployments.items()
             }

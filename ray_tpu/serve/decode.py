@@ -24,6 +24,7 @@ the serial consumer), matching how a chip is actually scheduled.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -33,7 +34,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.core.errors import (DeadlineExceededError, OverloadedError,
+                                 RequestCancelledError)
+
 logger = logging.getLogger(__name__)
+
+_req_ids = itertools.count(1)
 
 
 @dataclass
@@ -54,6 +60,26 @@ class _Request:
     finished_at: Optional[float] = None
     prefix_entry: int = -1                 # prefix-pool row spliced in
     prefix_len: int = 0                    # cached tokens NOT re-prefilled
+    # --------------------------------------------------- request lifecycle
+    request_id: str = ""
+    deadline: Optional[float] = None       # absolute monotonic; None = none
+    cancelled: bool = False                # cooperative-cancel flag
+    admitted: bool = False                 # left the pending queue
+    status: str = "pending"                # terminal: completed |
+    #   cancelled | deadline_exceeded | error
+
+    def raise_for_status(self) -> None:
+        """Re-raise this request's terminal outcome as its typed error."""
+        if self.status == "cancelled":
+            raise RequestCancelledError(
+                f"request {self.request_id} cancelled after "
+                f"{self.generated} tokens")
+        if self.status == "deadline_exceeded":
+            raise DeadlineExceededError(
+                f"request {self.request_id} exceeded its deadline after "
+                f"{self.generated} tokens")
+        if self.error:
+            raise RuntimeError(self.error)
 
 
 class DecodeEngine:
@@ -70,7 +96,8 @@ class DecodeEngine:
                  decode_chunk: int = 1,
                  prefix_pool_entries: Optional[int] = None,
                  prefix_capacity: Optional[int] = None,
-                 prefix_match_min_tokens: Optional[int] = None):
+                 prefix_match_min_tokens: Optional[int] = None,
+                 queue_max: Optional[int] = None):
         import jax
 
         from ray_tpu.core.config import config as rt_config
@@ -92,6 +119,23 @@ class DecodeEngine:
         self._rng = np.random.default_rng(0)
         self._stop = threading.Event()
         self._work = threading.Event()
+        # ------------------------------------------- request lifecycle
+        # Bounded admission: past queue_max pending requests, submit()
+        # sheds with OverloadedError at enqueue (<1 ms) instead of
+        # queueing into minutes of latency under overload.
+        if queue_max is None:
+            queue_max = rt_config.decode_queue_max
+        self.queue_max = int(queue_max) if queue_max else slots * 8
+        # request_id -> live request, for cancel(); guarded by _reqs_lock
+        # (intake/cancel threads vs the decode loop).
+        self._requests: Dict[str, _Request] = {}
+        self._reqs_lock = threading.Lock()
+        self._queued_cancelled = 0  # cancelled but not yet dequeued
+        self.shed = 0               # requests rejected by the queue cap
+        self.cancelled = 0          # requests ended by cancel()
+        self.deadline_exceeded = 0  # requests ended by their deadline
+        self._ema_request_s = 0.0   # EMA of admitted-request service time
+        self._last_purge = 0.0      # dead-entry queue-purge throttle
         # Prefix KV cache: a device-resident pool of cached prompt-prefix
         # K/V (P entries x C_prefix tokens) indexed by a host-side trie.
         # At admission the longest cached prefix is spliced into the
@@ -216,11 +260,13 @@ class DecodeEngine:
 
     def submit(self, prompt_tokens, max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: Optional[int] = None,
-               on_token: Optional[Callable[[int], None]] = None
-               ) -> _Request:
+               on_token: Optional[Callable[[int], None]] = None,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> _Request:
         req = _Request(np.asarray(prompt_tokens, np.int32).reshape(-1),
                        int(max_new_tokens), float(temperature), eos_id,
                        on_token)
+        req.request_id = request_id or f"req-{next(_req_ids)}"
         if len(req.tokens) >= self.capacity:
             raise ValueError(
                 f"prompt ({len(req.tokens)}) must be shorter than the "
@@ -235,9 +281,56 @@ class DecodeEngine:
                 f"prompt ({len(req.tokens)}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds the cache capacity "
                 f"({self.capacity})")
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                self.deadline_exceeded += 1
+                raise DeadlineExceededError(
+                    f"request {req.request_id} arrived with an already-"
+                    f"expired deadline ({deadline_s:.3f}s)")
+            req.deadline = time.monotonic() + float(deadline_s)
+        # Load shedding happens HERE, at enqueue — not after minutes in
+        # queue. qsize() can transiently overshoot by concurrent
+        # submitters, but the check bounds the queue within one wave.
+        if self._pending.qsize() - self._queued_cancelled >= self.queue_max:
+            self.shed += 1
+            raise OverloadedError(
+                f"decode queue at capacity ({self.queue_max} pending, "
+                f"{self.slots} slots)",
+                retry_after_s=self.retry_after_estimate_s())
+        with self._reqs_lock:
+            self._requests[req.request_id] = req
         self._pending.put(req)
         self._work.set()
         return req
+
+    def retry_after_estimate_s(self) -> float:
+        """How long a shed caller should wait before retrying, from the
+        observed per-request service time: the queue drains ``slots``
+        requests per service interval, so a rejected request's turn is
+        about ``(queued / slots + 1)`` intervals away. Clamped to
+        [0.5 s, 30 s]; 1 s before any request has completed."""
+        if self._ema_request_s <= 0:
+            return 1.0
+        depth = max(0, self._pending.qsize() - self._queued_cancelled)
+        est = (depth / max(1, self.slots) + 1.0) * self._ema_request_s
+        return min(30.0, max(0.5, est))
+
+    def cancel(self, request_id: str) -> bool:
+        """Cooperative cancellation: mark the request; the decode loop
+        drops it before prefill if still queued, or frees its slot at the
+        next ``step()`` boundary if active. Returns False for unknown /
+        already-finished requests (cancel is idempotent)."""
+        with self._reqs_lock:
+            req = self._requests.get(request_id)
+            if req is None or req.done.is_set() or req.cancelled:
+                return False
+            req.cancelled = True
+            if not req.admitted:
+                # Still in the pending queue: exclude it from the load
+                # signal now; _admit reconciles when it dequeues it.
+                self._queued_cancelled += 1
+        self._work.set()  # wake a parked loop so the drop is prompt
+        return True
 
     # -------------------------------------------------------- the loop
 
@@ -254,9 +347,28 @@ class DecodeEngine:
                     break
             if not wave:
                 return
+            # Dead-on-arrival requests (cancelled while queued, or
+            # deadline already passed) retire HERE — before any prefix
+            # match or device work. They never touch the device and the
+            # wave refills from the queue behind them.
+            live: List[_Request] = []
+            now = time.monotonic()
+            for req in wave:
+                with self._reqs_lock:
+                    req.admitted = True
+                    if req.cancelled:
+                        self._queued_cancelled -= 1
+                if req.cancelled:
+                    self._retire(req, "cancelled")
+                elif req.deadline is not None and now > req.deadline:
+                    self._retire(req, "deadline_exceeded")
+                else:
+                    live.append(req)
+            if not live:
+                continue
             hits: List[_Request] = []
             misses: List[_Request] = []
-            for req in wave:
+            for req in live:
                 m = (self.prefix.match(req.tokens)
                      if self.prefix is not None else None)
                 if m is not None:
@@ -266,6 +378,45 @@ class DecodeEngine:
                     misses.append(req)
             self._admit_full(misses)
             self._admit_suffix(hits)
+
+    def _retire(self, req: _Request, status: str) -> None:
+        """Terminal exit for a request that never held a slot."""
+        req.status = status
+        req.finished_at = time.monotonic()
+        if status == "cancelled":
+            self.cancelled += 1
+        elif status == "deadline_exceeded":
+            self.deadline_exceeded += 1
+        with self._reqs_lock:
+            self._requests.pop(req.request_id, None)
+        req.done.set()
+
+    def _purge_pending(self) -> None:
+        """Drop dead entries (cancelled / deadline-expired) from the
+        pending queue WITHOUT waiting for a slot to free: when every
+        slot is busy for minutes, admission never runs, but a cancelled
+        caller's entry must still retire promptly — it would otherwise
+        hold its done-event, its _requests entry, and (for expiries)
+        inflate the load signal. One FIFO-preserving rotation."""
+        now = time.monotonic()
+        for _ in range(self._pending.qsize()):
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            with self._reqs_lock:
+                dead = req.cancelled
+                if dead:
+                    self._queued_cancelled -= 1
+                    req.admitted = True
+            if dead:
+                self._retire(req, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                with self._reqs_lock:
+                    req.admitted = True
+                self._retire(req, "deadline_exceeded")
+            else:
+                self._pending.put(req)
 
     def _admit_full(self, reqs: List[_Request]) -> None:
         import jax.numpy as jnp
@@ -410,21 +561,58 @@ class DecodeEngine:
                         "emitted): %s", req.slot, req.generated,
                         req.on_token_error, exc_info=True)
 
-    def _finish(self, slot: int) -> None:
+    def _finish(self, slot: int, status: str = "completed") -> None:
         req = self._active.pop(slot)
+        # Return the slot IMMEDIATELY after the active-pop: _free is only
+        # consumed by _admit on this same thread, but stats() reads both
+        # cross-thread — a device dispatch between the pop and the append
+        # would show active+free < slots (a phantom wedged slot).
+        self._free.append(slot)
+        req.status = status
         req.finished_at = time.monotonic()
+        if status == "completed":
+            # Service-time EMA feeds the shed path's Retry-After estimate.
+            service = req.finished_at - req.submitted_at
+            self._ema_request_s = (service if self._ema_request_s <= 0
+                                   else 0.7 * self._ema_request_s
+                                   + 0.3 * service)
+        elif status == "cancelled":
+            self.cancelled += 1
+        elif status == "deadline_exceeded":
+            self.deadline_exceeded += 1
+        with self._reqs_lock:
+            self._requests.pop(req.request_id, None)
         req.done.set()
         # Park the freed slot at length 0 so idle slots don't walk their
         # cursor toward the capacity edge while others decode.
         self.cache["length"] = self.cache["length"].at[slot].set(0)
         self._tokens[slot] = 0
-        self._free.append(slot)
+
+    def _reap(self) -> None:
+        """Free slots whose requests are dead (cancelled, or past their
+        deadline): runs at every step boundary, so a dead request costs
+        at most ONE more decode step — its slot and its place in the
+        batch go back to live traffic immediately (the property Orca-
+        style iteration-level scheduling is for)."""
+        now = time.monotonic()
+        if (self._queued_cancelled > 0
+                or (now - self._last_purge > 0.5
+                    and not self._pending.empty())):
+            self._last_purge = now
+            self._purge_pending()
+        for slot in list(self._active):
+            req = self._active[slot]
+            if req.cancelled:
+                self._finish(slot, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                self._finish(slot, "deadline_exceeded")
 
     def step(self) -> int:
         """Admit pending prefills, advance every active slot one token.
         Returns the number of active slots stepped."""
         import jax.numpy as jnp
 
+        self._reap()
         self._admit()
         if not self._active:
             return 0
@@ -494,7 +682,10 @@ class DecodeEngine:
 
     def stats(self) -> Dict[str, Any]:
         active = len(self._active)
-        queued = self._pending.qsize()
+        # Live queue depth: cancelled-but-undequeued entries are dead
+        # weight, not demand — the autoscaler must not scale out for
+        # requests that will be dropped at admission.
+        queued = max(0, self._pending.qsize() - self._queued_cancelled)
         out = {
             "steps": self.steps,
             "tokens_out": self.tokens_out,
@@ -502,6 +693,14 @@ class DecodeEngine:
             "slots": self.slots,
             "free_slots": len(self._free),
             "queued": queued,
+            "queue_max": self.queue_max,
+            # Degradation counters: shed-at-enqueue, cooperative
+            # cancellations, and deadline expiries — surfaced through
+            # replica_metrics -> controller snapshot -> serve.status()
+            # so overload shows up as it happens.
+            "shed": self.shed,
+            "cancelled": self.cancelled,
+            "deadline_exceeded": self.deadline_exceeded,
             # Decode backlog as replica load: occupied slots + pending
             # queue depth. A full queue behind idle HTTP must read as
             # load to the serve autoscaler, not zero.
@@ -523,7 +722,8 @@ class LlamaDecodeDeployment:
                  config=None, decode_chunk: int = 1,
                  prefix_pool_entries: Optional[int] = None,
                  prefix_capacity: Optional[int] = None,
-                 prefix_match_min_tokens: Optional[int] = None):
+                 prefix_match_min_tokens: Optional[int] = None,
+                 queue_max: Optional[int] = None):
         import jax
 
         from ray_tpu.models import llama
@@ -536,63 +736,93 @@ class LlamaDecodeDeployment:
             decode_chunk=decode_chunk,
             prefix_pool_entries=prefix_pool_entries,
             prefix_capacity=prefix_capacity,
-            prefix_match_min_tokens=prefix_match_min_tokens)
+            prefix_match_min_tokens=prefix_match_min_tokens,
+            queue_max=queue_max)
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()
 
     def replica_metrics(self) -> Dict[str, Any]:
-        """Replica-reported load + prefix residency, merged into
-        ``ReplicaActor.stats()``: the autoscaler scales on decode backlog
-        and the router steers shared prefixes to the replica already
-        holding them."""
+        """Replica-reported load + prefix residency + degradation
+        counters, merged into ``ReplicaActor.stats()``: the autoscaler
+        scales on decode backlog, the router steers shared prefixes to
+        the replica already holding them, and ``serve.status()`` shows
+        shedding/cancellation/deadline counts as they happen."""
         s = self.engine.stats()
-        out: Dict[str, Any] = {"load": s["load"]}
+        out: Dict[str, Any] = {"load": s["load"], "queued": s["queued"],
+                               "shed": s["shed"],
+                               "cancelled": s["cancelled"],
+                               "deadline_exceeded": s["deadline_exceeded"]}
         if self.engine.prefix is not None:
             out["prefix"] = s.get("prefix", {})
             out["prefixes"] = self.engine.prefix.hashes()
         return out
+
+    def _submit(self, request: Dict[str, Any], on_token=None) -> _Request:
+        """Admission with the request's deadline attached: explicit
+        ``deadline_s`` in the payload wins, else the deadline the serve
+        stack propagated with this call (proxy header / handle
+        timeout_s / ``serve_request_timeout_s``)."""
+        from ray_tpu.serve.replica import request_deadline_s
+
+        deadline_s = request.get("deadline_s")
+        if deadline_s is None:
+            deadline_s = request_deadline_s()
+        return self.engine.submit(
+            request["tokens"],
+            max_new_tokens=int(request.get("max_new_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"),
+            on_token=on_token,
+            deadline_s=deadline_s,
+            request_id=request.get("request_id"))
 
     def __call__(self, request: Dict[str, Any]):
         if request.get("stream"):
             # Generator return = the replica streams it (handle.stream /
             # HTTP chunked via X-Serve-Stream on this same route).
             return self.stream(request)
-        req = self.engine.submit(
-            request["tokens"],
-            max_new_tokens=int(request.get("max_new_tokens", 32)),
-            temperature=float(request.get("temperature", 0.0)),
-            eos_id=request.get("eos_id"))
-        req.done.wait()
-        if req.error:
-            raise RuntimeError(req.error)
+        req = self._submit(request)
+        if req.deadline is not None:
+            # The engine enforces the deadline; the +10 s slack only
+            # covers a wedged decode loop (never-completing wait).
+            if not req.done.wait(
+                    max(0.1, req.deadline - time.monotonic()) + 10.0):
+                self.engine.cancel(req.request_id)
+                raise DeadlineExceededError(
+                    f"request {req.request_id} not finished by the decode "
+                    f"loop within its deadline")
+        else:
+            req.done.wait()
+        req.raise_for_status()
         return {"tokens": req.output,
                 "ttft_s": round(req.first_token_at - req.submitted_at, 4)}
 
     def stream(self, request: Dict[str, Any]):
         """Streaming generator: yields tokens as the engine emits them
-        (drive via a streaming handle / HTTP chunked response)."""
+        (drive via a streaming handle / HTTP chunked response). Closing
+        the generator (client disconnect anywhere up the stack) cancels
+        the engine request: the slot frees at the next step and queued-
+        but-unadmitted requests never touch the device."""
         q: "queue.Queue" = queue.Queue()
-        req = self.engine.submit(
-            request["tokens"],
-            max_new_tokens=int(request.get("max_new_tokens", 32)),
-            temperature=float(request.get("temperature", 0.0)),
-            eos_id=request.get("eos_id"),
-            on_token=q.put)
-        emitted = 0
-        while True:
-            try:
-                tok = q.get(timeout=0.5)
-                emitted += 1
-                yield tok
-                continue
-            except queue.Empty:
-                pass
-            if req.done.is_set():
-                while not q.empty():
-                    emitted += 1
-                    yield q.get()
-                break
+        req = self._submit(request, on_token=q.put)
+        try:
+            while True:
+                try:
+                    yield q.get(timeout=0.5)
+                    continue
+                except queue.Empty:
+                    pass
+                if req.done.is_set():
+                    while not q.empty():
+                        yield q.get()
+                    # A mid-stream deadline/cancel surfaces as the typed
+                    # error instead of silently truncating the stream.
+                    req.raise_for_status()
+                    break
+        finally:
+            if not req.done.is_set():
+                self.engine.cancel(req.request_id)
 
     def health(self) -> Dict[str, Any]:
         return self.engine.stats()

@@ -27,7 +27,8 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.actor import ActorHandle
-from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
+from ray_tpu.core.errors import (ActorDiedError, ActorUnavailableError,
+                                 DeadlineExceededError, GetTimeoutError)
 from ray_tpu.core.ids import ActorID
 from ray_tpu.serve.controller import SNAPSHOT_CHANNEL
 
@@ -292,17 +293,41 @@ class _Router:
                 self._inflight[rid] = max(0, self._inflight[rid] - 1)
 
     def submit(self, method: str, args: tuple, kwargs: dict,
-               model_id: str = "") -> Future:
+               model_id: str = "", timeout_s: Optional[float] = None
+               ) -> Future:
         fut: Future = Future()
-        self._pool.submit(self._run_one, fut, method, args, kwargs, model_id)
+        self._pool.submit(self._run_one, fut, method, args, kwargs,
+                          model_id, timeout_s)
         return fut
 
-    def _run_one(self, fut: Future, method, args, kwargs, model_id) -> None:
+    @staticmethod
+    def _backoff_s(attempt: int) -> float:
+        """Exponential backoff with +/-50% jitter: base * 2^attempt,
+        decorrelated so N handles retrying the same replica death don't
+        synchronize into a retry storm against the survivors."""
+        from ray_tpu.core.config import config as rt_config
+
+        base = rt_config.handle_retry_backoff_ms / 1e3
+        return base * (2 ** attempt) * (0.5 + random.random())
+
+    def _run_one(self, fut: Future, method, args, kwargs, model_id,
+                 timeout_s: Optional[float] = None) -> None:
+        from ray_tpu.core.config import config as rt_config
+
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        budget = max(1, rt_config.handle_retry_budget)
         try:
             self.wait_ready()
             prefix_hashes = _affinity_hashes(args)
             last_err: Optional[BaseException] = None
-            for _attempt in range(3):
+            for attempt in range(budget):
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise DeadlineExceededError(
+                        f"deadline expired before attempt {attempt + 1} "
+                        f"to {self.name!r}") from last_err
                 replica = self._pick(model_id, prefix_hashes)
                 if replica is None:
                     if self._deleted:
@@ -311,17 +336,36 @@ class _Router:
                     raise RuntimeError(
                         f"deployment {self.name!r} has no replicas")
                 try:
+                    # The deadline ships as a RELATIVE duration; the
+                    # replica re-anchors it to its own clock. get()'s
+                    # grace past it only covers transit — the replica
+                    # enforces the deadline itself.
                     ref = replica["handle"].handle_request.remote(
-                        method, args, kwargs, model_id)
-                    fut.set_result(ray_tpu.get(ref))
+                        method, args, kwargs, model_id, remaining)
+                    fut.set_result(ray_tpu.get(
+                        ref, timeout=(None if remaining is None
+                                      else remaining + 10.0)))
                     return
+                except GetTimeoutError as e:
+                    raise DeadlineExceededError(
+                        f"no reply from {self.name!r} within the request "
+                        f"deadline") from e
                 except (ActorDiedError, ActorUnavailableError) as e:
                     # Replica died: forget it locally; the controller's
-                    # next snapshot heals the set. Retry elsewhere.
+                    # next snapshot heals the set. Retry elsewhere —
+                    # within the per-request budget, with backoff, and
+                    # never past the deadline.
                     last_err = e
                     with self._lock:
                         self._replicas = [r for r in self._replicas
                                           if r["id"] != replica["id"]]
+                    if attempt + 1 >= budget:
+                        break
+                    pause = self._backoff_s(attempt)
+                    if (deadline is not None
+                            and time.monotonic() + pause >= deadline):
+                        break  # the retry could not finish in time anyway
+                    time.sleep(pause)
                 finally:
                     self._release(replica)
             raise last_err
@@ -329,35 +373,70 @@ class _Router:
             fut.set_exception(e)
 
     def stream(self, method: str, args: tuple, kwargs: dict,
-               model_id: str = "", chunk_items: int = 16):
+               model_id: str = "", chunk_items: int = 16,
+               timeout_s: Optional[float] = None):
         """Generator of streamed items from one replica: the replica's
         generator suspends between pulls (consumer-paced). The replica's
         in-flight slot and this router's count are held for the stream's
-        lifetime (autoscaling sees streams as load)."""
+        lifetime (autoscaling sees streams as load).
+
+        Replica death is retried (budget + backoff) only BEFORE the
+        first item: once any token has been yielded the stream has
+        observable state on the client, so a mid-stream retry would
+        replay or corrupt it — the error propagates instead."""
+        from ray_tpu.core.config import config as rt_config
+
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        budget = max(1, rt_config.handle_retry_budget)
         self.wait_ready()
-        replica = self._pick(model_id, _affinity_hashes(args))
-        if replica is None:
-            raise RuntimeError(
-                f"deployment {self.name!r} has no replicas")
-        handle = replica["handle"]
-        sid = None
-        try:
-            sid = ray_tpu.get(handle.start_stream.remote(
-                method, args, kwargs, model_id), timeout=70.0)
-            while True:
-                items, done = ray_tpu.get(handle.next_chunks.remote(
-                    sid, chunk_items), timeout=70.0)
-                yield from items
-                if done:
-                    sid = None
-                    return
-        finally:
-            if sid is not None:  # consumer bailed early: free the slot
+        prefix_hashes = _affinity_hashes(args)
+        last_err: Optional[BaseException] = None
+        for attempt in range(budget):
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceededError(
+                    f"deadline expired before the stream to "
+                    f"{self.name!r} started") from last_err
+            replica = self._pick(model_id, prefix_hashes)
+            if replica is None:
+                raise RuntimeError(
+                    f"deployment {self.name!r} has no replicas")
+            handle = replica["handle"]
+            sid = None
+            try:
                 try:
-                    handle.cancel_stream.remote(sid)
-                except Exception:
-                    pass
-            self._release(replica)
+                    sid = ray_tpu.get(handle.start_stream.remote(
+                        method, args, kwargs, model_id, remaining),
+                        timeout=70.0)
+                except (ActorDiedError, ActorUnavailableError) as e:
+                    last_err = e
+                    with self._lock:
+                        self._replicas = [r for r in self._replicas
+                                          if r["id"] != replica["id"]]
+                    if attempt + 1 >= budget:
+                        raise
+                    pause = self._backoff_s(attempt)
+                    if (deadline is not None
+                            and time.monotonic() + pause >= deadline):
+                        raise
+                    time.sleep(pause)
+                    continue
+                while True:
+                    items, done = ray_tpu.get(handle.next_chunks.remote(
+                        sid, chunk_items), timeout=70.0)
+                    yield from items
+                    if done:
+                        sid = None
+                        return
+            finally:
+                if sid is not None:  # consumer bailed early: free the
+                    try:             # slot + cancel the engine request
+                        handle.cancel_stream.remote(sid)
+                    except Exception:
+                        pass
+                self._release(replica)
 
     def stop(self) -> None:
         self._stop.set()
@@ -376,37 +455,49 @@ class DeploymentHandle:
     name) can route requests (reference: ``serve/handle.py:714``)."""
 
     def __init__(self, name: str, method: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 timeout_s: Optional[float] = None):
         self._name = name
         self._method = method
         self._model_id = multiplexed_model_id
+        self._timeout_s = timeout_s
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
+                multiplexed_model_id: Optional[str] = None,
+                timeout_s: Optional[float] = None
                 ) -> "DeploymentHandle":
+        """Per-request options; ``timeout_s`` sets the end-to-end
+        deadline propagated with every request made through the returned
+        handle (router retries stop at it, the replica re-anchors it,
+        and a DecodeEngine finishes the slot at it)."""
         return DeploymentHandle(
             self._name,
             method_name if method_name is not None else self._method,
             (multiplexed_model_id if multiplexed_model_id is not None
-             else self._model_id))
+             else self._model_id),
+            timeout_s if timeout_s is not None else self._timeout_s)
 
     def remote(self, *args, **kwargs) -> Future:
         return _Router.get(self._name).submit(
-            self._method, args, kwargs, self._model_id)
+            self._method, args, kwargs, self._model_id,
+            timeout_s=self._timeout_s)
 
     def stream(self, *args, **kwargs):
         """Iterate a generator-returning deployment method incrementally
         (reference: handle streaming / chunked HTTP responses)."""
         return _Router.get(self._name).stream(
-            self._method, args, kwargs, self._model_id)
+            self._method, args, kwargs, self._model_id,
+            timeout_s=self._timeout_s)
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._name, name, self._model_id)
+        return DeploymentHandle(self._name, name, self._model_id,
+                                self._timeout_s)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method, self._model_id))
+        return (DeploymentHandle, (self._name, self._method, self._model_id,
+                                   self._timeout_s))
 
     def __repr__(self):
         return f"DeploymentHandle({self._name!r}, {self._method!r})"

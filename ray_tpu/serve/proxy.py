@@ -18,12 +18,36 @@ responses use chunked transfer with one JSON line per yielded item.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 _STREAM_END = object()
+
+
+class _ClientDisconnected(Exception):
+    """The HTTP client went away mid-response; nothing can be written."""
+
+
+def _lifecycle_error(e: BaseException):
+    """Walk an exception chain (TaskError.cause / RemoteCallError.cause /
+    __cause__) for a typed request-lifecycle error so the proxy can map
+    it onto the right status code instead of a blanket 500."""
+    from ray_tpu.core.errors import (DeadlineExceededError, OverloadedError,
+                                     RequestCancelledError)
+
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, (OverloadedError, DeadlineExceededError,
+                            RequestCancelledError)):
+            return cur
+        nxt = getattr(cur, "cause", None)
+        cur = nxt if isinstance(nxt, BaseException) else cur.__cause__
+    return None
 
 
 class _InFlight:
@@ -119,7 +143,59 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
             else:
                 self.send_error(404)
 
+        def _request_timeout_s(self) -> Optional[float]:
+            """The request's end-to-end budget: client header
+            ``X-Request-Timeout-S`` wins; else the
+            ``serve_request_timeout_s`` config default (0 = none)."""
+            from ray_tpu.core.config import config as rt_config
+
+            raw = self.headers.get("X-Request-Timeout-S", "")
+            if raw:
+                try:
+                    val = float(raw)
+                    if val > 0:
+                        return val
+                except ValueError:
+                    pass  # malformed header: fall through to the default
+            default = rt_config.serve_request_timeout_s
+            return default if default > 0 else None
+
+        def _send_plain(self, code: int, message: str,
+                        headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+            data = (message + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_lifecycle_error(self, e: BaseException) -> bool:
+            """Typed lifecycle outcomes get real status codes: shed ->
+            503 + Retry-After (from the replica's throughput estimate),
+            deadline -> 504, client-cancelled -> 499. Returns False when
+            ``e`` is not a lifecycle error."""
+            from ray_tpu.core.errors import (DeadlineExceededError,
+                                             OverloadedError,
+                                             RequestCancelledError)
+
+            cause = _lifecycle_error(e)
+            if isinstance(cause, OverloadedError):
+                retry = max(1, math.ceil(cause.retry_after_s))
+                self._send_plain(503, f"overloaded: {cause}",
+                                 (("Retry-After", str(retry)),))
+            elif isinstance(cause, DeadlineExceededError):
+                self._send_plain(504, f"deadline exceeded: {cause}")
+            elif isinstance(cause, RequestCancelledError):
+                self._send_plain(499, f"request cancelled: {cause}")
+            else:
+                return False
+            return True
+
         def _handle(self) -> None:
+            from concurrent.futures import TimeoutError as FutTimeout
+
             parts = self.path.strip("/").split("/")
             # Route table first (supports custom route_prefix); fall back
             # to the first path segment as the app name.
@@ -129,24 +205,38 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
             model_id = self.headers.get("serve_multiplexed_model_id", "")
             streaming = (self.headers.get("x-serve-stream", "")
                          or self.headers.get("X-Serve-Stream", ""))
+            timeout_s = self._request_timeout_s()
             try:
                 payload = json.loads(body)
                 handle = DeploymentHandle(name,
-                                          multiplexed_model_id=model_id)
+                                          multiplexed_model_id=model_id,
+                                          timeout_s=timeout_s)
                 if streaming:
                     self._stream_response(handle, payload, name)
                     return
-                result = handle.remote(payload).result(timeout=70)
+                # The deadline rides with the request (router retries
+                # stop at it; the engine frees the slot at it). The
+                # local wait gets a grace window past it so the TYPED
+                # DeadlineExceededError from the replica wins the race
+                # against this blunt local timeout.
+                result = handle.remote(payload).result(
+                    timeout=(timeout_s + 10.0) if timeout_s else None)
                 data = json.dumps(result).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            except _ClientDisconnected:
+                self.close_connection = True  # socket is gone; cancel done
             except KeyError:
                 self.send_error(404, f"no deployment {name!r}")
+            except FutTimeout:
+                self._send_plain(504, "deadline exceeded: no reply from "
+                                      "the deployment in time")
             except Exception as e:  # noqa: BLE001
-                self.send_error(500, str(e))
+                if not self._send_lifecycle_error(e):
+                    self.send_error(500, str(e))
 
         def _stream_response(self, handle, payload, name: str) -> None:
             """Chunked transfer encoding, one JSON line per yielded item
@@ -166,7 +256,8 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
                 self.send_error(404, f"no deployment {name!r}")
                 return
             except Exception as e:  # noqa: BLE001
-                self.send_error(500, str(e))
+                if not self._send_lifecycle_error(e):
+                    self.send_error(500, str(e))
                 return
             self.send_response(200)
             self.send_header("Content-Type", "application/jsonlines")
@@ -183,11 +274,27 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
                     chunk(json.dumps(first).encode() + b"\n")
                     for item in stream:
                         chunk(json.dumps(item).encode() + b"\n")
+            except (BrokenPipeError, ConnectionError) as e:
+                # Client hung up mid-stream: nothing can be written, but
+                # the disconnect must PROPAGATE — the finally's
+                # stream.close() cancels the replica stream, which
+                # cancels the engine request and frees its slot.
+                raise _ClientDisconnected(str(e)) from e
             except Exception as e:  # noqa: BLE001 — headers already sent
+                # Mid-stream failures (incl. DeadlineExceeded) can't
+                # rewrite the status line; they become an error record in
+                # the stream and the connection closes.
                 chunk(json.dumps(
                     {"__serve_stream_error__": str(e)}).encode() + b"\n")
             finally:
-                self.wfile.write(b"0\r\n\r\n")
+                # Deterministic cancellation: closing the generator runs
+                # the router's finally (cancel_stream -> replica -> engine
+                # .cancel) NOW, not at some later GC.
+                stream.close()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
                 self.close_connection = True
 
         def log_message(self, *args):  # silence

@@ -14,12 +14,25 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 _current_model_id = threading.local()
+_current_deadline = threading.local()
 
 
 def get_multiplexed_model_id() -> str:
     """Inside a replica: the model id of the in-flight request (reference:
     ``serve.get_multiplexed_model_id``)."""
     return getattr(_current_model_id, "value", "")
+
+
+def request_deadline_s() -> Optional[float]:
+    """Inside a replica: seconds remaining on the in-flight request's
+    deadline, or None when the caller set none. The deadline is
+    propagated as a RELATIVE duration at every hop (proxy -> handle ->
+    replica) so it never depends on cross-process clock agreement; here
+    it is re-anchored to this process's monotonic clock on arrival."""
+    deadline = getattr(_current_deadline, "value", None)
+    if deadline is None:
+        return None
+    return deadline - time.monotonic()
 
 
 class _MultiplexCache:
@@ -96,17 +109,21 @@ class ReplicaActor:
         self._started = time.monotonic()
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       multiplexed_model_id: str = ""):
+                       multiplexed_model_id: str = "",
+                       deadline_s: Optional[float] = None):
         with self._lock:
             self._ongoing += 1
             self._total += 1
         _current_model_id.value = multiplexed_model_id
+        _current_deadline.value = (time.monotonic() + deadline_s
+                                   if deadline_s is not None else None)
         try:
             target = (self._instance if method == "__call__"
                       else getattr(self._instance, method))
             return target(*args, **kwargs)
         finally:
             _current_model_id.value = ""
+            _current_deadline.value = None
             with self._lock:
                 self._ongoing -= 1
 
@@ -119,13 +136,17 @@ class ReplicaActor:
     # ASGI receive/send; here the handle is the transport).
 
     def start_stream(self, method: str, args: tuple, kwargs: dict,
-                     multiplexed_model_id: str = "") -> str:
+                     multiplexed_model_id: str = "",
+                     deadline_s: Optional[float] = None) -> str:
         import uuid
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
         _current_model_id.value = multiplexed_model_id
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        _current_deadline.value = deadline
         try:
             target = (self._instance if method == "__call__"
                       else getattr(self._instance, method))
@@ -137,9 +158,10 @@ class ReplicaActor:
             raise
         finally:
             _current_model_id.value = ""
+            _current_deadline.value = None
         sid = uuid.uuid4().hex[:16]
         self._streams = getattr(self, "_streams", {})
-        self._streams[sid] = (iterator, multiplexed_model_id)
+        self._streams[sid] = (iterator, multiplexed_model_id, deadline)
         return sid
 
     def next_chunks(self, stream_id: str, max_items: int = 16,
@@ -152,11 +174,12 @@ class ReplicaActor:
         entry = getattr(self, "_streams", {}).get(stream_id)
         if entry is None:
             raise KeyError(f"unknown stream {stream_id}")
-        iterator, model_id = entry
+        iterator, model_id, req_deadline = entry
         items = []
         done = False
         deadline = time.monotonic() + deadline_s
         _current_model_id.value = model_id  # generator body resumes here
+        _current_deadline.value = req_deadline
         try:
             for _ in range(max_items):
                 items.append(next(iterator))
@@ -169,6 +192,7 @@ class ReplicaActor:
             raise
         finally:
             _current_model_id.value = ""
+            _current_deadline.value = None
         if done:
             self.cancel_stream(stream_id)
         return items, done

@@ -75,6 +75,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout_s(n): per-test timeout override (seconds)")
     config.addinivalue_line("markers", "slow: long-running test")
+    # Chaos tests are fault-injection tests (SIGKILL, stalled peers,
+    # dropped connections). They are NOT slow-marked: the fast ones run
+    # in every tier-1 pass (`-m 'not slow'`), and `-m chaos` selects
+    # just the fault-injection surface.
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection test (replica kill, stalled "
+                   "peer); fast ones run in tier-1")
 
 
 @pytest.fixture(autouse=True)

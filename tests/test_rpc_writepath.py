@@ -163,6 +163,40 @@ def test_stalled_peer_does_not_head_of_line_block():
         srv.stop()
 
 
+@pytest.mark.chaos
+def test_stalled_peer_delays_no_ping_past_100ms():
+    """Write-path audit regression (ISSUE 3 satellite): with the queued
+    write path there is NO residual blocking send anywhere in the server
+    — inline handlers reply through _send_reply (non-blocking sendmsg +
+    EVENT_WRITE residue), so a peer that requests a multi-MB INLINE
+    reply and never reads can delay an unrelated ping by at most one
+    reactor pass. Bound EVERY ping at 100 ms (one scheduler outlier
+    tolerated), not just the median — the seed design blocked 15 s under
+    SO_SNDTIMEO on the FIRST stalled send."""
+    srv = _server()
+    try:
+        cli = RpcClient(srv.addr)
+        assert cli.call("ping", timeout=5.0) == "pong"  # warm the path
+        stalled = []
+        for _ in range(3):  # several stalled peers, replies all parked
+            stalled.append(_raw_request(srv.addr, "blob", 8 << 20))
+        time.sleep(0.2)
+        lats = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            assert cli.call("ping", timeout=5.0) == "pong"
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        assert lats[-2] < 0.1, (
+            f"ping delayed {lats[-2] * 1e3:.1f} ms by a stalled peer "
+            f"(worst {lats[-1] * 1e3:.1f} ms)")
+        for s in stalled:
+            s.close()
+        cli.close()
+    finally:
+        srv.stop()
+
+
 def test_backpressure_cap_drops_connection():
     """A peer that stops reading accumulates replies up to the cap, then
     its connection is dropped; the server keeps serving everyone else."""
