@@ -1,0 +1,13 @@
+# Developer entry points. Tier-1 CI runs `make lint` semantics via
+# tests/test_analysis.py::test_repo_is_clean_under_strict.
+
+.PHONY: lint lint-stats test
+
+lint:
+	python -m ray_tpu.analysis --strict
+
+lint-stats:
+	python -m ray_tpu.analysis --strict --stats
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

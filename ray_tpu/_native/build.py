@@ -32,6 +32,9 @@ def build_library(name: str, sources: list, extra_flags: list = ()) -> str:
         tmp = out + f".tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
                "-pthread", *extra_flags, "-o", tmp, *srcs]
+        # Compiling under _LOCK is deliberate: one build per process,
+        # everyone else waits for the .so instead of racing g++.
+        # graftlint: disable=lock-held-blocking
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)  # atomic: concurrent builders race safely
     return out

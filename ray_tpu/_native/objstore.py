@@ -88,7 +88,7 @@ class ShmView:
     def __del__(self):
         try:
             self.release()
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (interpreter-teardown __del__)
             pass
 
     def __enter__(self):
@@ -116,7 +116,7 @@ class ShmPin:
     def __del__(self):
         try:
             self.release()
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (interpreter-teardown __del__)
             pass
 
 

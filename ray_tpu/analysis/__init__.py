@@ -1,0 +1,124 @@
+"""graftlint: AST-based concurrency & trace-safety analysis for ray_tpu.
+
+Four checkers fitted to this codebase's real failure modes (each rule is
+documented in docs/ANALYSIS.md):
+
+=====================  ==================================================
+rule                   catches
+=====================  ==================================================
+reactor-blocking-call  blocking calls reachable from core/rpc.py selector
+                       callbacks (the PR 1 bug class)
+trace-host-sync        .item()/np.asarray/device_get inside @jax.jit
+trace-python-branch    Python if/while on traced values inside jit
+trace-retrace-hazard   traced values in shape positions, set iteration
+lock-order-cycle       lock-acquisition ordering cycles / self-deadlocks
+lock-held-blocking     RPC sends, connects, sleeps under a held lock
+swallowed-exception    ``except Exception: pass`` (the PR 3 bug class)
+missing-finally-release  acquire/release in one function w/o finally
+=====================  ==================================================
+
+Run it: ``python -m ray_tpu.analysis [--strict] [--format json]``, or
+``make lint``. Suppress a deliberate site with
+``# graftlint: disable=<rule>`` (same line or the line above); defer a
+triaged finding via ``analysis/baseline.json``
+(``--write-baseline``, then fill in the ``reason``). The tier-1 gate
+(tests/test_analysis.py) fails on any unbaselined finding.
+
+Pure stdlib ``ast`` — no jax import, no third-party deps; a full-repo
+run takes a few seconds (budgeted < 10 s, see BENCH_NOTES.md).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.analysis import rules
+from ray_tpu.analysis.core import (Baseline, Finding, Project,
+                                   assign_fingerprints)
+
+__all__ = ["run_analysis", "Finding", "Baseline", "Project",
+           "DEFAULT_BASELINE", "repo_root"]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def repo_root() -> str:
+    """The directory containing the ``ray_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_analysis(root: Optional[str] = None,
+                 select: Optional[Iterable[str]] = None,
+                 paths: Optional[Iterable[str]] = None,
+                 ) -> Tuple[List[Finding], Dict[str, float]]:
+    """Run every (selected) checker over the package.
+
+    Returns (findings, stats). Findings are pragma-filtered and carry
+    fingerprints, but are NOT baseline-filtered — callers split against
+    a Baseline themselves. ``paths`` restricts reported findings to
+    files whose relpath starts with one of the given prefixes (the whole
+    package is still parsed: the call graph needs it).
+    """
+    from ray_tpu.analysis import (lifecycle_hygiene, lock_discipline,
+                                  reactor_safety, trace_safety)
+    from ray_tpu.analysis.callgraph import CallGraph
+
+    t0 = time.perf_counter()
+    root = root or repo_root()
+    project = Project.load(root)
+    t_parse = time.perf_counter() - t0
+
+    selected = set(select) if select else set(rules.ALL_RULES)
+    findings: List[Finding] = []
+    per_rule: Dict[str, float] = {}
+
+    def timed(label: str, fn, *args) -> List[Finding]:
+        t = time.perf_counter()
+        out = fn(*args)
+        per_rule[label] = time.perf_counter() - t
+        return out
+
+    graph = None
+    need_graph = selected & {rules.REACTOR_BLOCKING, rules.TRACE_HOST_SYNC,
+                             rules.TRACE_PY_BRANCH, rules.TRACE_RETRACE,
+                             rules.LOCK_ORDER_CYCLE,
+                             rules.LOCK_HELD_BLOCKING}
+    if need_graph:
+        t = time.perf_counter()
+        graph = CallGraph(project)
+        per_rule["callgraph"] = time.perf_counter() - t
+    if rules.REACTOR_BLOCKING in selected:
+        findings += timed("reactor-safety", reactor_safety.check, graph)
+    if selected & {rules.TRACE_HOST_SYNC, rules.TRACE_PY_BRANCH,
+                   rules.TRACE_RETRACE}:
+        findings += timed("trace-safety", trace_safety.check, graph)
+    if selected & {rules.LOCK_ORDER_CYCLE, rules.LOCK_HELD_BLOCKING}:
+        findings += timed("lock-discipline", lock_discipline.check, graph)
+    if selected & {rules.SWALLOWED_EXCEPTION, rules.MISSING_FINALLY}:
+        findings += timed("lifecycle-hygiene",
+                          lifecycle_hygiene.check_project, project)
+
+    findings = [f for f in findings if f.rule in selected]
+    if paths:
+        prefixes = tuple(p.rstrip("/") for p in paths)
+        findings = [f for f in findings
+                    if any(f.path == p or f.path.startswith(p + "/")
+                           or f.path.startswith(p)
+                           for p in prefixes)]
+    # pragma suppression
+    by_rel = {f.relpath: f for f in project.files}
+    findings = [f for f in findings
+                if not (f.path in by_rel
+                        and by_rel[f.path].suppressed(f.rule, f.line))]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    assign_fingerprints(findings)
+
+    stats = {"files": float(len(project.files)),
+             "parse_s": t_parse,
+             "total_s": time.perf_counter() - t0}
+    stats.update({f"{k}_s": v for k, v in per_rule.items()})
+    return findings, stats

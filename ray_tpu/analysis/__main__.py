@@ -1,0 +1,107 @@
+"""graftlint CLI: ``python -m ray_tpu.analysis``.
+
+Exit codes: 0 = clean (or non-strict), 1 = unbaselined findings with
+``--strict``, 2 = bad usage. ``--write-baseline`` snapshots current
+findings into analysis/baseline.json (reasons of surviving entries are
+preserved; fill in new ones by hand — shipping ``TODO: triage`` reasons
+is a review smell, see docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ray_tpu.analysis import (DEFAULT_BASELINE, Baseline, repo_root,
+                              run_analysis)
+from ray_tpu.analysis import rules as _rules
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.analysis",
+        description="graftlint: AST concurrency & trace-safety analysis")
+    parser.add_argument("paths", nargs="*",
+                        help="restrict findings to these repo-relative "
+                             "path prefixes (default: whole package)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 on any unbaselined finding")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report everything, ignoring the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="merge current findings into the baseline")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-checker timings")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in _rules.ALL_RULES:
+            print(r)
+        return 0
+
+    select = None
+    if args.rules:
+        select = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in select if r not in _rules.ALL_RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    findings, stats = run_analysis(root=args.root or repo_root(),
+                                   select=select, paths=args.paths)
+    baseline = Baseline() if args.no_baseline \
+        else Baseline.load(args.baseline)
+    new, baselined, stale = baseline.split(findings)
+
+    if args.write_baseline:
+        baseline.write(args.baseline, findings,
+                       default_reason="TODO: triage")
+        print(f"wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": len(baselined),
+            "stale_baseline_entries": len(stale),
+            "stats": stats}, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        counts = {}
+        for f in new:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        summary = ", ".join(f"{r}: {n}" for r, n in sorted(counts.items()))
+        print(f"graftlint: {len(new)} finding(s)"
+              + (f" [{summary}]" if summary else "")
+              + f", {len(baselined)} baselined, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}, "
+              f"{int(stats['files'])} files in {stats['total_s']:.2f}s")
+        if stale and args.strict:
+            for e in stale:
+                print(f"  stale baseline: {e.get('path')}:"
+                      f"{e.get('line')} [{e.get('rule')}] "
+                      f"{e.get('symbol')}")
+        if args.stats:
+            for k, v in stats.items():
+                if k.endswith("_s"):
+                    print(f"  {k[:-2]:>20}: {v * 1e3:7.1f} ms")
+
+    if args.strict and (new or stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

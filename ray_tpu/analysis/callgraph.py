@@ -1,0 +1,328 @@
+"""Best-effort intra-package call graph over the project ASTs.
+
+Resolution is deliberately conservative: a call we cannot bind to a
+package function is simply not an edge (checkers treat unresolved calls
+as opaque). What IS resolved:
+
+* ``foo(...)``            — module function / class in the same module,
+                            or a ``from x import foo`` target.
+* ``mod.foo(...)``        — where ``mod``/alias binds an imported module
+                            (``import ray_tpu.core.rpc as rpc``).
+* ``self.meth(...)``      — method of the enclosing class (single-module
+                            base-class walk included).
+* ``Cls(...)``            — constructor => ``Cls.__init__``.
+* ``obj.meth(...)``       — when exactly one class in the same module
+                            defines ``meth`` (covers the ``st: _Conn``
+                            pattern in core/rpc.py).
+
+Imports are collected at module level AND inside each function (this
+codebase imports locally for cycle-avoidance all over).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import Project, SourceFile
+
+# Names that collide with builtin container/str methods: the
+# single-owner-in-module fallback must never bind `msg.get(...)` or
+# `buf.append(...)` to a package method that happens to share the name.
+_BUILTIN_METHODS: Set[str] = set()
+for _t in (dict, list, set, str, bytes, bytearray, tuple, frozenset):
+    _BUILTIN_METHODS.update(n for n in dir(_t) if not n.startswith("__"))
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class FunctionInfo:
+    fqn: str                     # "ray_tpu.core.rpc:RpcServer._flush"
+    module: str
+    qualname: str
+    cls: Optional[str]
+    node: ast.AST                # FunctionDef / AsyncFunctionDef
+    file: SourceFile
+    local_imports: Dict[str, Tuple[str, Optional[str]]] = \
+        field(default_factory=dict)
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fqn
+    bases: List[str] = field(default_factory=list)
+
+
+class CallGraph:
+    def __init__(self, project: Project, package: str = "ray_tpu"):
+        self.project = project
+        self.package = package
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        # module -> {local name -> (kind, target)}; kind "module" binds a
+        # module path, kind "object" binds (module path, attr name).
+        self.imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        # module -> method name -> [class names defining it]
+        self._method_owners: Dict[str, Dict[str, List[str]]] = {}
+        for f in project.files:
+            self._index_file(f)
+
+    # ------------------------------------------------------------ indexing
+
+    def _index_file(self, f: SourceFile) -> None:
+        imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.imports[f.module] = imports
+        owners: Dict[str, List[str]] = {}
+        self._method_owners[f.module] = owners
+
+        def collect_imports(node: ast.AST,
+                            into: Dict[str, Tuple[str, Optional[str]]]
+                            ) -> None:
+            for child in ast.walk(node):
+                if isinstance(child, ast.Import):
+                    for alias in child.names:
+                        name = alias.asname or alias.name.split(".")[0]
+                        target = alias.name if alias.asname else \
+                            alias.name.split(".")[0]
+                        into[name] = ("module", target)
+                elif isinstance(child, ast.ImportFrom) and child.module:
+                    for alias in child.names:
+                        into[alias.asname or alias.name] = (
+                            "object", f"{child.module}.{alias.name}")
+
+        collect_imports(f.tree, imports)
+
+        def visit(node: ast.AST, stack: List[ast.AST],
+                  cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    ci = ClassInfo(f.module, child.name, child,
+                                   bases=[d for d in
+                                          (dotted(b) for b in child.bases)
+                                          if d])
+                    self.classes[(f.module, child.name)] = ci
+                    visit(child, stack + [child], child.name)
+                elif isinstance(child,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn_parts = [n.name for n in stack
+                                if isinstance(n, (ast.ClassDef,
+                                                  ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))]
+                    qn = ".".join(qn_parts + [child.name])
+                    fqn = f"{f.module}:{qn}"
+                    info = FunctionInfo(fqn, f.module, qn, cls, child, f)
+                    collect_imports(child, info.local_imports)
+                    self.functions[fqn] = info
+                    if cls is not None and len(qn_parts) == 1:
+                        self.classes[(f.module, cls)].methods[
+                            child.name] = fqn
+                        owners.setdefault(child.name, []).append(cls)
+                    # nested defs: indexed but rarely resolved into
+                    visit(child, stack + [child], cls)
+
+        visit(f.tree, [], None)
+
+    # ---------------------------------------------------------- resolution
+
+    def _import_target(self, ctx: FunctionInfo, name: str
+                       ) -> Optional[Tuple[str, Optional[str]]]:
+        hit = ctx.local_imports.get(name)
+        if hit is None:
+            hit = self.imports.get(ctx.module, {}).get(name)
+        return hit
+
+    def _module_symbol(self, module: str, name: str) -> Optional[str]:
+        """fqn of function `name` or class-constructor in `module`."""
+        fqn = f"{module}:{name}"
+        if fqn in self.functions:
+            return fqn
+        ci = self.classes.get((module, name))
+        if ci is not None:
+            init = ci.methods.get("__init__")
+            return init if init is not None else fqn  # class w/o __init__
+        return None
+
+    def _class_method(self, module: str, cls: str, meth: str,
+                      depth: int = 0) -> Optional[str]:
+        ci = self.classes.get((module, cls))
+        if ci is None or depth > 4:
+            return None
+        fqn = ci.methods.get(meth)
+        if fqn is not None:
+            return fqn
+        for base in ci.bases:
+            base = base.split(".")[-1]
+            hit = self._class_method(module, base, meth, depth + 1)
+            if hit is not None:
+                return hit
+        return None
+
+    def resolve_call(self, call: ast.Call, ctx: FunctionInfo
+                     ) -> Tuple[Optional[str], bool]:
+        """-> (callee fqn or None, via_self)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            hit = self._module_symbol(ctx.module, name)
+            if hit is not None:
+                return hit, False
+            imp = self._import_target(ctx, name)
+            if imp is not None:
+                kind, target = imp
+                if kind == "object" and target and \
+                        target.startswith(self.package):
+                    mod, _, attr = target.rpartition(".")
+                    if mod in self.project.by_module:
+                        return self._module_symbol(mod, attr), False
+            return None, False
+        if isinstance(func, ast.Attribute):
+            recv, meth = func.value, func.attr
+            if isinstance(recv, ast.Name):
+                if recv.id in ("self", "cls") and ctx.cls is not None:
+                    return (self._class_method(ctx.module, ctx.cls, meth),
+                            True)
+                imp = self._import_target(ctx, recv.id)
+                if imp is not None and imp[0] == "module" and \
+                        imp[1].startswith(self.package) and \
+                        imp[1] in self.project.by_module:
+                    return self._module_symbol(imp[1], meth), False
+                # Cls.method(...) in the same module
+                if (ctx.module, recv.id) in self.classes:
+                    return (self._class_method(ctx.module, recv.id, meth),
+                            False)
+                # obj.meth for a bare-name receiver, when exactly one
+                # class in this module defines meth — covers the
+                # ``st: _Conn`` parameter pattern. Never for names shared
+                # with builtin container/str methods (msg.get,
+                # queue.popleft, buf.append...), and never for dotted
+                # receivers (self._cond.wait) whose type is unknowable.
+                if meth not in _BUILTIN_METHODS and meth != "__init__":
+                    owners = self._method_owners.get(ctx.module, {}).get(
+                        meth, [])
+                    if len(owners) == 1:
+                        return (self._class_method(ctx.module, owners[0],
+                                                   meth), False)
+            d = dotted(func)
+            if d is not None and d.startswith(self.package + "."):
+                mod, _, attr = d.rpartition(".")
+                if mod in self.project.by_module:
+                    return self._module_symbol(mod, attr), False
+        return None, False
+
+    def resolved_dotted(self, call: ast.Call, ctx: FunctionInfo
+                        ) -> Optional[str]:
+        """Dotted name with the leading import alias normalized to its
+        real module path (``sleep`` -> ``time.sleep`` for
+        ``from time import sleep``)."""
+        d = dotted(call.func)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        imp = self._import_target(ctx, head)
+        if imp is None:
+            return d
+        kind, target = imp
+        if kind == "module":
+            return f"{target}.{rest}" if rest else target
+        return f"{target}.{rest}" if rest else target
+
+    # ------------------------------------------------- blocking analysis
+
+    def direct_blocking_sites(self, info: FunctionInfo,
+                              dotted_table: Dict[str, str],
+                              methods_always: Dict[str, str],
+                              methods_unbounded: Dict[str, str],
+                              ) -> List[Tuple[int, str]]:
+        """(line, label) for every blocking primitive called directly in
+        this function (nested defs excluded — they run later)."""
+        sites: List[Tuple[int, str]] = []
+        for node in _walk_no_nested(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            rd = self.resolved_dotted(node, info)
+            if rd is not None and rd in dotted_table:
+                sites.append((node.lineno, f"{rd} ({dotted_table[rd]})"))
+                continue
+            if isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth in methods_always:
+                    sites.append(
+                        (node.lineno,
+                         f".{meth}() ({methods_always[meth]})"))
+                elif meth in methods_unbounded and not node.args \
+                        and not node.keywords:
+                    sites.append(
+                        (node.lineno,
+                         f".{meth}() ({methods_unbounded[meth]})"))
+        return sites
+
+    def blocking_closure(self, dotted_table: Dict[str, str],
+                         methods_always: Dict[str, str],
+                         methods_unbounded: Dict[str, str],
+                         ) -> Dict[str, List[str]]:
+        """fqn -> shortest call chain (list of labels) ending at a
+        blocking primitive, for every transitively-blocking function."""
+        direct: Dict[str, List[Tuple[int, str]]] = {}
+        edges: Dict[str, List[Tuple[str, int]]] = {}
+        for fqn, info in self.functions.items():
+            direct[fqn] = self.direct_blocking_sites(
+                info, dotted_table, methods_always, methods_unbounded)
+            outs: List[Tuple[str, int]] = []
+            for node in _walk_no_nested(info.node):
+                if isinstance(node, ast.Call):
+                    callee, _ = self.resolve_call(node, info)
+                    if callee is not None and callee in self.functions:
+                        outs.append((callee, node.lineno))
+            edges[fqn] = outs
+
+        chains: Dict[str, List[str]] = {}
+        for fqn, sites in direct.items():
+            if sites:
+                line, label = sites[0]
+                chains[fqn] = [f"{_short(fqn)}:{line} -> {label}"]
+        # BFS fixpoint: propagate the shortest chain to callers.
+        changed = True
+        while changed:
+            changed = False
+            for fqn, outs in edges.items():
+                if fqn in chains:
+                    continue
+                for callee, line in outs:
+                    if callee in chains:
+                        chains[fqn] = (
+                            [f"{_short(fqn)}:{line}"] + chains[callee])
+                        changed = True
+                        break
+        return chains
+
+
+def _walk_no_nested(fn_node: ast.AST):
+    """Walk a function body without descending into nested defs/classes
+    (those execute on their own schedule, not in this frame)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _short(fqn: str) -> str:
+    return fqn.split(":", 1)[-1]

@@ -1,0 +1,64 @@
+"""reactor-safety: no blocking call reachable from a selector callback.
+
+PR 1 existed because a blocking ``send`` on the reactor thread wedged
+every connection at once. This checker walks the call graph from the
+reactor-thread functions (``rules.REACTOR_ROOT_FUNCS`` plus anything
+named ``_on_readable``/``_on_writable``) and flags every blocking
+primitive — ``time.sleep``, blocking connect/sendall, unbounded
+``acquire``/``wait``/``join``/``result``, subprocess and file I/O —
+that the reactor could hit. Calls that cannot be resolved into the
+package (dynamic handler dispatch, pool submission) are treated as
+opaque: handlers run on the pool, which is exactly the design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ray_tpu.analysis import rules
+from ray_tpu.analysis.callgraph import CallGraph, _short, _walk_no_nested
+from ray_tpu.analysis.core import Finding
+
+
+def _dotted_table() -> Dict[str, str]:
+    table = dict(rules.BLOCKING_DOTTED)
+    table.update(rules.REACTOR_EXTRA_DOTTED)
+    return table
+
+
+def check(graph: CallGraph) -> List[Finding]:
+    roots = []
+    for fqn, info in graph.functions.items():
+        tail = info.qualname.rsplit(".", 1)[-1]
+        if (any(info.module.endswith(m) and info.qualname == q
+                for m, q in rules.REACTOR_ROOT_FUNCS)
+                or tail in rules.REACTOR_ROOT_NAME_PATTERNS):
+            roots.append(fqn)
+
+    dotted_table = _dotted_table()
+    findings: List[Finding] = []
+    # BFS the reactor-reachable set, remembering one path per function.
+    paths: Dict[str, List[str]] = {fqn: [_short(fqn)] for fqn in roots}
+    queue = list(roots)
+    while queue:
+        fqn = queue.pop(0)
+        info = graph.functions[fqn]
+        for site_line, label in graph.direct_blocking_sites(
+                info, dotted_table, rules.BLOCKING_METHODS_ALWAYS,
+                rules.BLOCKING_METHODS_UNBOUNDED):
+            via = " -> ".join(paths[fqn])
+            findings.append(Finding(
+                rule=rules.REACTOR_BLOCKING,
+                path=info.file.relpath, line=site_line,
+                symbol=info.qualname,
+                message=f"blocking call {label} on the reactor thread "
+                        f"(reachable via {via})"))
+        for node in _walk_no_nested(info.node):
+            if isinstance(node, ast.Call):
+                callee, _ = graph.resolve_call(node, info)
+                if callee is not None and callee in graph.functions \
+                        and callee not in paths:
+                    paths[callee] = paths[fqn] + [_short(callee)]
+                    queue.append(callee)
+    return findings

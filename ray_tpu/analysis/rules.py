@@ -1,0 +1,142 @@
+"""graftlint rule configuration: the tables the checkers consult.
+
+Everything fitted to THIS codebase's conventions lives here (reactor
+roots, blocking-API tables, jit decorator spellings, acquire/release
+pairs), so tuning the analyzer never means editing checker logic.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------- rule ids
+
+REACTOR_BLOCKING = "reactor-blocking-call"
+TRACE_HOST_SYNC = "trace-host-sync"
+TRACE_PY_BRANCH = "trace-python-branch"
+TRACE_RETRACE = "trace-retrace-hazard"
+LOCK_ORDER_CYCLE = "lock-order-cycle"
+LOCK_HELD_BLOCKING = "lock-held-blocking"
+SWALLOWED_EXCEPTION = "swallowed-exception"
+MISSING_FINALLY = "missing-finally-release"
+
+ALL_RULES = (
+    REACTOR_BLOCKING,
+    TRACE_HOST_SYNC, TRACE_PY_BRANCH, TRACE_RETRACE,
+    LOCK_ORDER_CYCLE, LOCK_HELD_BLOCKING,
+    SWALLOWED_EXCEPTION, MISSING_FINALLY,
+)
+
+# ------------------------------------------------- blocking-API tables
+
+# Dotted call targets that always block the calling thread. Matched
+# against the best-effort resolved dotted name at the call site.
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "socket.create_connection": "blocking connect",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "os.system": "subprocess",
+    "os.waitpid": "process wait",
+    "shutil.rmtree": "filesystem walk",
+}
+
+# Method names that always block regardless of receiver. The reactor's
+# own non-blocking socket verbs (recv/send/sendmsg/accept on sockets in
+# O_NONBLOCK) are deliberately absent: the analyzer cannot see
+# setblocking(False), so it only flags verbs with no non-blocking mode.
+BLOCKING_METHODS_ALWAYS = {
+    "sendall": "blocking socket send",
+    "connect": "blocking connect",
+    "recv_into": "blocking socket read",
+    "makefile": "socket file I/O",
+}
+
+# Method names that block only when called with no bounding argument
+# (lock.acquire(), event.wait(), thread.join(), future.result()).
+# Any positional or keyword argument is treated as a bound.
+BLOCKING_METHODS_UNBOUNDED = {
+    "acquire": "unbounded lock acquire",
+    "wait": "unbounded wait",
+    "join": "unbounded join",
+    "result": "unbounded future wait",
+}
+
+# Extra table for the LOCK checker only: an RPC issued while holding a
+# lock serializes every other path through that lock behind the peer's
+# latency. ``.call``/``.notify`` are this runtime's RPC verbs.
+RPC_METHODS = {
+    "call": "RPC round-trip",
+    "notify": "RPC send",
+}
+RPC_DOTTED = {
+    "ray_tpu.get": "blocking object get",
+    "ray_tpu.wait": "blocking wait",
+    "ray_tpu.kill": "actor-kill RPC",
+    "api.get": "blocking object get",
+}
+
+# The reactor checker additionally treats file I/O as blocking (a disk
+# stall wedges every connection); the lock checker does not (file writes
+# under a lock are often the point — e.g. checkpoint serialization).
+REACTOR_EXTRA_DOTTED = {
+    "open": "file I/O",
+}
+
+# ------------------------------------------------------ reactor roots
+
+# (module suffix, function qualname) pairs that run on a reactor /
+# selector thread. Name patterns catch conventional callback names in
+# future modules without a table edit.
+REACTOR_ROOT_FUNCS = {
+    ("ray_tpu.core.rpc", "RpcServer._reactor"),
+    ("ray_tpu.core.rpc", "RpcServer._accept"),
+    ("ray_tpu.core.rpc", "RpcServer._read"),
+    ("ray_tpu.core.rpc", "RpcServer._pump"),
+    ("ray_tpu.core.rpc", "RpcServer._drop"),
+    ("ray_tpu.core.rpc", "RpcServer._drain_ops"),
+    ("ray_tpu.core.rpc", "RpcServer._flush"),
+    ("ray_tpu.core.rpc", "RpcServer._flush_locked"),
+    ("ray_tpu.core.rpc", "RpcServer._set_writing"),
+    ("ray_tpu.core.rpc", "RpcServer._send_reply"),
+    # _handle runs on the pool for most methods but ON the reactor for
+    # inline_methods — it must satisfy reactor discipline.
+    ("ray_tpu.core.rpc", "RpcServer._handle"),
+}
+REACTOR_ROOT_NAME_PATTERNS = ("_on_readable", "_on_writable")
+
+# ---------------------------------------------------- jit decorators
+
+JIT_DOTTED_SUFFIXES = ("jit", "pjit", "shard_map")
+
+# Host-sync method calls that are always wrong under trace.
+TRACE_SYNC_METHODS = {
+    "item": "host sync (.item())",
+    "tolist": "host sync (.tolist())",
+    "block_until_ready": "host sync (block_until_ready)",
+}
+# Dotted host-sync calls (receiver-resolved). ``np``/``numpy`` aliases
+# are detected per-module from the import table.
+TRACE_SYNC_DOTTED = {
+    "jax.device_get": "host transfer (device_get)",
+}
+NUMPY_SYNC_FUNCS = {"asarray", "array"}
+
+# jnp constructors whose first (or ``shape=``) argument must be static.
+SHAPE_POSITION_FUNCS = {"zeros", "ones", "full", "empty", "arange",
+                        "broadcast_to"}
+
+# -------------------------------------------- lifecycle acquire/release
+
+# (acquire method name, release method name) — flagged when both appear
+# in one function with the release NOT in a ``finally`` block.
+ACQUIRE_RELEASE_METHODS = (
+    ("acquire", "release"),
+    ("register", "unregister"),
+)
+# Dotted acquire constructors -> release method on the result.
+ACQUIRE_RELEASE_DOTTED = (
+    ("socket.socket", "close"),
+    ("open", "close"),
+)

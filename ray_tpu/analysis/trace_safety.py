@@ -1,0 +1,343 @@
+"""jax-trace-safety: host syncs, tracer branches, retrace hazards in jit.
+
+In the static-bucket decode engine an accidental retrace (or a hidden
+host sync) turns a 0.3 ms step into a multi-second stall, and nothing
+crashes — it is only visible as tail latency. This checker finds
+functions under ``@jax.jit`` / ``pjit`` / ``shard_map`` (as decorators,
+``partial(jax.jit, ...)`` decorators, or ``f2 = jax.jit(f)`` wrapping)
+and flags, with a light forward taint pass over the function body:
+
+* trace-host-sync      — ``.item()``/``.tolist()``/``block_until_ready``
+                         /``jax.device_get``/``np.asarray`` on traced
+                         values, ``float()/int()/bool()`` of a traced
+                         name.
+* trace-python-branch  — ``if``/``while`` whose test uses a traced name
+                         directly (``.shape``/``.dtype``/``.ndim``/
+                         ``len()``/``is None``/``isinstance`` uses are
+                         static and exempt).
+* trace-retrace-hazard — a traced name in a shape position
+                         (``jnp.zeros(n)``), or iterating a ``set`` while
+                         building pytrees (unordered => cache-key churn).
+
+Taint = function parameters (minus ``static_argnums``/``static_argnames``
+when they are literals in the ``partial``) plus names assigned from
+expressions that use tainted names or call into ``jnp``/``jax.lax``-like
+modules. ``x.shape``-style attribute reads are static and un-taint.
+Transitively-called package functions get only the unambiguous checks
+(``.item()`` etc.) — their parameters may well be static Python values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis import rules
+from ray_tpu.analysis.callgraph import (CallGraph, FunctionInfo, dotted,
+                                        _walk_no_nested)
+from ray_tpu.analysis.core import Finding
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "names"}
+_TRACED_MODULE_HEADS = {"jnp", "jax", "lax", "nn"}
+
+
+def _is_jit_dotted(d: Optional[str]) -> bool:
+    return d is not None and (
+        d.split(".")[-1] in rules.JIT_DOTTED_SUFFIXES)
+
+
+def _jit_static_params(dec: ast.expr) -> Tuple[bool, Set[int], Set[str]]:
+    """(is_jit, static positions, static names) for a decorator expr."""
+    if _is_jit_dotted(dotted(dec)):
+        return True, set(), set()
+    if isinstance(dec, ast.Call):
+        d = dotted(dec.func)
+        statics_pos: Set[int] = set()
+        statics_name: Set[str] = set()
+        target = None
+        if _is_jit_dotted(d):
+            target = dec
+        elif d is not None and d.split(".")[-1] == "partial" and dec.args \
+                and _is_jit_dotted(dotted(dec.args[0])):
+            target = dec
+        if target is not None:
+            for kw in target.keywords:
+                try:
+                    val = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if kw.arg == "static_argnums":
+                    vals = val if isinstance(val, (tuple, list)) else [val]
+                    statics_pos.update(int(v) for v in vals)
+                elif kw.arg == "static_argnames":
+                    vals = [val] if isinstance(val, str) else list(val)
+                    statics_name.update(vals)
+            return True, statics_pos, statics_name
+    return False, set(), set()
+
+
+def _find_jit_functions(graph: CallGraph
+                        ) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """fqn -> (static positions, static names) for directly-jitted fns."""
+    marked: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for fqn, info in graph.functions.items():
+        for dec in getattr(info.node, "decorator_list", []):
+            is_jit, pos, names = _jit_static_params(dec)
+            if is_jit:
+                marked[fqn] = (pos, names)
+    # wrapping form: anything(jax.jit(f)) / x = jit(self._step)
+    for fqn, info in graph.functions.items():
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Call)
+                    and _is_jit_dotted(graph.resolved_dotted(node, info))
+                    and node.args):
+                continue
+            arg = node.args[0]
+            callee = None
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                fake = ast.Call(func=arg, args=[], keywords=[])
+                ast.copy_location(fake, arg)
+                callee, _ = graph.resolve_call(fake, info)
+            if callee is not None and callee in graph.functions:
+                pos: Set[int] = set()
+                names: Set[str] = set()
+                for kw in node.keywords:
+                    try:
+                        val = ast.literal_eval(kw.value)
+                    except (ValueError, SyntaxError):
+                        continue
+                    if kw.arg == "static_argnums":
+                        vals = val if isinstance(val, (tuple, list)) \
+                            else [val]
+                        pos.update(int(v) for v in vals)
+                    elif kw.arg == "static_argnames":
+                        names.update([val] if isinstance(val, str)
+                                     else list(val))
+                marked.setdefault(callee, (pos, names))
+    return marked
+
+
+def _numpy_aliases(graph: CallGraph, info: FunctionInfo) -> Set[str]:
+    out = set()
+    for table in (graph.imports.get(info.module, {}), info.local_imports):
+        for name, (kind, target) in table.items():
+            if kind == "module" and target == "numpy":
+                out.add(name)
+    return out
+
+
+def _taint(info: FunctionInfo, statics: Tuple[Set[int], Set[str]]
+           ) -> Set[str]:
+    """Forward pass: which local names carry traced values."""
+    pos_static, name_static = statics
+    args = info.node.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    traced: Set[str] = set()
+    for i, p in enumerate(params):
+        if p in ("self", "cls") or i in pos_static or p in name_static:
+            continue
+        traced.add(p)
+    traced.update(a.arg for a in args.kwonlyargs
+                  if a.arg not in name_static)
+
+    def uses_traced(expr: ast.AST) -> bool:
+        # Manual walk so `x.shape[0]`-style static reads are PRUNED —
+        # the `x` underneath must not taint the assignment target.
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                continue  # static metadata read: don't descend
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d is not None and d.split(".")[0] in \
+                        _TRACED_MODULE_HEADS:
+                    return True
+                if d in ("len", "isinstance", "type"):
+                    continue  # static: don't descend into the argument
+            if isinstance(n, ast.Name) and n.id in traced:
+                return True
+            stack.extend(ast.iter_child_nodes(n))
+        return False
+
+    # two passes to reach a simple fixpoint on straight-line code
+    for _ in range(2):
+        for node in _walk_no_nested(info.node):
+            if isinstance(node, ast.Assign):
+                tainted = uses_traced(node.value)
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            if tainted:
+                                traced.add(n.id)
+                            else:
+                                traced.discard(n.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                if uses_traced(node.value):
+                    traced.add(node.target.id)
+    return traced
+
+
+def _test_traced_names(test: ast.AST, traced: Set[str]) -> List[str]:
+    """Traced names used *directly* in a test (static contexts exempt)."""
+    static_name_ids: Set[int] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Name):
+                    static_name_ids.add(id(sub))
+        elif isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d in ("len", "isinstance", "getattr", "hasattr", "type"):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name):
+                        static_name_ids.add(id(sub))
+        elif isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Name):
+                    static_name_ids.add(id(sub))
+    hits = []
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in traced \
+                and id(n) not in static_name_ids:
+            hits.append(n.id)
+    return hits
+
+
+def _check_marked(graph: CallGraph, info: FunctionInfo,
+                  statics: Tuple[Set[int], Set[str]],
+                  findings: List[Finding]) -> None:
+    traced = _taint(info, statics)
+    np_aliases = _numpy_aliases(graph, info)
+    for node in _walk_no_nested(info.node):
+        if isinstance(node, ast.Call):
+            _check_sync_call(graph, info, node, traced, np_aliases,
+                             findings, in_marked=True)
+            _check_shape_position(graph, info, node, traced, findings)
+        elif isinstance(node, (ast.If, ast.While)):
+            hits = _test_traced_names(node.test, traced)
+            if hits:
+                kind = "while" if isinstance(node, ast.While) else "if"
+                findings.append(Finding(
+                    rule=rules.TRACE_PY_BRANCH,
+                    path=info.file.relpath, line=node.lineno,
+                    symbol=info.qualname,
+                    message=f"`{kind}` on traced value(s) "
+                            f"{sorted(set(hits))} inside jit — use "
+                            f"lax.cond/select or hoist to a static "
+                            f"argument"))
+        elif isinstance(node, ast.For):
+            it = node.iter
+            is_set = isinstance(it, ast.Set) or (
+                isinstance(it, ast.Call) and dotted(it.func) == "set")
+            if is_set:
+                findings.append(Finding(
+                    rule=rules.TRACE_RETRACE,
+                    path=info.file.relpath, line=node.lineno,
+                    symbol=info.qualname,
+                    message="iterating a set inside jit — unordered "
+                            "iteration churns the trace cache key"))
+
+
+def _check_sync_call(graph: CallGraph, info: FunctionInfo, node: ast.Call,
+                     traced: Set[str], np_aliases: Set[str],
+                     findings: List[Finding], in_marked: bool) -> None:
+    path, qn = info.file.relpath, info.qualname
+
+    def add(rule: str, msg: str) -> None:
+        findings.append(Finding(rule=rule, path=path, line=node.lineno,
+                                symbol=qn, message=msg))
+
+    if isinstance(node.func, ast.Attribute):
+        meth = node.func.attr
+        if meth in rules.TRACE_SYNC_METHODS:
+            add(rules.TRACE_HOST_SYNC,
+                f"{rules.TRACE_SYNC_METHODS[meth]} inside jit")
+            return
+    rd = graph.resolved_dotted(node, info)
+    if rd in rules.TRACE_SYNC_DOTTED:
+        add(rules.TRACE_HOST_SYNC,
+            f"{rules.TRACE_SYNC_DOTTED[rd]} inside jit")
+        return
+    d = dotted(node.func)
+    if d is not None and "." in d:
+        head, _, tail = d.partition(".")
+        if head in np_aliases and tail in rules.NUMPY_SYNC_FUNCS \
+                and node.args and not isinstance(node.args[0],
+                                                 ast.Constant):
+            add(rules.TRACE_HOST_SYNC,
+                f"numpy {tail}() inside jit forces host concretization")
+            return
+    if in_marked and d in ("float", "int", "bool") and len(node.args) == 1:
+        arg = node.args[0]
+        names = {n.id for n in ast.walk(arg) if isinstance(n, ast.Name)}
+        if names & traced:
+            add(rules.TRACE_HOST_SYNC,
+                f"{d}() of traced value inside jit is a host sync "
+                f"(ConcretizationTypeError under jit)")
+
+
+def _check_shape_position(graph: CallGraph, info: FunctionInfo,
+                          node: ast.Call, traced: Set[str],
+                          findings: List[Finding]) -> None:
+    d = dotted(node.func)
+    if d is None:
+        return
+    tail = d.split(".")[-1]
+    if tail not in rules.SHAPE_POSITION_FUNCS:
+        return
+    if "." not in d and tail != "reshape":
+        return  # bare zeros()/full() etc. unlikely to be jnp
+    shape_args: List[ast.AST] = []
+    if node.args:
+        shape_args.append(node.args[0])
+    shape_args.extend(kw.value for kw in node.keywords
+                      if kw.arg == "shape")
+    for arg in shape_args:
+        hits = [n.id for n in ast.walk(arg)
+                if isinstance(n, ast.Name) and n.id in traced]
+        # x.shape-derived ints are fine; the taint pass already excludes
+        # them, so a hit here is a traced VALUE in a shape slot.
+        if hits:
+            findings.append(Finding(
+                rule=rules.TRACE_RETRACE,
+                path=info.file.relpath, line=node.lineno,
+                symbol=info.qualname,
+                message=f"traced value(s) {sorted(set(hits))} in shape "
+                        f"position of {tail}() — concretization error or "
+                        f"per-value retrace"))
+            return
+
+
+def check(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    marked = _find_jit_functions(graph)
+    for fqn, statics in marked.items():
+        _check_marked(graph, graph.functions[fqn], statics, findings)
+    # transitively jit-reachable: unambiguous host syncs only
+    reachable: Set[str] = set()
+    queue = list(marked)
+    seen: Set[str] = set(queue)
+    while queue:
+        fqn = queue.pop(0)
+        info = graph.functions[fqn]
+        for node in _walk_no_nested(info.node):
+            if isinstance(node, ast.Call):
+                callee, _ = graph.resolve_call(node, info)
+                if callee is not None and callee in graph.functions \
+                        and callee not in seen:
+                    seen.add(callee)
+                    reachable.add(callee)
+                    queue.append(callee)
+    for fqn in reachable:
+        if fqn in marked:
+            continue
+        info = graph.functions[fqn]
+        np_aliases = _numpy_aliases(graph, info)
+        for node in _walk_no_nested(info.node):
+            if isinstance(node, ast.Call):
+                _check_sync_call(graph, info, node, set(), np_aliases,
+                                 findings, in_marked=False)
+    return findings

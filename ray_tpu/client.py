@@ -22,6 +22,7 @@ argument-tree walking.
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -29,6 +30,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ray_tpu.core import serialization
 from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 Addr = Tuple[str, int]
 
@@ -163,7 +167,9 @@ class ClientServer:
                 try:
                     handle.kill(no_restart=True)
                 except Exception:
-                    pass
+                    log_every("client.session_actor_kill", 10.0, logger,
+                              "killing session actor failed",
+                              exc_info=True)
         session.refs.clear()
         session.actors.clear()
 
@@ -446,7 +452,10 @@ class ClientCore:
             try:
                 self._ping_rpc.call("client_ping", self._sid, timeout=10.0)
             except Exception:
-                pass
+                # Enough missed pings and the server reaps the session —
+                # the user deserves a trail before that happens.
+                log_every("client.ping", period * 3, logger,
+                          "client keepalive ping failed", exc_info=True)
 
     # -- plumbing
 
@@ -470,7 +479,11 @@ class ClientCore:
             try:
                 self._rpc.call("client_release", self._sid, batch)
             except Exception:
-                pass
+                # The dropped batch leaks server-side refs until session
+                # teardown — tolerable, but never silent.
+                log_every("client.release", 10.0, logger,
+                          "releasing %d client refs failed", len(batch),
+                          exc_info=True)
 
     # -- public surface (mirrors core worker usage in api.py)
 
@@ -514,7 +527,11 @@ class ClientCore:
         try:
             self._rpc.call("client_disconnect", self._sid, timeout=10.0)
         except Exception:
-            pass
+            # Best-effort goodbye; the server reaps the session on ping
+            # timeout anyway.
+            log_every("client.disconnect", 10.0, logger,
+                      "clean disconnect failed", level=logging.INFO,
+                      exc_info=True)
         self._rpc.close()
         self._ping_rpc.close()
         if _current_client is self:

@@ -53,13 +53,13 @@ class LaunchedCluster:
             for pid in list(self.provider.non_terminated_nodes()):
                 try:
                     self.provider.terminate_node(pid)
-                except Exception:
+                except Exception:  # graftlint: disable=swallowed-exception (best-effort cloud teardown; each node logged via actions)
                     pass
         if self.api_client is not None and self.head_path is not None:
             try:
                 self.api_client.delete_node(self.head_path)
                 self.actions.append(f"deleted head slice {self.head_path}")
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort cloud teardown)
                 pass
         if self.head_node is not None:
             self.head_node.stop()

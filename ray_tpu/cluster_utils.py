@@ -85,7 +85,7 @@ class Cluster:
         for node in self.nodes:
             try:
                 node.stop()
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort fixture teardown)
                 pass
         self.nodes.clear()
         self.controller.stop()

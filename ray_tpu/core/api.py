@@ -121,7 +121,7 @@ def _autodetect_tpu(resources: Dict[str, float], labels: Dict[str, str]) -> None
             resources["TPU"] = float(chips)
             if pod_type:
                 labels.setdefault("tpu_pod_type", pod_type)
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception (TPU autodetect probe: absence of TPU metadata is the common case)
         pass
 
 
@@ -141,7 +141,7 @@ def shutdown() -> None:
                     _local_cluster[1].log_monitor is not None:
                 _local_cluster[1].log_monitor.scan_once()
             _log_streamer.poll_once(timeout=0.2)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (final log drain at shutdown)
             pass
         _log_streamer.stop()
         _log_streamer = None
@@ -150,7 +150,7 @@ def shutdown() -> None:
         from ray_tpu import usage as _usage
 
         _usage.write_report()
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception (local usage report is optional)
         pass
     if _config_snapshot is not None:
         # _system_config overrides are scoped to the init()..shutdown() span;
@@ -161,7 +161,7 @@ def shutdown() -> None:
     set_core_worker(None)
     try:
         core.shutdown()
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception (best-effort core teardown)
         pass
     if _local_cluster is not None:
         controller, node = _local_cluster

@@ -19,6 +19,7 @@ live with their owners; the controller never sees them.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -28,6 +29,9 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID
 from ray_tpu.core.pubsub import Pubsub
 from ray_tpu.core.rpc import ClientPool, RpcServer
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 Addr = Tuple[str, int]
 
@@ -653,19 +657,28 @@ class Controller:
                 reply = self._clients.get(worker_addr).call(
                     "start_actor", spec, timeout=None)
                 if reply["ok"]:
+                    raced = False
                     with self._lock:
                         rec = self._actors.get(actor_id)
                         if rec is None or rec.incarnation != incarnation \
                                 or rec.state == DEAD:
-                            # Raced with kill/another restart: release worker.
-                            self._clients.get(tuple(node_addr)).call(
-                                "kill_worker", lease["worker_id"], True)
-                            return
-                        rec.state = ALIVE
-                        rec.addr = (worker_addr, lease["worker_id"],
-                                    tuple(node_addr))
-                        rec.node_id = NodeID(node_id_bytes)
-                        self._publish_actor(rec)
+                            # Raced with kill/another restart: release
+                            # the worker — but OUTSIDE self._lock. The
+                            # kill_worker RPC has no timeout, and _lock
+                            # guards ALL controller state: a slow node
+                            # here would stall every heartbeat/lease/
+                            # kill in the control plane behind this one
+                            # cleanup (graftlint: lock-held-blocking).
+                            raced = True
+                        else:
+                            rec.state = ALIVE
+                            rec.addr = (worker_addr, lease["worker_id"],
+                                        tuple(node_addr))
+                            rec.node_id = NodeID(node_id_bytes)
+                            self._publish_actor(rec)
+                    if raced:
+                        self._clients.get(tuple(node_addr)).call(
+                            "kill_worker", lease["worker_id"], True)
                     return
                 # __init__ raised: permanent failure, no restart (parity with
                 # the reference: creation-task errors kill the actor).
@@ -677,7 +690,9 @@ class Controller:
 
                     err = serialization.deserialize(reply["error_frame"])
                     err_desc = f"__init__ failed: {getattr(err, 'tb', err)}"
-                except Exception:
+                except Exception:  # graftlint: disable=swallowed-exception
+                    # Undeserializable error frame: the generic err_desc
+                    # above already tells the caller WHAT failed.
                     pass
                 self._mark_dead_locked_safe(actor_id, err_desc)
                 return
@@ -743,7 +758,11 @@ class Controller:
                 self._clients.get(tuple(node_addr)).call(
                     "kill_worker", worker_id, True, timeout=5.0)
             except Exception:
-                pass
+                # The node may already be dead (its reaper got the
+                # worker); a live node failing kills leaks workers.
+                log_every("controller.kill_worker", 10.0, logger,
+                          "kill_worker for actor kill failed",
+                          exc_info=True)
         if not no_restart:
             self.report_actor_failure(actor_id_bytes, "killed (restartable)")
 
@@ -875,7 +894,11 @@ class Controller:
                     self._clients.get(node_rec.addr).call(
                         "release_bundle", pg_id_bytes, idx)
                 except Exception:
-                    pass
+                    # A failed rollback strands the bundle's resources
+                    # until the node re-registers — worth a trail.
+                    log_every("controller.release_bundle", 10.0, logger,
+                              "placement-group bundle rollback failed",
+                              exc_info=True)
             with self._lock:
                 rec.state = "PENDING"
             return {"state": "PENDING", "reason": "reservation_failed"}
@@ -961,7 +984,9 @@ class Controller:
             try:
                 self._clients.get(addr).call("release_bundle", pg_id_bytes, idx)
             except Exception:
-                pass
+                log_every("controller.release_bundle", 10.0, logger,
+                          "placement-group bundle release failed",
+                          exc_info=True)
             with self._lock:
                 node_rec = self._nodes.get(node_id)
                 if node_rec is not None:
@@ -1025,6 +1050,9 @@ class Controller:
         try:
             self.save_state()
         except Exception:
-            pass
+            # Failing to persist at shutdown means the next head start
+            # comes up empty — never silent.
+            logger.warning("controller state save on stop failed",
+                           exc_info=True)
         self._clients.close_all()
         self._server.stop()

@@ -144,7 +144,9 @@ def main() -> int:
                 try:
                     sys.stdout.flush()
                     sys.stderr.flush()
-                except Exception:
+                except Exception:  # graftlint: disable=swallowed-exception
+                    # About to os._exit inside a forked child: nothing
+                    # to report to, nowhere to report.
                     pass
                 os._exit(code)
         _write_msg(stdout, {"pid": pid})

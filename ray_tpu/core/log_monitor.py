@@ -15,11 +15,15 @@ between polls are never lost (up to the window size).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import config
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 LOG_CHANNEL = "logs"
 
@@ -57,7 +61,8 @@ class LogMonitor:
             try:
                 self.scan_once()
             except Exception:
-                pass
+                log_every("log_monitor.scan", 60.0, logger,
+                          "log scan pass failed", exc_info=True)
 
     def scan_once(self) -> int:
         """Read appended bytes from every log file; publish if new lines.
@@ -116,7 +121,9 @@ class LogMonitor:
                 "psub_publish", LOG_CHANNEL, self._node.node_id.hex(),
                 {"end": self._end, "window": list(self._window)})
         except Exception:
-            pass
+            # Lines stay in the window; the next scan republishes them.
+            log_every("log_monitor.publish", 60.0, logger,
+                      "log window publish failed", exc_info=True)
         return len(new)
 
 

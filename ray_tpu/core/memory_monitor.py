@@ -26,11 +26,15 @@ reader via ``MemoryMonitor.set_reader``.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
 from ray_tpu.core.config import config
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 Reading = Tuple[int, int]  # (used_bytes, total_bytes)
 
@@ -137,7 +141,10 @@ class MemoryMonitor:
             try:
                 self.check_once()
             except Exception:
-                pass
+                # A monitor that fails every tick means NO oom
+                # protection — keep running, but say so.
+                log_every("memory_monitor.check", 60.0, logger,
+                          "memory watermark check failed", exc_info=True)
 
     def check_once(self) -> Optional[bytes]:
         """One watermark check; returns the killed worker id (or None)."""

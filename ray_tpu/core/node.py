@@ -19,6 +19,7 @@ inline RPC methods so they always make progress while lease calls wait.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import select
@@ -34,6 +35,9 @@ from ray_tpu.core import resources as resmath
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import NodeID, WorkerID
 from ray_tpu.core.rpc import ClientPool, ReconnectingClient, RpcServer
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 Addr = Tuple[str, int]
 BundleKey = Tuple[bytes, int]  # (placement group id, bundle index)
@@ -746,6 +750,11 @@ class Node:
         with self._fs_lock:
             try:
                 if self._fs_proc is None or self._fs_proc.poll() is not None:
+                    # Spawning the forkserver under _fs_lock is the
+                    # design: the pipe protocol allows exactly one
+                    # in-flight request, and a second starter would
+                    # orphan the first template process.
+                    # graftlint: disable=lock-held-blocking
                     self._start_forkserver_locked()
                 proc = self._fs_proc
                 blob = pickle.dumps(req, protocol=5)
@@ -1021,7 +1030,10 @@ class Node:
                         self.total_resources, self.labels, timeout=5.0)
                     last_sent = None
             except Exception:
-                pass
+                # Miss enough beats and the head declares this node dead
+                # — the operator needs the trail on THIS side too.
+                log_every("node.heartbeat", 15.0, logger,
+                          "heartbeat to controller failed", exc_info=True)
 
     def _reaper_loop(self) -> None:
         last_env_gc = time.monotonic()
@@ -1124,7 +1136,9 @@ class Node:
         try:
             gc_envs(config.runtime_env_cache_bytes, in_use)
         except Exception:
-            pass
+            # A gc pass that always fails fills the disk with dead venvs.
+            log_every("node.env_gc", 60.0, logger,
+                      "runtime-env cache gc failed", exc_info=True)
 
     def read_shm_object(self, oid_bytes: bytes) -> Optional[bytes]:
         """Serve a whole object from this node's store (or its spill dir) to
@@ -1268,7 +1282,9 @@ class Node:
         try:
             self._controller.call("unregister_node", self.node_id.binary(),
                                   timeout=2.0)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception
+            # Best-effort goodbye at shutdown: the head reaps us by
+            # heartbeat timeout regardless.
             pass
         self._controller.close()
         self._server.stop()

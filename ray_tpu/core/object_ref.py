@@ -10,10 +10,13 @@ address so any process can resolve it without a central directory.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, Optional, Tuple
 
 from ray_tpu.core.ids import ObjectID
+
+logger = logging.getLogger(__name__)
 
 Addr = Tuple[str, int]
 
@@ -99,7 +102,12 @@ class _RefTracker:
         try:
             core.store.drop(ObjectID(oid))
         except Exception:
-            pass
+            from ray_tpu.util.ratelimit import log_every
+
+            # Failure leaves a stale borrower-cache entry (memory, not
+            # correctness) — but systematic failure means store trouble.
+            log_every("object_ref.cache_drop", 60.0, logger,
+                      "borrower cache drop failed", exc_info=True)
 
     def _flush_loop(self) -> None:
         from ray_tpu.core.config import config
@@ -166,7 +174,9 @@ class ObjectRef:
         if getattr(self, "_tracked", False):
             try:
                 _RefTracker.get().dec(self.owner_addr, self.id.binary())
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception
+                # __del__ may run during interpreter teardown, when the
+                # tracker (or logging itself) is already dismantled.
                 pass
 
     def hex(self) -> str:

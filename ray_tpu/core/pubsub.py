@@ -17,9 +17,12 @@ up-to-date version parks until publish or timeout.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class Pubsub:
@@ -145,7 +148,14 @@ class Subscriber:
                 try:
                     callback(version, value)
                 except Exception:
-                    pass
+                    # The watch loop must outlive one bad callback, but
+                    # a subscriber silently not applying updates is a
+                    # routing/membership bug in the making.
+                    from ray_tpu.util.ratelimit import log_every
+
+                    log_every(f"pubsub.watch.{channel}", 10.0, logger,
+                              "watch callback for %r failed", channel,
+                              exc_info=True)
 
         thread = threading.Thread(target=_loop, daemon=True,
                                   name=f"psub-watch-{channel}-{key}")

@@ -56,8 +56,16 @@ def export_callable(fn) -> tuple:
     key, blob = cached
     core = get_core_worker()
     with _export_lock:
-        if key not in _exported_keys:
-            core.controller.call("kv_put", key, blob, False)
+        exported = key in _exported_keys
+    if not exported:
+        # The KV write happens OUTSIDE _export_lock: holding it across
+        # the RPC would serialize every first-submit of every function
+        # behind one controller round-trip (graftlint:
+        # lock-held-blocking). Keys are content-addressed, so a
+        # concurrent duplicate put is idempotent — worst case one
+        # redundant RPC, never a wrong value.
+        core.controller.call("kv_put", key, blob, False)
+        with _export_lock:
             _exported_keys.add(key)
     return key, blob
 

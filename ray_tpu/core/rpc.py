@@ -715,7 +715,11 @@ class RpcClient:
             # The pool reclaimed this idle connection while a caller still
             # held the handle (the get()/call() race): transparently
             # re-dial. Eviction requires no in-flight calls, so nothing is
-            # lost; any stragglers were failed by the old reader.
+            # lost; any stragglers were failed by the old reader. The dial
+            # happens under _lifecycle_lock ON PURPOSE: it serializes
+            # against _evict/close so eviction can never shut a half-built
+            # fresh socket (see _evict's docstring).
+            # graftlint: disable=lock-held-blocking
             self._sock = _connect(self.addr, None)
             self._pool_evicted = False
             self._closed = False
@@ -740,7 +744,13 @@ class RpcClient:
             with self._pending_lock:
                 self._pending[req_id] = call
             try:
+                # _send_lock held across the blocking send BY DESIGN:
+                # its entire purpose is to serialize frame writes so two
+                # threads can't interleave torn frames on the wire.
+                # Client sends are caller-thread blocking (module
+                # docstring); only the server reactor is non-blocking.
                 with self._send_lock:
+                    # graftlint: disable=lock-held-blocking
                     send_frame(self._sock, payload)
                 break
             except OSError as e:
@@ -768,7 +778,9 @@ class RpcClient:
                                "args": args, "kwargs": kwargs})
         for attempt in (0, 1):
             try:
+                # Same frame-write serialization as call() above.
                 with self._send_lock:
+                    # graftlint: disable=lock-held-blocking
                     send_frame(self._sock, payload)
                 return
             except OSError as e:
@@ -875,9 +887,28 @@ class ReconnectingClient:
         with self._lock:
             if self._closed:
                 raise RpcError(f"client to {self.addr} is closed")
-            if self._client is None or self._client._closed:
-                self._client = RpcClient(self.addr)
-            return self._client
+            client = self._client
+        if client is not None and not client._closed:
+            return client
+        # Dial OUTSIDE the lock: a peer that is down costs a connect
+        # retry loop (seconds), and holding _lock across it would wedge
+        # every concurrent call/notify/close on this handle behind one
+        # stuck re-dial (graftlint: lock-held-blocking). Concurrent
+        # re-dials are possible and cheap; first one in wins.
+        fresh = RpcClient(self.addr)
+        with self._lock:
+            if self._closed:
+                winner = None
+            elif self._client is None or self._client._closed:
+                self._client = fresh
+                winner = fresh
+            else:
+                winner = self._client
+        if winner is not fresh:
+            fresh.close()
+        if winner is None:
+            raise RpcError(f"client to {self.addr} is closed")
+        return winner
 
     def call(self, method: str, *args, timeout: Optional[float] = None,
              **kwargs):
@@ -935,7 +966,6 @@ class ClientPool:
         import time as _time
 
         addr = tuple(addr)
-        evicted: List[RpcClient] = []
         now = _time.monotonic()
         with self._lock:
             client = self._clients.get(addr)
@@ -943,26 +973,44 @@ class ClientPool:
                 self._clients.move_to_end(addr)
                 client._last_handout = now
                 return client
-            client = RpcClient(addr)
-            client._last_handout = now
-            self._clients[addr] = client
-            if len(self._clients) > self._max:
-                for key in list(self._clients):
-                    if len(self._clients) <= self._max:
-                        break
-                    if key == addr:
-                        continue
-                    cand = self._clients[key]
-                    # Evict only clients that are idle AND haven't been
-                    # handed out recently: a thread that just got this
-                    # client may not have registered its call yet, and a
-                    # point-in-time _pending check alone would close the
-                    # connection under it.
-                    if (not cand._pending
-                            and now - getattr(cand, "_last_handout", 0.0)
-                            > 5.0):
-                        del self._clients[key]
-                        evicted.append(cand)
+        # Dial OUTSIDE the pool lock. The connect path retries with
+        # sleeps for seconds when the peer is down; under _lock that
+        # head-of-line-blocked every get() for every OTHER (healthy)
+        # address in the process — on the serve path, one dead replica
+        # wedged the whole router (graftlint: lock-held-blocking).
+        # Concurrent gets for the same addr may each dial; the first to
+        # re-check under the lock wins and the rest close their socket.
+        fresh = RpcClient(addr)
+        evicted: List[RpcClient] = []
+        now = _time.monotonic()
+        with self._lock:
+            client = self._clients.get(addr)
+            if client is not None and not client._closed:
+                self._clients.move_to_end(addr)
+                client._last_handout = now
+            else:
+                client = fresh
+                client._last_handout = now
+                self._clients[addr] = client
+                if len(self._clients) > self._max:
+                    for key in list(self._clients):
+                        if len(self._clients) <= self._max:
+                            break
+                        if key == addr:
+                            continue
+                        cand = self._clients[key]
+                        # Evict only clients that are idle AND haven't
+                        # been handed out recently: a thread that just
+                        # got this client may not have registered its
+                        # call yet, and a point-in-time _pending check
+                        # alone would close the connection under it.
+                        if (not cand._pending
+                                and now - getattr(cand, "_last_handout",
+                                                  0.0) > 5.0):
+                            del self._clients[key]
+                            evicted.append(cand)
+        if client is not fresh:
+            fresh.close()  # lost the insert race; drop the spare socket
         for c in evicted:
             c._evict()  # mark+close atomically; holders re-dial
         return client

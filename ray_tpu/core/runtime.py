@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import logging
 import os
 import threading
 import time
@@ -38,6 +39,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import serialization
 from ray_tpu.core.config import config
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 from ray_tpu.core.errors import (
     ActorDiedError,
     ObjectLostError,
@@ -220,6 +224,11 @@ class CoreWorker:
             if store is None:
                 from ray_tpu._native.objstore import ShmStore
 
+                # One-time per-path init (may compile the native .so on
+                # first use). Serializing it is the point: two threads
+                # must not mmap/build the same store concurrently, and
+                # after the first call it's a dict hit.
+                # graftlint: disable=lock-held-blocking
                 store = ShmStore(path)
                 self._shm_stores[path] = store
             return store
@@ -350,7 +359,9 @@ class CoreWorker:
             try:
                 store.seal(cache_oid.binary(), pin=False)
                 store.delete(cache_oid.binary())
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception
+                # Best-effort shm cleanup while the pull failure is
+                # already propagating — must not mask it.
                 pass
             raise
         store.seal(cache_oid.binary(), pin=False)
@@ -571,7 +582,11 @@ class CoreWorker:
                                          src_key, payload["node_id"],
                                          slot_token)
                         except Exception:
-                            pass
+                            # Owner unreachable: it will reap the pull
+                            # slot by timeout instead.
+                            log_every("runtime.pull_notify", 10.0, logger,
+                                      "pull_failed notify to owner "
+                                      "failed", exc_info=True)
                         src_fails += 1
                         if src_fails <= 3:
                             # A broadcast tree has alternative sources:
@@ -597,7 +612,9 @@ class CoreWorker:
                         owner.notify("pull_done", ref.id.binary(), src_key,
                                      new_loc, slot_token)
                     except Exception:
-                        pass
+                        log_every("runtime.pull_notify", 10.0, logger,
+                                  "pull_done notify to owner failed",
+                                  exc_info=True)
                 self.store.put_shm_ref(ref.id, new_loc or payload)
                 return frame
             raise ObjectLostError(f"unknown get_object reply kind {kind!r}")
@@ -816,7 +833,10 @@ class CoreWorker:
                                                config.ref_free_grace_s * 30))
                 self._flush_task_events()
             except Exception:
-                pass
+                # A sweeper that dies silently means owned objects are
+                # never freed — keep the loop alive but leave a trail.
+                log_every("runtime.sweep", 30.0, logger,
+                          "ref sweeper pass failed", exc_info=True)
 
     def record_task_event(self, event: Dict[str, Any]) -> None:
         with self._task_events_lock:
@@ -831,7 +851,9 @@ class CoreWorker:
             try:
                 self.controller.notify("push_task_events", events)
             except Exception:
-                pass
+                log_every("runtime.task_events", 30.0, logger,
+                          "task-event flush (%d events) failed",
+                          len(events), exc_info=True)
 
     def free_object(self, oid: ObjectID) -> None:
         """Full owner-side free: in-process entry, primary shm copy (pin +
@@ -845,7 +867,11 @@ class CoreWorker:
                 self.clients.get(tuple(locator["node_addr"])).notify(
                     "free_shm_object", locator["oid"])
             except Exception:
-                pass
+                # Usually the node is simply gone (its store died with
+                # it); a live node failing frees would leak shm slots.
+                log_every("runtime.free_shm", 30.0, logger,
+                          "free of primary shm copy failed",
+                          exc_info=True)
         with self._bcast_cond:
             track = self._bcast.pop(oid.binary(), None)
         if track:
@@ -856,7 +882,9 @@ class CoreWorker:
                     self.clients.get(tuple(loc["node_addr"])).notify(
                         "free_shm_object", loc["oid"])
                 except Exception:
-                    pass
+                    log_every("runtime.free_shm", 30.0, logger,
+                              "free of replica shm copy failed",
+                              exc_info=True)
         with self._lineage_lock:
             self._lineage.pop(oid, None)
 
@@ -1762,7 +1790,9 @@ class ObjectRefGenerator:
         if core is not None:
             try:
                 core.drop_stream(self._task_id)
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception
+                # __del__ during interpreter teardown: anything (even
+                # logging) may already be torn down. Stay silent.
                 pass
 
 

@@ -419,19 +419,19 @@ class CompiledDAG:
         for stage in self._stages:
             try:
                 close_refs.append(stage.close_channels.remote())
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort channel close at teardown; kill below is the backstop)
                 pass
         # Await the closes (bounded): a kill landing first would skip the
         # reader-side unlink and leak slot files on the stages' hosts.
         try:
             ray_tpu.wait(close_refs, num_returns=len(close_refs),
                          timeout=10.0)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (bounded wait at teardown; kill below is the backstop)
             pass
         for stage in self._stages:
             try:
                 ray_tpu.kill(stage)
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort stage kill at teardown)
                 pass
         import os as _os
 

@@ -15,6 +15,7 @@ controller's task-event buffer and actor table.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -68,11 +69,16 @@ class _HistoryRing:
         del ring[:-self._capacity]
 
     def _loop(self) -> None:
+        from ray_tpu.util.ratelimit import log_every
+
         while not self._stopped.wait(self._period):
             try:
                 self.sample_once()
             except Exception:
-                pass
+                log_every("dashboard.sample", 60.0,
+                          logging.getLogger(__name__),
+                          "dashboard history sample failed",
+                          exc_info=True)
 
     def sample_once(self) -> None:
         now = time.time()

@@ -783,7 +783,16 @@ class Dataset:
                 try:
                     ray_tpu.kill(actor)
                 except Exception:
-                    pass
+                    import logging
+
+                    from ray_tpu.util.ratelimit import log_every
+
+                    # A map actor that survives this kill keeps its
+                    # resources leased until the cluster reaps it.
+                    log_every("dataset.actor_kill", 10.0,
+                              logging.getLogger(__name__),
+                              "kill of dataset map actor failed",
+                              exc_info=True)
 
     # -------------------------------------------------------- consumption
 

@@ -27,9 +27,14 @@ postmortems — the reference keeps the same per-instance history.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 REQUESTED = "REQUESTED"
 ALLOCATED = "ALLOCATED"
@@ -95,7 +100,11 @@ class InstanceManager:
             try:
                 self._provider.terminate_node(provider_id)
             except Exception:
-                pass
+                # A failed terminate is a VM that keeps BILLING — the
+                # reconcile loop retries, but leave the trail.
+                log_every("instance.terminate", 30.0, logger,
+                          "terminate of foreign instance %s failed",
+                          provider_id, exc_info=True)
 
     # -------------------------------------------------------- reconcile
 
@@ -211,7 +220,9 @@ class InstanceManager:
             try:
                 self._provider.terminate_node(inst.provider_id)
             except Exception:
-                pass
+                log_every("instance.terminate", 30.0, logger,
+                          "terminate of instance %s failed",
+                          inst.provider_id, exc_info=True)
         inst.state = TERMINATED
         self._event(inst, f"terminated: {why}")
 

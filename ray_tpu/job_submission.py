@@ -75,7 +75,16 @@ class JobSupervisor:
                 "kv_put", f"__job_logs__/{self.job_id}",
                 "".join(self._log_chunks).encode())
         except Exception:
-            pass
+            import logging
+
+            from ray_tpu.util.ratelimit import log_every
+
+            # The job still ran — but its terminal status/logs are now
+            # invisible to `job status` callers. Never silent.
+            log_every(f"job.publish.{self.job_id}", 10.0,
+                      logging.getLogger(__name__),
+                      "publishing state of job %s failed", self.job_id,
+                      exc_info=True)
 
     def status(self) -> Dict[str, Any]:
         return {"job_id": self.job_id, "status": self._status,

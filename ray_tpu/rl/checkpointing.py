@@ -131,7 +131,7 @@ class Checkpointable:
             ray_tpu.get([
                 r.set_connectors.remote(per_runner[i % len(per_runner)])
                 for i, r in enumerate(runners)], timeout=30)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (connector-state push is best-effort; next sync rebuilds it)
             pass
 
 

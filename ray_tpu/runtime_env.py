@@ -177,10 +177,16 @@ def ensure_pip_env(pip: List[str]) -> str:
             return python
         build = f"{dest}.build-{os.getpid()}"
         try:
+            # Building the venv under _pip_lock is the point of the
+            # lock: concurrent builds of the same env would thrash pip's
+            # cache and race the final rename; waiters get the marker
+            # fast-path the moment the first build lands.
+            # graftlint: disable=lock-held-blocking
             subprocess.run(
                 [sys.executable, "-m", "venv", "--system-site-packages",
                  build],
                 check=True, capture_output=True, text=True, timeout=300)
+            # graftlint: disable=lock-held-blocking
             proc = subprocess.run(
                 [os.path.join(build, "bin", "python"), "-m", "pip",
                  "install", "--no-input", *pip],
@@ -202,6 +208,8 @@ def ensure_pip_env(pip: List[str]) -> str:
         finally:
             import shutil
 
+            # graftlint: disable=lock-held-blocking  (cleanup of the
+            # build dir belongs to the same critical section)
             shutil.rmtree(build, ignore_errors=True)
     return python
 

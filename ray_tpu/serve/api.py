@@ -85,7 +85,7 @@ def shutdown(drain_timeout_s: float = 10.0) -> None:
     try:
         ray_tpu.get(controller.shutdown.remote(drain_timeout_s),
                     timeout=drain_timeout_s + 60.0)
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception (best-effort serve teardown)
         pass
     finally:
         # Kill even when the graceful path timed out: a surviving named
@@ -94,7 +94,7 @@ def shutdown(drain_timeout_s: float = 10.0) -> None:
         if controller is not None:
             try:
                 ray_tpu.kill(controller)
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort serve teardown)
                 pass
     _Router.reset_all()
 

@@ -13,12 +13,16 @@ up a ``DeploymentHandle`` by name.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_ray_tpu_serve_controller"
 SNAPSHOT_CHANNEL = "serve_routes"
@@ -117,7 +121,14 @@ class ServeController:
         if drain_old:
             self._drain(old)
         with rec.lock:
-            return self._settle(rec)
+            doomed = self._settle(rec)
+        # Kill downscaled replicas OUTSIDE rec.lock: ray_tpu.kill is a
+        # controller RPC, and holding the record lock across it would
+        # stall every reconcile tick on this deployment behind a dead
+        # node's timeout (graftlint: lock-held-blocking).
+        for replica in doomed:
+            self._kill_replica(replica)
+        return self._publish(rec)
 
     def _target_replicas(self, rec: DeploymentRecord) -> int:
         auto = rec.cfg.get("autoscaling")
@@ -127,13 +138,17 @@ class ServeController:
                            auto["min_replicas"]))
         return rec.cfg.get("num_replicas", 1)
 
-    def _settle(self, rec: DeploymentRecord) -> Optional[int]:
+    def _settle(self, rec: DeploymentRecord) -> List[ReplicaRecord]:
+        """Converge the replica count toward target under rec.lock.
+        Returns the replicas a downscale removed — the caller kills them
+        after releasing the lock."""
         target = self._target_replicas(rec)
+        doomed: List[ReplicaRecord] = []
         while len(rec.replicas) < target:
             self._add_replica(rec)
         while len(rec.replicas) > target:
-            self._remove_replica(rec)
-        return self._publish(rec)
+            doomed.append(self._remove_replica(rec))
+        return doomed
 
     def _add_replica(self, rec: DeploymentRecord) -> None:
         from ray_tpu.serve.replica import ReplicaActor
@@ -149,16 +164,25 @@ class ServeController:
         rec.replicas.append(ReplicaRecord(handle, replica_id))
 
     def _remove_replica(self, rec: DeploymentRecord,
-                        index: int = -1) -> None:
-        replica = rec.replicas.pop(index)
+                        index: int = -1) -> ReplicaRecord:
+        """Pop a replica record. Killing the actor is the caller's job —
+        via _kill_replica, outside any held lock."""
+        return rec.replicas.pop(index)
+
+    def _kill_replica(self, replica: ReplicaRecord) -> None:
         try:
             ray_tpu.kill(replica.handle)
         except Exception:
-            pass
+            # Expected when healing replicas the cluster already declared
+            # DEAD or when the head is briefly unreachable; rate-limited
+            # so a systematic kill failure still surfaces.
+            log_every("serve.kill_replica", 10.0, logger,
+                      "kill of replica %s failed", replica.replica_id,
+                      exc_info=True)
 
     def _drain(self, rec: DeploymentRecord) -> None:
         while rec.replicas:
-            self._remove_replica(rec)
+            self._kill_replica(self._remove_replica(rec))
 
     def _publish(self, rec: DeploymentRecord) -> Optional[int]:
         """Push the routing snapshot (replica actor ids + model residency)
@@ -303,11 +327,15 @@ class ServeController:
                 ray_tpu.get(ref, timeout=max(0.1,
                                              deadline - time.monotonic()))
             except Exception:
-                pass
+                log_every("serve.proxy_drain", 10.0, logger,
+                          "proxy %s drain did not complete",
+                          proxy.node_hex, exc_info=True)
             try:
                 ray_tpu.kill(proxy.handle)
             except Exception:
-                pass
+                log_every("serve.proxy_kill", 10.0, logger,
+                          "kill of proxy %s failed", proxy.node_hex,
+                          exc_info=True)
 
     def http_addresses(self) -> Dict[str, tuple]:
         """node hex -> (host, port) of its live proxy."""
@@ -351,7 +379,10 @@ class ServeController:
                 try:
                     ray_tpu.kill(proxy.handle)
                 except Exception:
-                    pass
+                    # Departed node: the actor is usually already gone.
+                    log_every("serve.proxy_kill", 10.0, logger,
+                              "kill of proxy %s failed", node_hex,
+                              exc_info=True)
         # Health-check live ones (the actor call doubles as the probe).
         for node_hex, proxy in current.items():
             if node_hex not in alive:
@@ -390,7 +421,9 @@ class ServeController:
                         try:
                             ray_tpu.kill(proxy.handle)
                         except Exception:
-                            pass
+                            log_every("serve.proxy_kill", 10.0, logger,
+                                      "kill of hung proxy %s failed",
+                                      node_hex, exc_info=True)
                     continue
                 # No record, or DEAD: safe to forget and let the
                 # missing-node pass below start a replacement.
@@ -404,7 +437,11 @@ class ServeController:
             try:
                 self._start_proxy(node_hex, cfg)
             except Exception:
-                pass
+                # A node with no proxy has no ingress — this must never
+                # fail invisibly (retried next round either way).
+                log_every("serve.proxy_start", 5.0, logger,
+                          "starting proxy on node %s failed", node_hex,
+                          exc_info=True)
 
     def _start_proxy(self, node_hex: str, cfg: Dict[str, Any]) -> None:
         from ray_tpu.core.placement import NodeAffinitySchedulingStrategy
@@ -425,7 +462,9 @@ class ServeController:
             try:
                 ray_tpu.kill(handle)
             except Exception:
-                pass
+                log_every("serve.proxy_kill", 10.0, logger,
+                          "kill of raced proxy on %s failed", node_hex,
+                          exc_info=True)
             return
         try:
             proxy.addr = tuple(ray_tpu.get(
@@ -443,7 +482,12 @@ class ServeController:
                 try:
                     self._reconcile_one(rec)
                 except Exception:
-                    pass
+                    # The loop must survive one bad record, but a
+                    # reconcile that fails every tick is an outage
+                    # (replicas not healing) — say so, rate-limited.
+                    log_every(f"serve.reconcile.{rec.name}", 5.0, logger,
+                              "reconcile of deployment %r failed",
+                              rec.name, exc_info=True)
 
     def _proxy_loop(self) -> None:
         # Membership changes are rare; 1 Hz keeps probe load low.
@@ -451,7 +495,8 @@ class ServeController:
             try:
                 self._reconcile_proxies()
             except Exception:
-                pass
+                log_every("serve.proxy_reconcile", 5.0, logger,
+                          "proxy reconcile pass failed", exc_info=True)
 
     def _stale(self, rec: DeploymentRecord) -> bool:
         with self._lock:
@@ -490,6 +535,7 @@ class ServeController:
                 dead.append(replica)
         if self._stale(rec):
             return
+        to_kill: List[ReplicaRecord] = []
         with rec.lock:
             if self._stale(rec):
                 return
@@ -498,21 +544,24 @@ class ServeController:
                     rec.replicas.remove(replica)
                 except ValueError:
                     continue
-                try:
-                    ray_tpu.kill(replica.handle)  # idempotent cleanup
-                except Exception:
-                    pass
+                to_kill.append(replica)
                 changed = True
             while (len(rec.replicas) < self._min_replicas(rec)
                    and not self._stale(rec)):
                 self._add_replica(rec)
                 changed = True
+        # Idempotent cleanup kills happen after rec.lock is released —
+        # an RPC under the record lock would stall deploy/settle on this
+        # deployment (graftlint: lock-held-blocking).
+        for replica in to_kill:
+            self._kill_replica(replica)
         if self._stale(rec):
             self._drain(rec)  # raced a delete after adding: clean up
             return
 
         auto = rec.cfg.get("autoscaling")
         if auto:
+            downscaled: Optional[ReplicaRecord] = None
             with rec.lock:
                 # Replica load = max(HTTP concurrency, replica-reported
                 # backlog): a decode engine with a full pending queue and
@@ -537,9 +586,11 @@ class ServeController:
                 elif (desired < len(rec.replicas)
                         and now - rec.last_scale >
                         auto["downscale_delay_s"]):
-                    self._remove_replica(rec)
+                    downscaled = self._remove_replica(rec)
                     rec.last_scale = now
                     changed = True
+            if downscaled is not None:
+                self._kill_replica(downscaled)
         # Model residency changes also need a push (multiplex routing).
         if changed or self._models_changed(rec):
             self._publish(rec)

@@ -18,6 +18,7 @@ requested ``multiplexed_model_id`` loaded (``serve/multiplex.py``).
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
@@ -31,6 +32,9 @@ from ray_tpu.core.errors import (ActorDiedError, ActorUnavailableError,
                                  DeadlineExceededError, GetTimeoutError)
 from ray_tpu.core.ids import ActorID
 from ray_tpu.serve.controller import SNAPSHOT_CHANNEL
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -435,7 +439,12 @@ class _Router:
                     try:             # slot + cancel the engine request
                         handle.cancel_stream.remote(sid)
                     except Exception:
-                        pass
+                        # Cancel undeliverable: the replica frees the
+                        # slot at its deadline instead — slower, and a
+                        # systematic failure here is a capacity leak.
+                        log_every("router.cancel_stream", 10.0, logger,
+                                  "stream cancel to replica failed",
+                                  exc_info=True)
                 self._release(replica)
 
     def stop(self) -> None:

@@ -8,10 +8,13 @@ residency reported to the controller for model-aware routing).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 _current_model_id = threading.local()
 _current_deadline = threading.local()
@@ -203,7 +206,13 @@ class ReplicaActor:
             try:
                 entry[0].close()
             except Exception:
-                pass
+                from ray_tpu.util.ratelimit import log_every
+
+                # close() runs the generator's cleanup (engine cancel,
+                # slot free) — a failure here can strand engine state.
+                log_every("replica.stream_close", 10.0, logger,
+                          "closing stream generator failed",
+                          exc_info=True)
             with self._lock:
                 self._ongoing -= 1
 

@@ -231,7 +231,7 @@ class WorkerGroup:
         # teardown is bounded at ~20s total even with N unreachable workers.
         try:
             ray_tpu.wait(refs, num_returns=len(refs), timeout=20.0)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (best-effort distributed-jax leave at teardown)
             pass
 
     def run(self, train_fn: Callable, config: Optional[Dict],
@@ -245,9 +245,9 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort worker teardown)
                 pass
         try:
             remove_placement_group(self.pg)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception (best-effort worker teardown)
             pass

@@ -499,18 +499,18 @@ class Tuner:
         if trial.group is not None:
             try:
                 trial.group.shutdown()
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort trial teardown; cluster reaps the actor)
                 pass
             trial.group = None
         elif trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort trial teardown; cluster reaps the actor)
                 pass
         if trial.pg is not None:
             try:
                 remove_placement_group(trial.pg)
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception (best-effort trial teardown; cluster reaps the actor)
                 pass
             trial.pg = None
         trial.actor = None

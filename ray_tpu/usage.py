@@ -45,7 +45,7 @@ def collect() -> Dict[str, Any]:
         report["nodes"] = len([n for n in nodes if n["alive"]])
         report["cluster_resources"] = core.controller.call(
             "cluster_resources")
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception (local-only telemetry probe; absence of a source is normal)
         pass
     return report
 

@@ -13,6 +13,7 @@ Prometheus-text endpoint (``metrics_text``).
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -87,7 +88,14 @@ class _Registry:
                      "pid": __import__("os").getpid()},
                     self.snapshot())
             except Exception:
-                pass
+                from ray_tpu.util.ratelimit import log_every
+
+                # Metrics are droppable, but a push that fails every
+                # 5 s tick means the head is unreachable — worth a line.
+                log_every("metrics.push", 60.0,
+                          logging.getLogger(__name__),
+                          "metrics push to controller failed",
+                          exc_info=True)
 
 
 class _Metric:

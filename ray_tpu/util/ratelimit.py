@@ -1,0 +1,55 @@
+"""Rate-limited logging for hot paths and reconcile loops.
+
+The PR 2 ``DecodeEngine._emit`` pattern, factored out: a failure that
+can repeat thousands of times per second (every heartbeat, every
+reconcile tick, every streamed token) must be *diagnosable* without
+drowning the log. ``log_every`` emits at most one record per key per
+period and counts what it suppressed, so the first line after a quiet
+stretch says how many identical failures it stands for.
+
+Used by the swallowed-exception fixes graftlint drove (see
+docs/ANALYSIS.md): ``except Exception: pass`` on a request/daemon path
+becomes ``except Exception: log_every(...)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Tuple
+
+_lock = threading.Lock()
+# key -> (last emit monotonic, suppressed count since)
+_state: Dict[str, Tuple[float, int]] = {}
+
+
+def log_every(key: str, period_s: float, logger: logging.Logger,
+              msg: str, *args, level: int = logging.WARNING,
+              exc_info: bool = False) -> bool:
+    """Log ``msg % args`` at most once per ``period_s`` per ``key``.
+
+    Returns True when the record was emitted. Suppressed repeats are
+    counted and reported in the next emitted record's suffix.
+    """
+    now = time.monotonic()
+    with _lock:
+        last, suppressed = _state.get(key, (0.0, 0))
+        if now - last < period_s:
+            _state[key] = (last, suppressed + 1)
+            return False
+        _state[key] = (now, 0)
+    suffix = f" ({suppressed} similar suppressed)" if suppressed else ""
+    try:
+        logger.log(level, msg + suffix, *args, exc_info=exc_info)
+    except Exception:
+        # Logging must never take down the caller (interpreter teardown
+        # closes handlers mid-write).
+        return False
+    return True
+
+
+def reset() -> None:
+    """Test hook: forget all rate-limit state."""
+    with _lock:
+        _state.clear()
