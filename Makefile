@@ -1,13 +1,23 @@
 # Developer entry points. Tier-1 CI runs `make lint` semantics via
 # tests/test_analysis.py::test_repo_is_clean_under_strict.
 
-.PHONY: lint lint-stats test
+.PHONY: lint lint-diff lint-stats test
 
 lint:
 	python -m ray_tpu.analysis --strict
 
+# Pre-push fast path: findings only in files changed vs origin/main
+# (override with DIFF_REF=<ref>); whole-program indexes still span the
+# package, so cross-file findings in your files are not missed.
+DIFF_REF ?= origin/main
+lint-diff:
+	python -m ray_tpu.analysis --strict --diff $(DIFF_REF)
+
+# Full strict run + per-rule timing/finding-count artifact
+# (analysis/stats.json is the trajectory input for BENCH_NOTES.md).
 lint-stats:
-	python -m ray_tpu.analysis --strict --stats
+	python -m ray_tpu.analysis --strict --stats \
+		--stats-json ray_tpu/analysis/stats.json
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

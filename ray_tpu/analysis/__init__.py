@@ -1,7 +1,7 @@
 """graftlint: AST-based concurrency & trace-safety analysis for ray_tpu.
 
-Four checkers fitted to this codebase's real failure modes (each rule is
-documented in docs/ANALYSIS.md):
+Seven checker families fitted to this codebase's real failure modes
+(each rule is documented in docs/ANALYSIS.md):
 
 =====================  ==================================================
 rule                   catches
@@ -14,15 +14,23 @@ trace-retrace-hazard   traced values in shape positions, set iteration
 lock-order-cycle       lock-acquisition ordering cycles / self-deadlocks
 lock-held-blocking     RPC sends, connects, sleeps under a held lock
 swallowed-exception    ``except Exception: pass`` (the PR 3 bug class)
-missing-finally-release  acquire/release in one function w/o finally
+missing-finally-release  lock acquire/release in one function w/o finally
+unguarded-field-access guarded-by inference: a field locked at a majority
+                       of sites, accessed lock-free from 2+-thread code
+resource-leak-path     a path (incl. exception edges) exiting a scope
+                       with a socket/registration/slot/pin still live
+rpc-unknown-method     .call("x")/.notify("x") with no registered handler
+rpc-arity-mismatch     call arg shape no registration of the name accepts
+rpc-dead-endpoint      handler registered but never called in-package
 =====================  ==================================================
 
-Run it: ``python -m ray_tpu.analysis [--strict] [--format json]``, or
-``make lint``. Suppress a deliberate site with
-``# graftlint: disable=<rule>`` (same line or the line above); defer a
-triaged finding via ``analysis/baseline.json``
-(``--write-baseline``, then fill in the ``reason``). The tier-1 gate
-(tests/test_analysis.py) fails on any unbaselined finding.
+Run it: ``python -m ray_tpu.analysis [--strict] [--format json]
+[--jobs N] [--diff REF]``, or ``make lint`` / ``make lint-diff``.
+Suppress a deliberate site with ``# graftlint: disable=<rule>`` (same
+line or the line above); defer a triaged finding via
+``analysis/baseline.json`` (``--write-baseline``, then fill in the
+``reason``). The tier-1 gate (tests/test_analysis.py) fails on any
+unbaselined finding.
 
 Pure stdlib ``ast`` — no jax import, no third-party deps; a full-repo
 run takes a few seconds (budgeted < 10 s, see BENCH_NOTES.md).
@@ -44,6 +52,10 @@ __all__ = ["run_analysis", "Finding", "Baseline", "Project",
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "baseline.json")
 
+# Set in the parent before forking --jobs workers; children inherit the
+# parsed project/graph via copy-on-write and ship only findings back.
+_FORK_CTX: Dict[str, object] = {}
+
 
 def repo_root() -> str:
     """The directory containing the ``ray_tpu`` package."""
@@ -51,9 +63,39 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def _family_checks():
+    """family name -> (needs_graph, check callable). Every check takes
+    (project_or_graph, emit_files=None): whole-program indexes are
+    always built, but per-file emission work is skipped for files
+    outside ``emit_files`` (the --diff fast path)."""
+    from ray_tpu.analysis import (guarded_by, lifecycle_hygiene, lifetime,
+                                  lock_discipline, reactor_safety,
+                                  rpc_contract, trace_safety)
+
+    return {
+        "reactor-safety": (True, reactor_safety.check),
+        "trace-safety": (True, trace_safety.check),
+        "lock-discipline": (True, lock_discipline.check),
+        "lifecycle-hygiene": (False, lifecycle_hygiene.check_project),
+        "guarded-by": (True, guarded_by.check),
+        "lifetime": (True, lifetime.check),
+        "rpc-contract": (True, rpc_contract.check),
+    }
+
+
+def _run_family(name: str) -> Tuple[str, List[Finding], float]:
+    needs_graph, fn = _family_checks()[name]
+    t = time.perf_counter()
+    arg = _FORK_CTX["graph"] if needs_graph else _FORK_CTX["project"]
+    out = fn(arg, emit_files=_FORK_CTX.get("emit_files"))
+    return name, out, time.perf_counter() - t
+
+
 def run_analysis(root: Optional[str] = None,
                  select: Optional[Iterable[str]] = None,
                  paths: Optional[Iterable[str]] = None,
+                 jobs: int = 1,
+                 emit_files: Optional[Iterable[str]] = None,
                  ) -> Tuple[List[Finding], Dict[str, float]]:
     """Run every (selected) checker over the package.
 
@@ -61,10 +103,14 @@ def run_analysis(root: Optional[str] = None,
     fingerprints, but are NOT baseline-filtered — callers split against
     a Baseline themselves. ``paths`` restricts reported findings to
     files whose relpath starts with one of the given prefixes (the whole
-    package is still parsed: the call graph needs it).
+    package is still parsed: the call graph needs it). ``jobs`` > 1
+    forks that many workers and runs checker families in parallel
+    (fork shares the parsed ASTs copy-on-write; falls back to serial
+    where fork is unavailable). ``emit_files`` (exact relpaths — the
+    --diff fast path) additionally skips per-file emission WORK inside
+    the checkers; whole-program indexes still cover the package, so
+    cross-file findings in the listed files stay sound.
     """
-    from ray_tpu.analysis import (lifecycle_hygiene, lock_discipline,
-                                  reactor_safety, trace_safety)
     from ray_tpu.analysis.callgraph import CallGraph
 
     t0 = time.perf_counter()
@@ -73,36 +119,43 @@ def run_analysis(root: Optional[str] = None,
     t_parse = time.perf_counter() - t0
 
     selected = set(select) if select else set(rules.ALL_RULES)
+    families = [name for name, fam_rules in rules.FAMILIES.items()
+                if selected & set(fam_rules)]
     findings: List[Finding] = []
     per_rule: Dict[str, float] = {}
 
-    def timed(label: str, fn, *args) -> List[Finding]:
-        t = time.perf_counter()
-        out = fn(*args)
-        per_rule[label] = time.perf_counter() - t
-        return out
-
     graph = None
-    need_graph = selected & {rules.REACTOR_BLOCKING, rules.TRACE_HOST_SYNC,
-                             rules.TRACE_PY_BRANCH, rules.TRACE_RETRACE,
-                             rules.LOCK_ORDER_CYCLE,
-                             rules.LOCK_HELD_BLOCKING}
+    need_graph = any(_family_checks()[name][0] for name in families)
     if need_graph:
         t = time.perf_counter()
         graph = CallGraph(project)
+        graph.edges()  # precompute once; forked workers share it COW
         per_rule["callgraph"] = time.perf_counter() - t
-    if rules.REACTOR_BLOCKING in selected:
-        findings += timed("reactor-safety", reactor_safety.check, graph)
-    if selected & {rules.TRACE_HOST_SYNC, rules.TRACE_PY_BRANCH,
-                   rules.TRACE_RETRACE}:
-        findings += timed("trace-safety", trace_safety.check, graph)
-    if selected & {rules.LOCK_ORDER_CYCLE, rules.LOCK_HELD_BLOCKING}:
-        findings += timed("lock-discipline", lock_discipline.check, graph)
-    if selected & {rules.SWALLOWED_EXCEPTION, rules.MISSING_FINALLY}:
-        findings += timed("lifecycle-hygiene",
-                          lifecycle_hygiene.check_project, project)
+
+    _FORK_CTX["project"] = project
+    _FORK_CTX["graph"] = graph
+    _FORK_CTX["emit_files"] = set(emit_files) if emit_files else None
+    try:
+        if jobs > 1 and len(families) > 1 and hasattr(os, "fork"):
+            import multiprocessing
+
+            ctx = multiprocessing.get_context("fork")
+            with ctx.Pool(min(jobs, len(families))) as pool:
+                results = pool.map(_run_family, families)
+        else:
+            results = [_run_family(name) for name in families]
+    finally:
+        _FORK_CTX.clear()
+    for name, fam_findings, dt in results:
+        findings += fam_findings
+        per_rule[name] = dt
 
     findings = [f for f in findings if f.rule in selected]
+    # per-rule counts BEFORE pragma suppression (the --stats-json
+    # trajectory tracks total analyzer debt, suppressed or not)
+    raw_counts: Dict[str, int] = {}
+    for f in findings:
+        raw_counts[f.rule] = raw_counts.get(f.rule, 0) + 1
     if paths:
         prefixes = tuple(p.rstrip("/") for p in paths)
         findings = [f for f in findings
@@ -121,4 +174,11 @@ def run_analysis(root: Optional[str] = None,
              "parse_s": t_parse,
              "total_s": time.perf_counter() - t0}
     stats.update({f"{k}_s": v for k, v in per_rule.items()})
+    for rule, n in sorted(raw_counts.items()):
+        stats[f"raw_{rule}"] = float(n)
+    reported: Dict[str, int] = {}
+    for f in findings:
+        reported[f.rule] = reported.get(f.rule, 0) + 1
+    for rule, n in sorted(reported.items()):
+        stats[f"reported_{rule}"] = float(n)
     return findings, stats

@@ -41,6 +41,17 @@ def main(argv=None) -> int:
                         help="merge current findings into the baseline")
     parser.add_argument("--stats", action="store_true",
                         help="print per-checker timings")
+    parser.add_argument("--stats-json", default=None, metavar="PATH",
+                        help="write per-rule timing/finding-count JSON "
+                             "artifact to PATH")
+    parser.add_argument("--jobs", type=int, default=0, metavar="N",
+                        help="fork N workers to run checker families in "
+                             "parallel (0 = auto: one per family when "
+                             "the platform supports fork)")
+    parser.add_argument("--diff", default=None, metavar="REF",
+                        help="only report findings in package files "
+                             "changed vs this git ref (plus untracked "
+                             "files); implies --jobs auto")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -57,11 +68,44 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
-    findings, stats = run_analysis(root=args.root or repo_root(),
-                                   select=select, paths=args.paths)
+    root = args.root or repo_root()
+    paths = list(args.paths)
+    emit_files = None
+    if args.diff is not None:
+        changed = _changed_package_files(root, args.diff)
+        if changed is None:
+            print(f"--diff: git diff against {args.diff!r} failed",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print("graftlint: no package files changed "
+                  f"vs {args.diff}; nothing to check")
+            return 0
+        emit_files = changed
+
+    jobs = args.jobs
+    if jobs <= 0:
+        # auto: fork-parallel families when the box has the cores for
+        # it; a single-core box runs serial (fork would only add
+        # scheduler churn). --jobs 1 forces serial explicitly.
+        import os as _os
+        cores = _os.cpu_count() or 1
+        jobs = min(len(_rules.FAMILIES), cores) \
+            if hasattr(_os, "fork") else 1
+
+    findings, stats = run_analysis(root=root, select=select,
+                                   paths=paths or None, jobs=jobs,
+                                   emit_files=emit_files)
     baseline = Baseline() if args.no_baseline \
         else Baseline.load(args.baseline)
     new, baselined, stale = baseline.split(findings)
+    if args.diff is not None:
+        # a diff run sees only a slice of the repo: absent findings say
+        # nothing about baseline entries outside the slice
+        stale = []
+
+    if args.stats_json:
+        _write_stats_json(args.stats_json, stats, new, baselined)
 
     if args.write_baseline:
         baseline.write(args.baseline, findings,
@@ -101,6 +145,57 @@ def main(argv=None) -> int:
     if args.strict and (new or stale):
         return 1
     return 0
+
+
+def _changed_package_files(root, ref):
+    """Package .py files changed vs ``ref`` (plus untracked), or None on
+    git failure."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, cwd=root, timeout=30)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, cwd=root, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    names = diff.stdout.splitlines()
+    if untracked.returncode == 0:
+        names += untracked.stdout.splitlines()
+    return sorted({n for n in names
+                   if n.endswith(".py") and n.startswith("ray_tpu/")})
+
+
+def _write_stats_json(path, stats, new, baselined):
+    """Per-rule JSON artifact: timings, raw/reported finding counts,
+    and the number of pragma-suppressed sites per rule (raw - reported)
+    — the analyzer-debt trajectory tracked in BENCH_NOTES.md."""
+    from ray_tpu.analysis import rules as r
+
+    per_rule = {}
+    for rule in r.ALL_RULES:
+        raw = int(stats.get(f"raw_{rule}", 0.0))
+        reported = int(stats.get(f"reported_{rule}", 0.0))
+        per_rule[rule] = {
+            "raw": raw,
+            "pragma_suppressed": raw - reported,
+            "reported_unbaselined": sum(1 for f in new if f.rule == rule),
+            "baselined": sum(1 for f in baselined if f.rule == rule),
+        }
+    artifact = {
+        "files": int(stats.get("files", 0.0)),
+        "total_s": round(stats.get("total_s", 0.0), 3),
+        "timings_s": {k[:-2]: round(v, 4) for k, v in stats.items()
+                      if k.endswith("_s")},
+        "rules": per_rule,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
 
 
 if __name__ == "__main__":

@@ -76,6 +76,11 @@ def _extract_pragmas(text: str) -> Dict[int, Set[str]]:
     out: Dict[int, Set[str]] = {}
     standalone: List[Tuple[int, Set[str]]] = []
     code_rows: Set[int] = set()
+    # Fast path: tokenizing every file dominates parse time, and most
+    # files carry no pragma at all — a substring probe is enough to skip
+    # them (a false hit here just pays the tokenize).
+    if "graftlint" not in text:
+        return out
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
     except tokenize.TokenError:

@@ -186,9 +186,11 @@ def _check_releases(fn_node: ast.AST, relpath: str, symbol: str,
                     f"between leaks it"))
 
 
-def check_project(project: Project) -> List[Finding]:
+def check_project(project: Project, emit_files=None) -> List[Finding]:
     findings: List[Finding] = []
     for f in project.files:
+        if emit_files is not None and f.relpath not in emit_files:
+            continue  # purely per-file rules: skip entirely
         _check_swallowed(f.tree, f.relpath, findings)
         stack: List[ast.AST] = []
 

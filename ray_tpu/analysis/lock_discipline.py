@@ -54,7 +54,9 @@ class Acquisition:
 
 
 class LockIndex:
-    """All lock declarations in the project, with Condition aliasing."""
+    """All lock declarations in the project, with Condition aliasing.
+    Use :func:`lock_index` — the per-graph memo — instead of
+    constructing directly (two checker families need it)."""
 
     def __init__(self, graph: CallGraph):
         self.graph = graph
@@ -98,17 +100,26 @@ class LockIndex:
                         and arg.value.id == "self":
                     self._aliases[key] = (f.module, owner, arg.attr)
 
-        for node in ast.walk(f.tree):
-            if isinstance(node, ast.ClassDef):
-                for sub in ast.walk(node):
-                    if isinstance(sub, ast.Assign):
-                        for tgt in sub.targets:
-                            if isinstance(tgt, ast.Attribute) \
-                                    and isinstance(tgt.value, ast.Name) \
-                                    and tgt.value.id == "self":
-                                record(node.name, tgt.attr, sub.value)
-                            elif isinstance(tgt, ast.Name):
-                                record(node.name, tgt.id, sub.value)
+        # single pass, tracking the innermost enclosing class (the old
+        # walk-per-class rescanned nested bodies quadratically);
+        # module-level locks are recorded from the top level only, as
+        # before
+        def visit(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                    continue
+                if cls is not None and isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            record(cls, tgt.attr, child.value)
+                        elif isinstance(tgt, ast.Name):
+                            record(cls, tgt.id, child.value)
+                visit(child, cls)
+
+        visit(f.tree, None)
         for node in f.tree.body:
             if isinstance(node, ast.Assign):
                 for tgt in node.targets:
@@ -144,51 +155,41 @@ class LockIndex:
         return None, False
 
 
+def lock_index(graph: CallGraph) -> LockIndex:
+    """Per-graph LockIndex memo (lock-discipline and guarded-by both
+    need it; indexing the whole package twice showed up in profiles)."""
+    cached = getattr(graph, "_lock_index", None)
+    if cached is None:
+        cached = LockIndex(graph)
+        graph._lock_index = cached
+    return cached
+
+
 def _acquisitions(index: LockIndex, info: FunctionInfo
                   ) -> List[Acquisition]:
+    """Every bound lock acquisition in the function (nested ``with``
+    blocks included, nested defs excluded). Reads the graph's
+    withs-by-fqn side index — most functions have no ``with`` at all
+    and are skipped without touching their bodies."""
     out: List[Acquisition] = []
-
-    def visit(stmts: List[ast.stmt]) -> None:
-        for node in stmts:
-            if isinstance(node, ast.With):
-                for item in node.items:
-                    lock, via_self = index.bind(item.context_expr, info)
-                    if lock is not None:
-                        out.append(Acquisition(lock, node.lineno,
-                                               via_self, node.body))
-                visit(node.body)
-                continue
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                continue
-            for fname in ("body", "orelse", "finalbody"):
-                sub = getattr(node, fname, None)
-                if sub:
-                    visit(sub)
-            for h in getattr(node, "handlers", ()):
-                visit(h.body)
-
-    visit(info.node.body)
+    for node in index.graph.withs_by_fqn.get(info.fqn, ()):
+        for item in node.items:
+            lock, via_self = index.bind(item.context_expr, info)
+            if lock is not None:
+                out.append(Acquisition(lock, node.lineno, via_self,
+                                       node.body))
     return out
 
 
-def _locks_acquired_closure(graph: CallGraph, index: LockIndex
+def _locks_acquired_closure(graph: CallGraph, index: LockIndex,
+                            direct: Dict[str, List[Acquisition]]
                             ) -> Dict[str, Set[Tuple[LockId, bool]]]:
     """fqn -> set of (lock, self_chain) acquired in it or its resolved
     callees. self_chain is True only while every hop is a self.-call and
     the final acquisition is via self (same-instance evidence)."""
-    direct: Dict[str, List[Acquisition]] = {
-        fqn: _acquisitions(index, info)
-        for fqn, info in graph.functions.items()}
-    edges: Dict[str, List[Tuple[str, bool]]] = {}
-    for fqn, info in graph.functions.items():
-        outs = []
-        for node in _walk_no_nested(info.node):
-            if isinstance(node, ast.Call):
-                callee, via_self = graph.resolve_call(node, info)
-                if callee is not None and callee in graph.functions:
-                    outs.append((callee, via_self))
-        edges[fqn] = outs
+    edges: Dict[str, List[Tuple[str, bool]]] = {
+        fqn: [(callee, via_self) for callee, _line, via_self in rows]
+        for fqn, rows in graph.edges().items()}
 
     closure: Dict[str, Set[Tuple[LockId, bool]]] = {
         fqn: {(a.lock, a.via_self) for a in acqs}
@@ -235,11 +236,14 @@ def _direct_rpc_sites(graph: CallGraph, info: FunctionInfo
     return sites
 
 
-def check(graph: CallGraph) -> List[Finding]:
-    index = LockIndex(graph)
+def check(graph: CallGraph, emit_files=None) -> List[Finding]:
+    index = lock_index(graph)
     findings: List[Finding] = []
     chains = _blocking_chains(graph)
-    closure = _locks_acquired_closure(graph, index)
+    direct_acqs: Dict[str, List[Acquisition]] = {
+        fqn: _acquisitions(index, info)
+        for fqn, info in graph.functions.items()}
+    closure = _locks_acquired_closure(graph, index, direct_acqs)
 
     # fqn -> [(line, label)] for direct blocking sites (lock table: no
     # file I/O — serializing a file write is often the lock's purpose).
@@ -251,13 +255,19 @@ def check(graph: CallGraph) -> List[Finding]:
     self_edges: List[Tuple[LockId, str, int]] = []
 
     for fqn, info in graph.functions.items():
-        for acq in _acquisitions(index, info):
+        # ordering edges are whole-program (a cycle can span files); only
+        # the per-site blocking findings are sliceable
+        emit_here = emit_files is None \
+            or info.file.relpath in emit_files
+        for acq in direct_acqs[fqn]:
             held = acq.lock
             # -------- blocking under the lock (direct statements)
             for node in _iter_body(acq.body):
                 if not isinstance(node, ast.Call):
                     continue
                 label = _blocking_label(graph, info, node, lock_dotted)
+                if label is not None and not emit_here:
+                    continue
                 if label is not None:
                     findings.append(Finding(
                         rule=rules.LOCK_HELD_BLOCKING,
@@ -266,8 +276,9 @@ def check(graph: CallGraph) -> List[Finding]:
                         message=f"{label} while holding "
                                 f"{held.label()}"))
                     continue
-                callee, via_self = graph.resolve_call(node, info)
-                if callee is not None and callee in chains:
+                callee, via_self = graph.resolve_call_cached(node, info)
+                if callee is not None and callee in chains \
+                        and emit_here:
                     chain = " -> ".join(chains[callee])
                     findings.append(Finding(
                         rule=rules.LOCK_HELD_BLOCKING,
@@ -324,6 +335,8 @@ def check(graph: CallGraph) -> List[Finding]:
             message=f"re-acquisition of non-reentrant {held.label()} on "
                     f"the same instance via a self.-call chain "
                     f"(self-deadlock)"))
+    if emit_files is not None:
+        findings = [f for f in findings if f.path in emit_files]
     return findings
 
 

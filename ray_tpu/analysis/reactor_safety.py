@@ -13,11 +13,10 @@ opaque: handlers run on the pool, which is exactly the design.
 
 from __future__ import annotations
 
-import ast
 from typing import Dict, List
 
 from ray_tpu.analysis import rules
-from ray_tpu.analysis.callgraph import CallGraph, _short, _walk_no_nested
+from ray_tpu.analysis.callgraph import CallGraph, _short
 from ray_tpu.analysis.core import Finding
 
 
@@ -27,7 +26,7 @@ def _dotted_table() -> Dict[str, str]:
     return table
 
 
-def check(graph: CallGraph) -> List[Finding]:
+def check(graph: CallGraph, emit_files=None) -> List[Finding]:
     roots = []
     for fqn, info in graph.functions.items():
         tail = info.qualname.rsplit(".", 1)[-1]
@@ -38,15 +37,25 @@ def check(graph: CallGraph) -> List[Finding]:
 
     dotted_table = _dotted_table()
     findings: List[Finding] = []
+    blocking_map = graph.direct_blocking_map(
+        dotted_table, rules.BLOCKING_METHODS_ALWAYS,
+        rules.BLOCKING_METHODS_UNBOUNDED)
     # BFS the reactor-reachable set, remembering one path per function.
     paths: Dict[str, List[str]] = {fqn: [_short(fqn)] for fqn in roots}
     queue = list(roots)
     while queue:
         fqn = queue.pop(0)
         info = graph.functions[fqn]
-        for site_line, label in graph.direct_blocking_sites(
-                info, dotted_table, rules.BLOCKING_METHODS_ALWAYS,
-                rules.BLOCKING_METHODS_UNBOUNDED):
+        if emit_files is not None \
+                and info.file.relpath not in emit_files:
+            # still walk the closure (reachability is whole-program),
+            # just skip emission in out-of-slice files
+            for callee, _line, _vs in graph.edges().get(fqn, ()):
+                if callee not in paths:
+                    paths[callee] = paths[fqn] + [_short(callee)]
+                    queue.append(callee)
+            continue
+        for site_line, label in blocking_map.get(fqn, ()):
             via = " -> ".join(paths[fqn])
             findings.append(Finding(
                 rule=rules.REACTOR_BLOCKING,
@@ -54,11 +63,8 @@ def check(graph: CallGraph) -> List[Finding]:
                 symbol=info.qualname,
                 message=f"blocking call {label} on the reactor thread "
                         f"(reachable via {via})"))
-        for node in _walk_no_nested(info.node):
-            if isinstance(node, ast.Call):
-                callee, _ = graph.resolve_call(node, info)
-                if callee is not None and callee in graph.functions \
-                        and callee not in paths:
-                    paths[callee] = paths[fqn] + [_short(callee)]
-                    queue.append(callee)
+        for callee, _line, _vs in graph.edges().get(fqn, ()):
+            if callee not in paths:
+                paths[callee] = paths[fqn] + [_short(callee)]
+                queue.append(callee)
     return findings

@@ -17,13 +17,33 @@ LOCK_ORDER_CYCLE = "lock-order-cycle"
 LOCK_HELD_BLOCKING = "lock-held-blocking"
 SWALLOWED_EXCEPTION = "swallowed-exception"
 MISSING_FINALLY = "missing-finally-release"
+UNGUARDED_FIELD = "unguarded-field-access"
+RESOURCE_LEAK = "resource-leak-path"
+RPC_UNKNOWN = "rpc-unknown-method"
+RPC_ARITY = "rpc-arity-mismatch"
+RPC_DEAD = "rpc-dead-endpoint"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
     TRACE_HOST_SYNC, TRACE_PY_BRANCH, TRACE_RETRACE,
     LOCK_ORDER_CYCLE, LOCK_HELD_BLOCKING,
     SWALLOWED_EXCEPTION, MISSING_FINALLY,
+    UNGUARDED_FIELD,
+    RESOURCE_LEAK,
+    RPC_UNKNOWN, RPC_ARITY, RPC_DEAD,
 )
+
+# The seven checker families, for ``--jobs`` scheduling and per-family
+# stats: family name -> tuple of rule ids it emits.
+FAMILIES = {
+    "reactor-safety": (REACTOR_BLOCKING,),
+    "trace-safety": (TRACE_HOST_SYNC, TRACE_PY_BRANCH, TRACE_RETRACE),
+    "lock-discipline": (LOCK_ORDER_CYCLE, LOCK_HELD_BLOCKING),
+    "lifecycle-hygiene": (SWALLOWED_EXCEPTION, MISSING_FINALLY),
+    "guarded-by": (UNGUARDED_FIELD,),
+    "lifetime": (RESOURCE_LEAK,),
+    "rpc-contract": (RPC_UNKNOWN, RPC_ARITY, RPC_DEAD),
+}
 
 # ------------------------------------------------- blocking-API tables
 
@@ -130,13 +150,92 @@ SHAPE_POSITION_FUNCS = {"zeros", "ones", "full", "empty", "arange",
 # -------------------------------------------- lifecycle acquire/release
 
 # (acquire method name, release method name) — flagged when both appear
-# in one function with the release NOT in a ``finally`` block.
+# in one function with the release NOT in a ``finally`` block. Lock
+# discipline only; resource idioms (sockets, files, selector
+# registrations, slots, pins, refcounts) moved to the path-sensitive
+# ``resource-leak-path`` rule (lifetime.py).
 ACQUIRE_RELEASE_METHODS = (
     ("acquire", "release"),
-    ("register", "unregister"),
 )
-# Dotted acquire constructors -> release method on the result.
-ACQUIRE_RELEASE_DOTTED = (
-    ("socket.socket", "close"),
-    ("open", "close"),
-)
+# Dotted acquire constructors -> release method on the result (still
+# consulted by the v1 rule for same-function pairing; the v2 lifetime
+# rule uses the richer tables below).
+ACQUIRE_RELEASE_DOTTED = ()
+
+# ------------------------------------------ v2: guarded-by inference
+
+# Thread-construction call targets -> where the entry callable lives:
+# a keyword name, with a positional-index fallback.
+THREAD_CTORS = {
+    "threading.Thread": ("target", 1),
+    "threading.Timer": ("function", 1),
+}
+# ``X.submit(fn, ...)`` hands fn to a pool thread (and pools run it
+# concurrently with itself — self-concurrent, like RPC handlers).
+EXECUTOR_SUBMIT_METHODS = ("submit",)
+
+# Methods whose field accesses are construction/teardown-time, excluded
+# from guarded-by inference and from flagging.
+GUARDED_BY_EXCLUDED_METHODS = ("__init__", "__del__", "__repr__",
+                               "__reduce__")
+# Methods named ``*_locked`` are called with their lock already held
+# (repo convention, e.g. RpcServer._flush_locked): their accesses are
+# neither inference evidence nor flaggable.
+LOCKED_BY_CONVENTION_SUFFIX = "_locked"
+
+# A field is inferred guarded-by L when L is held at a strict majority
+# of its eligible access sites AND at at least this many sites.
+GUARDED_BY_MIN_LOCKED_SITES = 2
+
+# ---------------------------------------- v2: resource-lifetime pairing
+
+# Dotted constructors that acquire a releasable resource when assigned
+# to a local: ``sock = socket.socket()`` ... ``sock.close()``.
+RESOURCE_CTOR_DOTTED = {
+    "socket.socket": "close",
+    "socket.create_connection": "close",
+    "open": "close",
+}
+# Receiver-keyed acquire/release method pairs: ``sel.register(fd, ...)``
+# pairs with ``sel.unregister(fd)`` (possibly in a callee — release-
+# through-call is resolved over the call graph), ``cache.pin(h)`` with
+# ``cache.unpin(h)``.
+RESOURCE_METHOD_PAIRS = {
+    "register": "unregister",
+    "pin": "unpin",
+}
+# Slot-pool attributes: ``self._free.pop()`` leases a slot that
+# ``self._free.append(slot)`` returns (DecodeEngine slot discipline).
+RESOURCE_POOL_ATTRS = {
+    "_free": ("pop", "append"),
+}
+# Refcount attributes: ``ent.refcount += 1`` pins, ``-= 1`` unpins
+# (prefix-cache row pinning).
+RESOURCE_REFCOUNT_ATTRS = ("refcount",)
+
+# ------------------------------------------- v2: RPC contract checking
+
+# Handler maps are declared as RpcServer(handlers={...}) dict literals
+# (this keyword) or via server.register("name", fn).
+RPC_HANDLERS_KWARG = "handlers"
+RPC_INLINE_KWARG = "inline_methods"
+RPC_REGISTER_METHOD = "register"
+# Client-side kwargs consumed by the transport, never forwarded to the
+# handler.
+RPC_CLIENT_KWARGS = ("timeout",)
+# Wrapper methods that prepend implicit positional args before
+# forwarding to ``.call`` (ClientCore._call prepends the session id).
+# Scoped to the module defining the wrapper: an unrelated ``_call``
+# (tpu_vm_api's HTTP helper) must not be read as an RPC site.
+RPC_CALL_WRAPPERS = {
+    "_call": (1, "ray_tpu.client"),
+}
+# Endpoints reached only through dynamic dispatch the AST cannot see
+# (dashboard proxy forwards ?method=... query strings; CLI tools) or
+# from outside the package (tests, external health probes).
+# Registered-but-never-literally-called names listed here are not dead.
+RPC_DYNAMIC_ENDPOINTS: frozenset = frozenset({
+    # liveness probe on every server: exercised by tests, health
+    # monitors, and the dashboard's generic proxy
+    "ping",
+})

@@ -311,30 +311,33 @@ def _check_shape_position(graph: CallGraph, info: FunctionInfo,
             return
 
 
-def check(graph: CallGraph) -> List[Finding]:
+def check(graph: CallGraph, emit_files=None) -> List[Finding]:
     findings: List[Finding] = []
+
+    def in_slice(info: FunctionInfo) -> bool:
+        return emit_files is None or info.file.relpath in emit_files
+
     marked = _find_jit_functions(graph)
     for fqn, statics in marked.items():
-        _check_marked(graph, graph.functions[fqn], statics, findings)
+        if in_slice(graph.functions[fqn]):
+            _check_marked(graph, graph.functions[fqn], statics, findings)
     # transitively jit-reachable: unambiguous host syncs only
     reachable: Set[str] = set()
     queue = list(marked)
     seen: Set[str] = set(queue)
     while queue:
         fqn = queue.pop(0)
-        info = graph.functions[fqn]
-        for node in _walk_no_nested(info.node):
-            if isinstance(node, ast.Call):
-                callee, _ = graph.resolve_call(node, info)
-                if callee is not None and callee in graph.functions \
-                        and callee not in seen:
-                    seen.add(callee)
-                    reachable.add(callee)
-                    queue.append(callee)
+        for callee, _line, _vs in graph.edges().get(fqn, ()):
+            if callee not in seen:
+                seen.add(callee)
+                reachable.add(callee)
+                queue.append(callee)
     for fqn in reachable:
         if fqn in marked:
             continue
         info = graph.functions[fqn]
+        if not in_slice(info):
+            continue
         np_aliases = _numpy_aliases(graph, info)
         for node in _walk_no_nested(info.node):
             if isinstance(node, ast.Call):

@@ -313,11 +313,17 @@ class Node:
                 "kill_worker": self.kill_worker,
                 "reserve_bundle": self.reserve_bundle,
                 "release_bundle": self.release_bundle,
+                # whole-object read fallback for peers without chunked
+                # pull; kept for external/debug tooling
+                # graftlint: disable=rpc-dead-endpoint
                 "read_shm_object": self.read_shm_object,
                 "read_shm_chunk": self.read_shm_chunk,
                 "free_shm_object": self.free_shm_object,
                 "worker_death_cause": self.worker_death_cause,
                 "list_workers": self.list_workers,
+                # reference-parity PrestartWorkers hook, reserved for
+                # the autoscaler's warm-up path
+                # graftlint: disable=rpc-dead-endpoint
                 "prestart_workers": self.prestart_workers,
                 "get_info": self.get_info,
                 "ping": lambda: "pong",

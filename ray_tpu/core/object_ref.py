@@ -60,18 +60,65 @@ class _RefTracker:
             return cls._instance
 
     def inc(self, owner: Addr, oid: bytes) -> None:
+        apply_local = False
         with self._lock:
             key = (owner, oid)
             n = self._counts.get(key, 0) + 1
             self._counts[key] = n
             if n == 1:
-                d = self._dirty.setdefault(owner, {})
-                d[oid] = d.get(oid, 0) + 1
+                if self._is_local_owner(owner):
+                    # Owner-local +1 applies SYNCHRONOUSLY, not via the
+                    # batched flush: under full-suite load the flush
+                    # thread can be starved past ref_free_grace_s, and a
+                    # borrower's net-zero touch (+1/-1 in one window)
+                    # would then arm the owner's zero-clock while our own
+                    # +1 still sat in _dirty — the sweeper frees an
+                    # object the driver is about to get()
+                    # (ObjectFreedError under load). Matching decs stay
+                    # batched: they only ever run after this inc.
+                    apply_local = True
+                else:
+                    d = self._dirty.setdefault(owner, {})
+                    d[oid] = d.get(oid, 0) + 1
+        if apply_local:
+            self._apply_local(owner, {oid: 1})
 
     def dec(self, owner: Addr, oid: bytes) -> None:
-        """GC-safe: only enqueues; the flush thread does the bookkeeping."""
+        """GC-safe: only enqueues; the flush thread does the bookkeeping.
+        Decrements NEVER apply synchronously — a batched -1 only delays
+        a free, while a batched +1 can lose a race against the owner's
+        grace sweeper (see inc)."""
         self._pending_decs.append((owner, oid))
         self._wake.set()
+
+    @staticmethod
+    def _is_local_owner(owner: Addr) -> bool:
+        from ray_tpu.core import runtime
+
+        core = runtime._core_worker
+        return core is not None and tuple(owner) == tuple(core.addr)
+
+    def _apply_local(self, owner: Addr, deltas: Dict[bytes, int]) -> None:
+        """Apply owner-local deltas straight to the store; fall back to
+        the batched dirty map if the core vanished mid-flight (shutdown
+        between the locked check and this call)."""
+        from ray_tpu.core import runtime
+
+        core = runtime._core_worker
+        if core is not None and tuple(owner) == tuple(core.addr):
+            try:
+                core.apply_ref_updates(deltas)
+                return
+            # store mid-teardown (interpreter exit): falling through to
+            # the batched path below is the handling — the flush loop
+            # retries or abandons with the owner.
+            # graftlint: disable=swallowed-exception
+            except Exception:
+                pass
+        with self._lock:
+            d = self._dirty.setdefault(owner, {})
+            for oid, delta in deltas.items():
+                d[oid] = d.get(oid, 0) + delta
 
     def _drain_decs(self) -> None:
         while True:
@@ -120,13 +167,23 @@ class _RefTracker:
     def flush(self) -> None:
         from ray_tpu.core import runtime
 
+        from ray_tpu.core.rpc import RpcConnectError
+
         self._drain_decs()
         with self._lock:
             dirty, self._dirty = self._dirty, {}
         core = runtime._core_worker
         if core is None:
             return
-        for owner, deltas in dirty.items():
+        # Owner-local deltas apply FIRST: shipping to a remote owner can
+        # block ~1 s per dead peer in the dial-retry loop (stale owners
+        # from torn-down sessions accumulate under test/driver churn),
+        # and the local grace sweeper must never wait behind that — a
+        # starved local -1 holds an owned object beyond its lifetime, a
+        # starved local +1 was the ObjectFreedError flake.
+        owners = sorted(dirty, key=lambda o: o != core.addr)
+        for owner in owners:
+            deltas = dirty[owner]
             # Net-zero deltas still ship: a ref born and dropped inside one
             # flush window must mark the object as touched-then-released on
             # the owner, or it would never become sweepable.
@@ -137,6 +194,13 @@ class _RefTracker:
                     core.apply_ref_updates(deltas)
                 else:
                     core.clients.get(owner).notify("ref_update", deltas)
+                self._send_failures.pop(owner, None)
+            except RpcConnectError:
+                # The owner process cannot even be dialed: it is gone,
+                # and its objects died with it — abandon the deltas NOW
+                # instead of burning a ~1 s dial x 25 retries per dead
+                # session (which starved the flush thread and every
+                # queued dec behind it).
                 self._send_failures.pop(owner, None)
             except Exception:
                 # Transient failure: merge the deltas back for retry; a

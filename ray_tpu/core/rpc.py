@@ -237,6 +237,13 @@ class RpcError(Exception):
     """Transport-level failure (peer died, connection refused)."""
 
 
+class RpcConnectError(RpcError):
+    """The peer could not be dialed at all (connect retries exhausted).
+    Distinct from a mid-call transport failure so callers can tell "the
+    process at this address is gone" (its state died with it — safe to
+    abandon per-peer work) from "the connection hiccuped" (retry)."""
+
+
 class RemoteCallError(Exception):
     """The handler on the peer raised; carries the remote exception."""
 
@@ -693,18 +700,31 @@ class RpcClient:
                     call.complete(msg)
         except (ConnectionError, OSError):
             # Guard against a stale reader (pre-redial socket) failing the
-            # fresh connection's in-flight calls.
+            # fresh connection's in-flight calls. The unlocked _sock read
+            # is the point: an identity probe against whatever socket is
+            # current — a racing re-dial makes the comparison fail and
+            # this stale reader exit silently, which is the desired
+            # outcome.
+            # graftlint: disable=unguarded-field-access
             if sock is self._sock:
                 self._fail_all(RpcError(f"connection to {self.addr} lost"))
 
     def _fail_all(self, err: Exception) -> None:
-        self._closed = True
+        # _closed writes go through _lifecycle_lock (like close/_evict/
+        # _ensure_open): an unlocked write here could interleave with
+        # _ensure_open's re-dial sequence and publish a half-built
+        # open-but-closed state (graftlint: unguarded-field-access).
+        with self._lifecycle_lock:
+            self._closed = True
         with self._pending_lock:
             pending, self._pending = self._pending, {}
         for call in pending.values():
             call.fail(err)
 
     def _ensure_open(self) -> None:
+        # Double-checked fast path: a stale read here only costs one
+        # trip into the locked re-check below, never a wrong decision.
+        # graftlint: disable=unguarded-field-access
         if not self._closed:
             return
         with self._lifecycle_lock:
@@ -749,13 +769,21 @@ class RpcClient:
                 # threads can't interleave torn frames on the wire.
                 # Client sends are caller-thread blocking (module
                 # docstring); only the server reactor is non-blocking.
+                # The unlocked _sock read is part of the protocol too: a
+                # racing _evict closes it and the OSError arm below
+                # re-dials and resends.
                 with self._send_lock:
-                    # graftlint: disable=lock-held-blocking
+                    # graftlint: disable=lock-held-blocking, unguarded-field-access
                     send_frame(self._sock, payload)
                 break
             except OSError as e:
                 with self._pending_lock:
                     self._pending.pop(req_id, None)
+                # Racy read by design: _evict flips the flag BEFORE
+                # closing the socket, so a send that failed because of
+                # eviction always sees it set; a stale False just
+                # surfaces the send error to a caller that raced close().
+                # graftlint: disable=unguarded-field-access
                 if attempt == 0 and self._pool_evicted:
                     # Eviction closed the socket between our open-check
                     # and the send: re-dial and resend. Any partial frame
@@ -778,12 +806,15 @@ class RpcClient:
                                "args": args, "kwargs": kwargs})
         for attempt in (0, 1):
             try:
-                # Same frame-write serialization as call() above.
+                # Same frame-write serialization (and deliberate racy
+                # _sock read) as call() above.
                 with self._send_lock:
-                    # graftlint: disable=lock-held-blocking
+                    # graftlint: disable=lock-held-blocking, unguarded-field-access
                     send_frame(self._sock, payload)
                 return
             except OSError as e:
+                # Same deliberate racy read as call() above.
+                # graftlint: disable=unguarded-field-access
                 if attempt == 0 and self._pool_evicted:
                     self._ensure_open()  # send overlapped pool eviction
                     continue
@@ -854,15 +885,26 @@ def _connect(addr: Addr, timeout: Optional[float]) -> socket.socket:
     for _ in range(max(1, retries)):
         try:
             sock = socket.create_connection(addr, timeout=5.0)
-            sock.settimeout(None)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            return sock
         except OSError as e:
             last_err = e
             if deadline is not None and time.monotonic() > deadline:
                 break
             time.sleep(0.05)
-    raise RpcError(f"could not connect to {addr}: {last_err}")
+            continue
+        try:
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except OSError as e:
+            # Post-connect setup failing must not orphan the connected
+            # fd — one leaked socket per retry adds up under a flapping
+            # peer (graftlint: resource-leak-path).
+            sock.close()
+            last_err = e
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+    raise RpcConnectError(f"could not connect to {addr}: {last_err}")
 
 
 class ReconnectingClient:
@@ -925,6 +967,10 @@ class ReconnectingClient:
                 # this arm must precede the transport arm).
                 raise
             except (RpcError, ConnectionError, OSError):
+                # Unlocked read: the worst a stale value costs is one
+                # extra 0.2 s retry against a just-closed handle, and
+                # _get() re-checks _closed under _lock before dialing.
+                # graftlint: disable=unguarded-field-access
                 if self._closed or time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)

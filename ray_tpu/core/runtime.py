@@ -173,6 +173,9 @@ class CoreWorker:
                 "get_object": self._handle_get_object,
                 "wait_object": self._handle_wait_object,
                 "peek_object": self._handle_peek_object,
+                # remote-free entry point for external tooling (the
+                # owner frees its own objects via free_object directly)
+                # graftlint: disable=rpc-dead-endpoint
                 "free_object": self._handle_free_object,
                 "pull_done": self._handle_pull_done,
                 "pull_failed": self._handle_pull_failed,
@@ -183,6 +186,9 @@ class CoreWorker:
                 "stream_item": self._handle_stream_item,
                 "start_actor": self._handle_start_actor,
                 "push_actor_task": self._handle_push_actor_task,
+                # graceful-stop hook (nodes SIGTERM workers today);
+                # reserved for drain-before-kill
+                # graftlint: disable=rpc-dead-endpoint
                 "shutdown_worker": self._handle_shutdown,
                 "dump_stacks": _dump_stacks,
                 # On-demand profiling (reference: profile_manager.py:79

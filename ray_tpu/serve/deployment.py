@@ -183,7 +183,8 @@ class _Router:
             live = {r["id"] for r in self._replicas}
             self._inflight = {k: v for k, v in self._inflight.items()
                               if k in live}
-        if self._replicas or self._deleted:
+            ready = bool(self._replicas) or self._deleted
+        if ready:
             self._have_snapshot.set()
 
     def _watch_loop(self) -> None:
@@ -192,8 +193,12 @@ class _Router:
         while not self._stop.is_set():
             try:
                 core = get_core_worker()
+                # Single-writer field: _version is only assigned by
+                # _apply, and only THIS thread calls _apply — the
+                # unlocked read can never observe a torn/foreign write.
                 update = core.controller.call(
                     "psub_poll", SNAPSHOT_CHANNEL, self.name,
+                    # graftlint: disable=unguarded-field-access
                     self._version, 10.0, timeout=25.0)
             except Exception:
                 if self._stop.wait(0.5):
@@ -334,6 +339,10 @@ class _Router:
                         f"to {self.name!r}") from last_err
                 replica = self._pick(model_id, prefix_hashes)
                 if replica is None:
+                    # Advisory read: worst case a request that raced the
+                    # delete gets the "no replicas" message instead of
+                    # "was deleted" — both terminate it identically.
+                    # graftlint: disable=unguarded-field-access
                     if self._deleted:
                         raise RuntimeError(
                             f"deployment {self.name!r} was deleted")

@@ -368,22 +368,27 @@ def test_missing_finally_release_tp_tn():
     assert found[0].rule == rules.MISSING_FINALLY
 
 
-def test_selector_register_and_socket_close_pairs():
+def test_missing_finally_scoped_to_locks_only():
+    """Socket/file/registration pairing moved to the path-sensitive
+    resource-leak-path rule (tests/test_analysis_v2.py); the v1 rule
+    keeps lock acquire/release discipline only."""
     src = """
         import socket
 
-        def leaky_socket(addr):
+        def lock_leak(self):
+            self._lock.acquire()
+            work_that_can_raise()
+            more_work()
+            self._lock.release()
+
+        def socket_not_v1s_business(addr):
             sock = socket.socket()
             handshake(sock, addr)
             sock.close()
-
-        def with_ok(addr):
-            with socket.socket() as sock:
-                handshake(sock, addr)
     """
     found = run_checker(lifecycle_hygiene.check_project,
                         project_of(mod=src), needs_graph=False)
-    assert [f.symbol for f in found] == ["leaky_socket"]
+    assert [f.symbol for f in found] == ["lock_leak"]
 
 
 # ----------------------------------------------------- pragmas/baseline

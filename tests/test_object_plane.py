@@ -192,3 +192,62 @@ def test_broadcast_tree_forms_and_releases(ray_start_cluster):
             assert live == 0, track["slots"]
     finally:
         config.object_broadcast_fanout = old_fanout
+
+
+def test_owner_local_inc_not_raced_by_grace_sweeper(monkeypatch):
+    """Regression (PR 5): the driver's own +1 for an object it owns must
+    reach the store SYNCHRONOUSLY at ObjectRef-creation time. Pre-fix it
+    sat in the tracker's batched dirty map until the flush thread ran —
+    and under full-suite load (starved flush > ref_free_grace_s) a
+    borrower's net-zero touch (+1/-1 inside one flush window, shipped as
+    delta 0) armed the owner-side zero-clock first, so the sweeper freed
+    an object the driver still held a live handle to: the rare
+    ObjectFreedError flake in test_data.py. This reproduces the exact
+    interleaving with the flush thread deliberately never running."""
+    import collections
+    import threading
+
+    from ray_tpu.core import object_ref as orf
+    from ray_tpu.core import runtime as rt
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import MemoryStore
+
+    store = MemoryStore()
+
+    class FakeCore:
+        addr = ("127.0.0.1", 4242)
+
+        def apply_ref_updates(self, deltas):
+            for oid_bytes, delta in deltas.items():
+                store.apply_ref_update(ObjectID(oid_bytes), delta)
+
+    monkeypatch.setattr(rt, "_core_worker", FakeCore())
+
+    # A tracker whose flush thread never runs (the "starved under load"
+    # extreme): built via __new__ so no daemon thread starts.
+    tracker = orf._RefTracker.__new__(orf._RefTracker)
+    tracker._lock = threading.Lock()
+    tracker._counts = {}
+    tracker._dirty = {}
+    tracker._pending_decs = collections.deque()
+    tracker._send_failures = {}
+    tracker._wake = threading.Event()
+
+    oid = ObjectID.from_random()
+    store.create_pending(oid)
+    store.put_serialized(oid, b"payload")
+
+    # driver creates its handle (ObjectRef.__init__ -> tracker.inc)
+    tracker.inc(FakeCore.addr, oid.binary())
+    # a borrower's ref was born and died within one flush window: its
+    # tracker ships a net-zero delta, which deliberately re-arms the
+    # owner's zero-clock ("touched then released")
+    store.apply_ref_update(oid, 0)
+
+    time.sleep(0.05)
+    victims = store.sweep_dead_refs(grace_s=0.01)
+    assert victims == [], (
+        "sweeper freed an object the driver still holds a handle to "
+        f"(driver +1 never applied): {victims}")
+    # and the object is still fetchable
+    assert store.wait_ready(oid, timeout=1.0).data == b"payload"

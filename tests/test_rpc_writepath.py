@@ -375,3 +375,85 @@ def test_stop_with_wedged_reactor_keeps_selector_fds_open():
         srv._reactor_thread = real
         srv.stop()  # second stop reaps the selector and wake fds
         assert srv._wake_r.fileno() == -1 and srv._wake_w.fileno() == -1
+
+
+def test_connect_closes_socket_when_setup_fails(monkeypatch):
+    """Regression (PR 5, found by graftlint resource-leak-path): post-
+    connect setup (settimeout/setsockopt) raising inside _connect's
+    retry loop must close the just-connected socket — pre-fix each retry
+    orphaned one fd against a flapping peer."""
+    from ray_tpu.core import rpc as rpc_mod
+
+    made = []
+
+    class FakeSock:
+        def __init__(self):
+            self.closed = False
+
+        def settimeout(self, t):
+            raise OSError("setup blows up")
+
+        def setsockopt(self, *a):
+            pass
+
+        def close(self):
+            self.closed = True
+
+    def fake_create_connection(addr, timeout=None):
+        s = FakeSock()
+        made.append(s)
+        return s
+
+    monkeypatch.setattr(rpc_mod.socket, "create_connection",
+                        fake_create_connection)
+    monkeypatch.setattr(rpc_mod.config, "rpc_connect_retries", 3)
+    with pytest.raises(rpc_mod.RpcError):
+        rpc_mod._connect(("127.0.0.1", 1), timeout=0.5)
+    assert made and all(s.closed for s in made), \
+        f"{sum(not s.closed for s in made)}/{len(made)} sockets leaked"
+
+
+def test_ref_flush_abandons_undialable_owners(monkeypatch):
+    """Regression (PR 5): ref_update deltas for an owner that cannot
+    even be DIALED are abandoned immediately (its objects died with it)
+    instead of entering the 25-retry merge-back loop — pre-fix each dead
+    session cost ~1 s of flush-thread stall per pass for up to 25
+    passes, starving every queued local dec behind it (the
+    test_data.py ObjectFreedError flake's second half)."""
+    import collections
+    import threading
+
+    from ray_tpu.core import object_ref as orf
+    from ray_tpu.core import runtime as rt
+    from ray_tpu.core.rpc import RpcConnectError
+
+    dials = []
+
+    class FakeClients:
+        def get(self, addr):
+            dials.append(addr)
+            raise RpcConnectError(f"could not connect to {addr}")
+
+    class FakeCore:
+        addr = ("127.0.0.1", 4242)
+        clients = FakeClients()
+
+        def apply_ref_updates(self, deltas):
+            pass
+
+    monkeypatch.setattr(rt, "_core_worker", FakeCore())
+
+    tracker = orf._RefTracker.__new__(orf._RefTracker)
+    tracker._lock = threading.Lock()
+    tracker._counts = {}
+    tracker._dirty = {("127.0.0.1", 9999): {b"oid1": -1}}
+    tracker._pending_decs = collections.deque()
+    tracker._send_failures = {}
+    tracker._wake = threading.Event()
+
+    tracker.flush()
+    assert dials == [("127.0.0.1", 9999)]
+    assert tracker._dirty == {}, "undialable owner's deltas merged back"
+    assert tracker._send_failures == {}
+    tracker.flush()  # and they stay gone: no retry storm
+    assert dials == [("127.0.0.1", 9999)]
