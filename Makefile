@@ -1,7 +1,7 @@
 # Developer entry points. Tier-1 CI runs `make lint` semantics via
 # tests/test_analysis.py::test_repo_is_clean_under_strict.
 
-.PHONY: lint lint-diff lint-stats test
+.PHONY: lint lint-diff lint-stats test bench-paged
 
 lint:
 	python -m ray_tpu.analysis --strict
@@ -21,3 +21,10 @@ lint-stats:
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Paged-KV decode rows (concurrency per pool byte, mixed-prompt TTFT
+# p99 chunked vs monolithic) -> BENCH_SERVE.json. Drop BENCH_ARGS to
+# run on the attached accelerator; CI boxes use the CPU backend.
+BENCH_ARGS ?= --cpu
+bench-paged:
+	python bench_decode.py --sections paged $(BENCH_ARGS)

@@ -39,7 +39,7 @@ def pctl(xs, p):
     return xs[max(0, min(len(xs) - 1, math.ceil(p * len(xs)) - 1))]
 
 
-def engine_rows(params, cfg, quick: bool):
+def engine_rows(params, cfg, quick: bool, platform: str = ""):
     from ray_tpu.serve.decode import DecodeEngine
 
     import numpy as np
@@ -95,7 +95,8 @@ def engine_rows(params, cfg, quick: bool):
             "unit": "tokens/s",
             "note": (f"{n_requests} reqs x {gen} new tokens, prompt "
                      f"{prompt_len}, {slots} slots continuous batching, "
-                     f"decode_chunk={chunk}; wall {wall:.1f}s"),
+                     f"decode_chunk={chunk}; wall {wall:.1f}s; "
+                     f"{platform}"),
         })
         rows.append({
             "metric": f"decode_per_token_p50_chunk{chunk}",
@@ -104,7 +105,8 @@ def engine_rows(params, cfg, quick: bool):
             "note": (f"per-request stream duration/token; p99="
                      f"{pctl(per_tok, 0.99):.1f}ms; TTFT p50="
                      f"{pctl(ttfts, 0.5):.0f}ms (includes queueing — "
-                     f"{n_requests} reqs over {slots} slots)"
+                     f"{n_requests} reqs over {slots} slots); "
+                     f"nearest-rank pctl; {platform}"
                      if per_tok else ""),
         })
         eng.shutdown()
@@ -327,7 +329,154 @@ def overload_rows(params, cfg, quick: bool, platform: str):
     ]
 
 
-def serve_stack_row(cfg, quick: bool):
+def paged_rows(quick: bool, platform: str):
+    """Paged-KV rows (ISSUE 6): (a) concurrency per pool byte — active
+    requests sustained in the same pool bytes vs whole-row capacity
+    (acceptance bar >= 1.5x, also asserted in tests/test_paged_kv.py);
+    (b) mixed 64/512/4k prompt mix, chunked-prefill ON vs OFF: TTFT p99
+    and per-token p99 (the un-chunked baseline is one monolithic prefill
+    per admission — every active stream stalls for its duration);
+    (c) tokens/s/slot and HBM pool bytes per active request.
+
+    Uses a dedicated small config with a long rope table (the preset
+    debug model caps max_seq_len at 128; 4k prompts need 8k)."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.decode import DecodeEngine
+
+    import numpy as np
+
+    cfg = llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq_len=2048 if quick else 8192)
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    T = 64
+    rows = []
+
+    # ---- (a) + (c): overcommitted pool concurrency, same pool bytes
+    slots, capacity, pool_pages = 16, 1024, 8 * 1024 // T
+    whole_rows = pool_pages * T // capacity  # 8
+    eng = DecodeEngine(params, cfg, slots=slots, capacity=capacity,
+                       page_tokens=T, pool_pages=pool_pages,
+                       prefix_pool_entries=0)
+    pool_bytes = int(eng.cache["k"].nbytes + eng.cache["v"].nbytes)
+    prompts = [rng.integers(0, cfg.vocab_size, 70).tolist()
+               for _ in range(slots)]
+    warm = [eng.submit(p, max_new_tokens=2) for p in prompts]
+    while not all(w.done.is_set() for w in warm):
+        eng.step()
+    t0 = time.monotonic()
+    gen = 16 if quick else 48
+    reqs = [eng.submit(p, max_new_tokens=gen) for p in prompts]
+    eng.step()
+    active = eng.stats()["active"]
+    while not all(r.done.is_set() for r in reqs):
+        eng.step()
+    wall = time.monotonic() - t0
+    total = sum(len(r.output) for r in reqs)
+    rows.append({
+        "metric": "decode_paged_concurrency_gain",
+        "value": round(active / whole_rows, 2),
+        "unit": "x",
+        "note": (f"{active} concurrent active requests in a pool whose "
+                 f"bytes hold {whole_rows} whole {capacity}-token rows "
+                 f"(kv_page_tokens={T}, {pool_pages} pages, "
+                 f"{pool_bytes / 1e6:.1f} MB pool); bar >= 1.5x; "
+                 f"prompt 70 + {gen} new; {platform}"),
+    })
+    rows.append({
+        "metric": "decode_paged_pool_bytes_per_request",
+        "value": round(pool_bytes / active / 1e6, 3),
+        "unit": "MB",
+        "note": (f"KV pool bytes / {active} active requests (whole-row "
+                 f"equivalent: {pool_bytes / whole_rows / 1e6:.3f} MB); "
+                 f"{platform}"),
+    })
+    rows.append({
+        "metric": "decode_paged_tokens_per_s_per_slot",
+        "value": round(total / wall / active, 2),
+        "unit": "tokens/s/slot",
+        "note": (f"{total} tokens over {wall:.1f}s across {active} "
+                 f"paged slots (tiny 2-layer model; the row tracks the "
+                 f"paged-vs-whole-row regression, not absolute speed); "
+                 f"{platform}"),
+    })
+    eng.shutdown()
+
+    # ---- (b): mixed prompt mix, chunked prefill ON vs OFF
+    mix = ([32, 32, 128, 128, 512] if quick
+           else [64, 64, 64, 512, 512, 4096])
+    gen = 8 if quick else 24
+    capacity = 1024 if quick else 4352  # 4096 + headroom, % 64 == 0
+    chunk = 128 if quick else 256
+    results = {}
+    for mode, chunk_tok in (("monolithic", 0), ("chunked", chunk)):
+        eng = DecodeEngine(params, cfg, slots=4, capacity=capacity,
+                           page_tokens=T, prefix_pool_entries=0,
+                           prefill_chunk_tokens=chunk_tok)
+        # Warm every program in the mix (compile outside the window).
+        warm = [eng.submit(rng.integers(0, cfg.vocab_size, n).tolist(),
+                           max_new_tokens=2) for n in set(mix)]
+        while not all(w.done.is_set() for w in warm):
+            eng.step()
+        prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+                   for n in mix]
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, max_new_tokens=gen) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            if eng.step() == 0:
+                time.sleep(0.001)
+        wall = time.monotonic() - t0
+        ttfts = [1e3 * (r.first_token_at - r.submitted_at) for r in reqs]
+        per_tok = [1e3 * (r.finished_at - r.first_token_at)
+                   / max(1, len(r.output) - 1) for r in reqs
+                   if len(r.output) > 1]
+        results[mode] = {
+            "ttft_p99": pctl(ttfts, 0.99),
+            "per_tok_p99": pctl(per_tok, 0.99),
+            "wall": wall,
+            "chunks": eng.prefill_chunks,
+        }
+        eng.shutdown()
+    workload = (f"{len(mix)} reqs, prompt mix {sorted(set(mix))}, "
+                f"{gen} new tokens, 4 paged slots (T={T}); {platform}")
+    rows.append({
+        "metric": "decode_paged_mixed_ttft_p99_monolithic",
+        "value": round(results["monolithic"]["ttft_p99"], 1),
+        "unit": "ms",
+        "note": (f"chunked prefill OFF (one monolithic prefill per "
+                 f"admission); per-token p99="
+                 f"{results['monolithic']['per_tok_p99']:.1f}ms; "
+                 f"{workload}"),
+    })
+    rows.append({
+        "metric": "decode_paged_mixed_ttft_p99_chunked",
+        "value": round(results["chunked"]["ttft_p99"], 1),
+        "unit": "ms",
+        "note": (f"chunked prefill ON (prefill_chunk_tokens={chunk}, "
+                 f"{results['chunked']['chunks']} chunks interleaved); "
+                 f"per-token p99="
+                 f"{results['chunked']['per_tok_p99']:.1f}ms vs "
+                 f"{results['monolithic']['per_tok_p99']:.1f}ms "
+                 f"un-chunked — a long admission stalls active streams "
+                 f"for at most one chunk; {workload}"),
+    })
+    rows.append({
+        "metric": "decode_paged_mixed_per_token_p99_chunked",
+        "value": round(results["chunked"]["per_tok_p99"], 1),
+        "unit": "ms",
+        "note": (f"inter-token p99 of ACTIVE streams while 4k-class "
+                 f"prefills interleave (un-chunked baseline "
+                 f"{results['monolithic']['per_tok_p99']:.1f}ms); "
+                 f"{workload}"),
+    })
+    return rows
+
+
+def serve_stack_row(cfg, quick: bool, platform: str = "",
+                    cpu: bool = False):
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu.serve.decode import LlamaDecodeDeployment
@@ -340,7 +489,7 @@ def serve_stack_row(cfg, quick: bool):
     dep = serve.deployment(LlamaDecodeDeployment).options(
         max_ongoing_requests=64, max_concurrency=32,
         ray_actor_options=(
-            {} if quick else {"resources": {"TPU": 1.0}}),
+            {} if quick or cpu else {"resources": {"TPU": 1.0}}),
     ).bind(config=cfg, slots=4 if quick else 16, capacity=256,
            decode_chunk=8)
     serve.run(dep, name="llm_decode")
@@ -390,7 +539,8 @@ def serve_stack_row(cfg, quick: bool):
         "note": (f"{clients} closed-loop clients x {gen} new tokens/req "
                  f"through controller-routed handle, {len(lat)} reqs, "
                  f"req p50={pctl(lat, 0.5):.0f}ms "
-                 f"p99={pctl(lat, 0.99):.0f}ms"),
+                 f"p99={pctl(lat, 0.99):.0f}ms; nearest-rank pctl; "
+                 f"{platform}"),
     }
     serve.shutdown()
     return [row]
@@ -400,10 +550,11 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
-        "--sections", default="engine,serve,shared_prefix,overload",
+        "--sections", default="engine,serve,shared_prefix,overload,paged",
         help="comma-set of row groups to (re)measure: engine, serve, "
-             "shared_prefix, overload. Only the selected groups' rows "
-             "are replaced in BENCH_SERVE.json; the rest are preserved.")
+             "shared_prefix, overload, paged. Only the selected groups' "
+             "rows are replaced in BENCH_SERVE.json; the rest are "
+             "preserved.")
     parser.add_argument(
         "--model", default=None,
         help="llama preset override (default: debug if --quick else "
@@ -434,15 +585,18 @@ def main() -> None:
 
     rows = []
     if "engine" in sections:
-        rows += engine_rows(params, cfg, args.quick)
+        rows += engine_rows(params, cfg, args.quick, plat_note)
     if "shared_prefix" in sections:
         rows += shared_prefix_rows(params, cfg, args.quick, plat_note)
     if "overload" in sections:
         rows += overload_rows(params, cfg, args.quick, plat_note)
+    if "paged" in sections:
+        rows += paged_rows(args.quick, f"{platform} backend")
     if "serve" in sections:
         ray_tpu.init(num_cpus=4)
         try:
-            rows += serve_stack_row(cfg, args.quick)
+            rows += serve_stack_row(cfg, args.quick, plat_note,
+                                    cpu=args.cpu)
         finally:
             ray_tpu.shutdown()
 

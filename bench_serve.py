@@ -28,8 +28,13 @@ import time
 
 
 def pctl(xs, p):
+    """Nearest-rank percentile (1-indexed rank ceil(p*n)) — the old
+    ``int(len(xs) * p)`` index was biased one rank high at p50 for
+    even-sized samples (same fix as bench_decode.py::pctl)."""
+    import math
+
     xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(len(xs) * p))]
+    return xs[max(0, min(len(xs) - 1, math.ceil(p * len(xs)) - 1))]
 
 
 SEQ_LEN = 128
@@ -40,9 +45,10 @@ SEQ_LEN = 128
 BUCKETS = [8, 64]
 
 
-def llama_deployment(serve):
+def llama_deployment(serve, cpu: bool = False, model: str = "160m"):
     @serve.deployment(max_ongoing_requests=128,
-                      ray_actor_options={"resources": {"TPU": 1.0}})
+                      ray_actor_options=(
+                          {} if cpu else {"resources": {"TPU": 1.0}}))
     class LlamaServer:
         def __init__(self):
             import jax
@@ -51,7 +57,7 @@ def llama_deployment(serve):
 
             from ray_tpu.models import llama
 
-            self.cfg = llama.PRESETS["160m"]
+            self.cfg = llama.PRESETS[model]
             self.params = llama.init_params(self.cfg, jax.random.key(0))
 
             # The serving shape: score the prompt, return the NEXT TOKEN
@@ -112,8 +118,18 @@ def closed_loop(handle, seq, n_clients: int, duration_s: float):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="run the prefill-serving rows on the CPU backend (replicas "
+             "lose the TPU resource requirement; rows are annotated)")
+    ap.add_argument(
+        "--model", default="160m",
+        help="llama preset for the serving rows (the 160m default needs "
+             "the rig; CPU re-measures use debug)")
     args = ap.parse_args()
     duration = 10.0 if args.quick else 30.0
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
     import ray_tpu
     from ray_tpu import serve
@@ -122,7 +138,8 @@ def main() -> None:
     rows = []
 
     # ---- 1+2: handle-path throughput + latency on the TPU replica
-    LlamaServer = llama_deployment(serve)
+    LlamaServer = llama_deployment(serve, cpu=args.cpu,
+                                   model=args.model)
     handle = serve.run(LlamaServer.bind(), name="llama",
                        ready_timeout_s=600.0)
     seq = list(range(SEQ_LEN))
@@ -136,7 +153,8 @@ def main() -> None:
         "metric": "serve_throughput_requests_per_s",
         "value": round(n / wall, 1), "unit": "req/s",
         "note": f"64 closed-loop clients, {duration:.0f}s, batch buckets "
-                f"{BUCKETS}, seq {SEQ_LEN}, 160M-param jitted Llama fwd",
+                f"{BUCKETS}, seq {SEQ_LEN}, {args.model} jitted Llama "
+                f"fwd",
     })
     rows.append({
         "metric": "serve_throughput_tokens_per_s",
@@ -217,9 +235,13 @@ def main() -> None:
     })
     serve.shutdown()
 
+    if args.cpu:
+        for r in rows:
+            r["note"] += (f"; {args.model} model, cpu backend "
+                          f"(nearest-rank pctl)")
     out = {
         "artifact": "BENCH_SERVE",
-        "model": "llama-160m prefill, seq 128, bf32 defaults",
+        "model": f"llama-{args.model} prefill, seq 128, bf32 defaults",
         "data_plane": "per-node ProxyActor (serve/proxy.py)",
         "device_probe": {
             "note": "raw jitted step on this chip (no serving stack): "
@@ -233,6 +255,17 @@ def main() -> None:
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_SERVE.json")
+    # Merge-preserve: replace exactly the rows this run re-measured —
+    # clobbering bench_decode.py's decode/paged rows (as the pre-fix
+    # version did) silently erased half the artifact.
+    if os.path.exists(path):
+        with open(path) as f:
+            old = json.load(f)
+        emitted = {r["metric"] for r in rows}
+        out["rows"] = [r for r in old.get("rows", [])
+                       if r["metric"] not in emitted] + rows
+        for key, val in old.items():
+            out.setdefault(key, val)
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))

@@ -17,9 +17,14 @@ still live and unowned. Resource idioms are fitted to this codebase
   a function that never releases owns the registration by design
   (``__init__`` registering the listening socket);
 * slot pools — ``self._free.pop()`` leases, ``self._free.append(s)``
-  returns (DecodeEngine slots);
+  returns (DecodeEngine slots); ``pages = self._pages.alloc(n)``
+  leases KV pool pages, ``self._pages.free(...)`` returns them — the
+  leased local matches anywhere inside a release argument expression
+  (``free(shared + fresh)``), since the paged-KV allocator frees
+  collections;
 * refcounts — ``ent.refcount += 1`` pins, ``-= 1`` unpins (prefix-cache
-  rows).
+  rows); ``alloc.incref(p)``/``decref(p)`` pin/unpin pool pages
+  (method-pair form).
 
 Ownership transfer kills liveness: storing the resource (assignment
 value — including wrapping constructors like ``_Conn(sock)``),
@@ -226,9 +231,14 @@ class _FnAnalysis:
                         out.add(r.rid)
                     elif r.kind == "pool" and verb == r.release_verb \
                             and recv_d == r.recv_key \
-                            and any(isinstance(a, ast.Name)
-                                    and a.id == r.name
-                                    for a in node.args):
+                            and any(isinstance(sub, ast.Name)
+                                    and sub.id == r.name
+                                    for a in node.args
+                                    for sub in ast.walk(a)):
+                        # the leased value anywhere in an argument
+                        # expression counts: the page-allocator idiom
+                        # frees collections (``free(shared + fresh)``),
+                        # not just the bare local
                         out.add(r.rid)
                 # release-through-self-call (``self._drop(st)``)
                 callee, _vs = self.graph.resolve_call_cached(

@@ -203,11 +203,19 @@ RESOURCE_CTOR_DOTTED = {
 RESOURCE_METHOD_PAIRS = {
     "register": "unregister",
     "pin": "unpin",
+    # Page-allocator refcount sharing (serve/paging.py): an incref pins
+    # a pool page a later decref/free must release.
+    "incref": "decref",
 }
 # Slot-pool attributes: ``self._free.pop()`` leases a slot that
-# ``self._free.append(slot)`` returns (DecodeEngine slot discipline).
+# ``self._free.append(slot)`` returns (DecodeEngine slot discipline);
+# ``pages = self._pages.alloc(n)`` leases KV pool pages that
+# ``self._pages.free(pages)`` returns (the paged-KV allocator — a block
+# leak on a cancel/deadline/retire path pins HBM forever, the exact
+# failure mode the decode engine's _release_slot centralizes against).
 RESOURCE_POOL_ATTRS = {
     "_free": ("pop", "append"),
+    "_pages": ("alloc", "free"),
 }
 # Refcount attributes: ``ent.refcount += 1`` pins, ``-= 1`` unpins
 # (prefix-cache row pinning).

@@ -216,6 +216,34 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "Base backoff before a handle retry; doubles each attempt with "
         "+/-50% jitter so a replica death under load heals instead of "
         "amplifying into a synchronized retry storm on the survivors."),
+    "kv_page_tokens": (int, 0,
+        "Page size (tokens) of a DecodeEngine's paged KV pool. >0 switches "
+        "the engine from per-slot monolithic cache rows to a shared device "
+        "pool of fixed-size pages addressed through per-slot block tables "
+        "(vLLM-style paged attention on static shapes): slots consume only "
+        "the pages their sequence actually covers, prefix sharing splices "
+        "block-table entries with zero device copies, and eviction frees "
+        "page-granular tail segments. Must divide the engine capacity. "
+        "0 = contiguous whole-row cache (pre-paging behavior)."),
+    "kv_pool_pages": (int, 0,
+        "Pages in a paged DecodeEngine's device KV pool. The pool may be "
+        "OVERCOMMITTED (pages < slots * capacity / kv_page_tokens): more "
+        "concurrent sequences fit the same HBM bytes, and when the pool "
+        "truly runs dry the engine reclaims prefix-cache pins first and "
+        "then preempts the youngest request (recompute-style requeue). "
+        "0 = slots * capacity / kv_page_tokens (no overcommit)."),
+    "kv_prefix_max_pages": (int, 0,
+        "Cap on pool pages pinned by the paged prefix index (cached "
+        "prompt prefixes kept resident after their request completes). "
+        "Past it, least-recently-used tail pages unpin first. "
+        "0 = kv_pool_pages // 4."),
+    "prefill_chunk_tokens": (int, 0,
+        "Chunked-prefill interleaving for paged DecodeEngines: prompt "
+        "prefills longer than this run as a sequence of at most one "
+        "chunk-sized prefill program per decode step, scheduled between "
+        "decode steps — a long admission can stall active streams for at "
+        "most ONE chunk instead of its whole prefill. 0 disables "
+        "(monolithic prefill at admission, pre-chunking behavior)."),
     "prefix_affinity_enabled": (bool, True,
         "Serve routers hash a request's leading token buckets and prefer "
         "the replica advertising that prefix in its cache (falling back "

@@ -287,6 +287,227 @@ def decode_chunk(params: Dict[str, Any], cache: Cache, tokens: jax.Array,
     return toks, cache
 
 
+# --------------------------------------------------------------- paged KV
+#
+# vLLM-style paged attention on XLA-friendly static shapes: K/V for ALL
+# slots live in one device pool of ``(pages, page_tokens)`` blocks, and a
+# per-slot block table (int32 page ids, static width) maps logical token
+# positions to pool pages. Attention gathers a slot's pages back into
+# logical order — the gathered layout is value-for-value identical to the
+# contiguous cache, so the masked-dot attention below is BIT-EXACT vs the
+# monolithic path (same values, same reduction order, same masks).
+#
+# Page id 0 is a reserved scratch page: block-table entries for positions
+# a slot never allocated point at it, so pad writes land somewhere
+# harmless (never read — masking is by per-slot ``length``/causality,
+# exactly like the contiguous path). The host-side allocator
+# (``serve/paging.py``) hands out ids 1..pages.
+
+
+def init_page_pool(config: LlamaConfig, pages: int, page_tokens: int,
+                   dtype=None) -> Cache:
+    """Zeroed paged KV pool: ``pages`` usable pages of ``page_tokens``
+    tokens each, plus the reserved scratch page 0 (so the arrays hold
+    ``pages + 1`` page rows)."""
+    c = config
+    if c.moe_experts:
+        raise NotImplementedError(
+            "paged KV-cache decode for MoE configs is not implemented yet "
+            "(dense + GQA only)")
+    dt = dtype or c.dtype
+    shape = (c.n_layers, pages + 1, page_tokens, c.n_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_prefill(params: Dict[str, Any], tokens: jax.Array, pool: Cache,
+                  block_tables: jax.Array, config: LlamaConfig,
+                  lengths: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, Cache]:
+    """Full prefill of right-padded prompts (B, S) scattered into pool
+    pages. The attention itself is the plain causal ``prefill`` (a fresh
+    prompt attends only to itself — no pool reads), so logits are
+    bit-exact vs the contiguous path; only the K/V destination differs:
+    position ``p`` of row ``b`` lands in page
+    ``block_tables[b, p // T]`` at offset ``p % T``.
+
+    ``block_tables``: (B, W) int32 with ``W * T >= S``. Pad positions
+    past a row's real length scatter into whatever page backs them —
+    the row's own tail page or the scratch page 0 — and are never read
+    (causally invisible at prefill, masked by ``length`` at decode)."""
+    B, S = tokens.shape
+    T = pool["k"].shape[2]
+    scratch = {
+        "k": jnp.zeros(pool["k"].shape[:1] + (B, S)
+                       + pool["k"].shape[3:], pool["k"].dtype),
+        "v": jnp.zeros(pool["v"].shape[:1] + (B, S)
+                       + pool["v"].shape[3:], pool["v"].dtype),
+        "length": jnp.zeros((B,), jnp.int32),
+    }
+    logits, scratch = prefill(params, tokens, scratch, config, lengths)
+    pos = jnp.arange(S)
+    pages = block_tables[:, pos // T]                    # (B, S)
+    offs = jnp.broadcast_to(pos % T, (B, S))
+    new_k = pool["k"].at[:, pages, offs].set(scratch["k"])
+    new_v = pool["v"].at[:, pages, offs].set(scratch["v"])
+    return logits, {"k": new_k, "v": new_v}
+
+
+def paged_prefill_suffix(params: Dict[str, Any], tokens: jax.Array,
+                         pool: Cache, block_tables: jax.Array,
+                         config: LlamaConfig, prefix_lens: jax.Array,
+                         lengths: jax.Array) -> Tuple[jax.Array, Cache]:
+    """Suffix prefill against paged context: process right-padded suffix
+    ``tokens`` (B, S) from ``pos = prefix_lens``, attending to the pages
+    ``block_tables`` (B, W) maps — the shared/previously-filled prefix
+    pages plus the causal part of the suffix. This one program is the
+    prefix-hit splice (prefix pages borrowed from the pool with ZERO
+    copies — the block table entries ARE the splice) and the chunked-
+    prefill continuation step (prefix = what earlier chunks wrote).
+
+    ``W`` is a static page width covering ``prefix + suffix`` for the
+    whole wave; pass block tables sliced to it so gather/attention cost
+    scales with what the wave touches, not the engine's max context.
+    Suffix K/V additionally scatters into the pool at the absolute
+    positions (always pages owned exclusively by the row: sharing is
+    full-page and writes start past the shared region)."""
+    c = config
+    B, S = tokens.shape
+    T = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    C = W * T
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_embed"].astype(c.dtype)[tokens]          # (B, S, E)
+    abs_pos = prefix_lens[:, None] + jnp.arange(S)[None, :]  # (B, S)
+    kv_groups = c.n_heads // c.n_kv_heads
+    scale = c.head_dim ** -0.5
+    rows = jnp.arange(B)
+    # Pad positions past the static page window (a bucket overhanging a
+    # row's real length) scatter to the SCRATCH page, never a clamped
+    # real page — an index clamp here would corrupt live K/V at the
+    # pad's page offset.
+    pages = jnp.where(
+        abs_pos < C,
+        block_tables[rows[:, None], jnp.minimum(abs_pos // T, W - 1)], 0)
+    offs = abs_pos % T
+    valid = (jnp.arange(C)[None, None, :]
+             <= abs_pos[:, :, None])                         # (B, S, C)
+
+    def body(x, inp):
+        layer, k_p, v_p = inp               # pool slices (P+1, T, KV, D)
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q, k_new, v_new = _qkv(layer, h, c)  # (B, S, H/KV, D)
+        q = apply_rope(q, cos, sin, positions=abs_pos)
+        k_new = apply_rope(k_new, cos, sin, positions=abs_pos)
+        k_p = k_p.at[pages, offs].set(k_new.astype(k_p.dtype))
+        v_p = v_p.at[pages, offs].set(v_new.astype(v_p.dtype))
+        # Gather AFTER the scatter so the suffix's own causal K/V is in
+        # view; layout is logical position order, like the contiguous
+        # rows, so attention below is the exact prefill_suffix math.
+        k_c = k_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
+        v_c = v_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
+        qg = q.reshape(B, S, c.n_kv_heads, kv_groups, c.head_dim)
+        scores = jnp.einsum("bskgd,bckd->bkgsc", qg, k_c,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bkgsc,bckd->bkgsd", probs.astype(v_c.dtype), v_c)
+        att = att.transpose(0, 3, 1, 2, 4).reshape(
+            B, S, c.n_heads, c.head_dim).astype(x.dtype)
+        out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
+        x = x + out
+        x = _mlp(layer, x, c)
+        return x, (k_p, v_p)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    idx = jnp.clip(lengths - prefix_lens - 1, 0, S - 1)
+    x_last = jnp.take_along_axis(
+        x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = jnp.einsum("be,ev->bv", x_last,
+                        params["lm_head"].astype(c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def paged_decode_step(params: Dict[str, Any], pool: Cache,
+                      block_tables: jax.Array, lengths: jax.Array,
+                      tokens: jax.Array, config: LlamaConfig
+                      ) -> Tuple[jax.Array, Cache, jax.Array]:
+    """One decode token per slot against paged context. ``tokens``: (B,)
+    int32 written at position ``lengths[b]`` of each row's block-mapped
+    sequence; attention sees positions ``<= length`` across the row's
+    gathered pages — value-for-value the contiguous ``decode_step``."""
+    c = config
+    B = tokens.shape[0]
+    T = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    C = W * T
+    pos = lengths                                            # (B,)
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_embed"].astype(c.dtype)[tokens][:, None]  # (B, 1, E)
+    kv_groups = c.n_heads // c.n_kv_heads
+    scale = c.head_dim ** -0.5
+    rows = jnp.arange(B)
+    # Idle/mid-prefill slots also flow through this program (static B);
+    # their parked cursor can sit past the page window — route those
+    # writes to the scratch page instead of clamping into a live page.
+    page = jnp.where(pos < C,
+                     block_tables[rows, jnp.minimum(pos // T, W - 1)], 0)
+    off = pos % T
+    valid = (jnp.arange(C)[None, :] <= pos[:, None])         # (B, C)
+
+    def body(x, inp):
+        layer, k_p, v_p = inp
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q, k_new, v_new = _qkv(layer, h, c)       # (B, 1, H/KV, D)
+        q = apply_rope(q, cos, sin, positions=pos[:, None])
+        k_new = apply_rope(k_new, cos, sin, positions=pos[:, None])
+        k_p = k_p.at[page, off].set(k_new[:, 0].astype(k_p.dtype))
+        v_p = v_p.at[page, off].set(v_new[:, 0].astype(v_p.dtype))
+        k_c = k_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
+        v_c = v_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
+        qg = q[:, 0].reshape(B, c.n_kv_heads, kv_groups, c.head_dim)
+        scores = jnp.einsum("bkgd,bckd->bkgc", qg, k_c,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bkgc,bckd->bkgd", probs.astype(v_c.dtype), v_c)
+        att = att.reshape(B, 1, c.n_heads, c.head_dim).astype(x.dtype)
+        out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
+        x = x + out
+        x = _mlp(layer, x, c)
+        return x, (k_p, v_p)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("be,ev->bv", x[:, 0],
+                        params["lm_head"].astype(c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}, pos + 1
+
+
+def paged_decode_chunk(params: Dict[str, Any], pool: Cache,
+                       block_tables: jax.Array, lengths: jax.Array,
+                       tokens: jax.Array, config: LlamaConfig, k: int
+                       ) -> Tuple[jax.Array, Cache, jax.Array]:
+    """``k`` greedy paged decode steps in ONE jitted program (the
+    dispatch-amortization lever, paged flavor). The block tables are
+    static across the chunk: the caller must have pages allocated to
+    cover ``length + k`` for every stepping slot."""
+    def body(carry, _):
+        pool, lens, tok = carry
+        logits, pool, lens = paged_decode_step(params, pool, block_tables,
+                                               lens, tok, config)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (pool, lens, nxt), nxt
+
+    (pool, lengths, _), toks = jax.lax.scan(
+        body, (pool, lengths, tokens), None, length=k)
+    return toks, pool, lengths
+
+
 def _sample(logits: jax.Array, temperature: float, key) -> jax.Array:
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

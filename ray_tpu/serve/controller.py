@@ -237,6 +237,23 @@ class ServeController:
                     "deadline_exceeded": sum(
                         r.last_stats.get("deadline_exceeded", 0)
                         for r in rec.replicas),
+                    # Page-pool health (paged decode replicas): free /
+                    # prefix-pinned pages and prefill-backlog tokens sum
+                    # across replicas; fragmentation reports the WORST
+                    # replica (it is a ratio — summing is meaningless).
+                    "pages_free": sum(r.last_stats.get("pages_free", 0)
+                                      for r in rec.replicas),
+                    "pages_pinned": sum(
+                        r.last_stats.get("pages_pinned", 0)
+                        for r in rec.replicas),
+                    "kv_fragmentation": max(
+                        (r.last_stats.get("kv_fragmentation", 0.0)
+                         for r in rec.replicas), default=0.0),
+                    "prefill_backlog_tokens": sum(
+                        r.last_stats.get("prefill_backlog_tokens", 0)
+                        for r in rec.replicas),
+                    "preempted": sum(r.last_stats.get("preempted", 0)
+                                     for r in rec.replicas),
                 }
                 for name, rec in self._deployments.items()
             }

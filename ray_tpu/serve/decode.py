@@ -42,7 +42,9 @@ logger = logging.getLogger(__name__)
 _req_ids = itertools.count(1)
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: the generated __eq__ would
+#   compare numpy token arrays elementwise (list.remove on the requeue
+#   would crash on different-length prompts)
 class _Request:
     tokens: np.ndarray                     # prompt ids, (S,)
     max_new_tokens: int
@@ -60,6 +62,11 @@ class _Request:
     finished_at: Optional[float] = None
     prefix_entry: int = -1                 # prefix-pool row spliced in
     prefix_len: int = 0                    # cached tokens NOT re-prefilled
+    # ------------------------------------------------------- paged mode
+    prefix_pages: List[int] = field(default_factory=list)  # spliced pages
+    prompt_len: int = 0       # ORIGINAL prompt length (tokens grows when
+    #   a preempted request re-queues with its emitted tokens absorbed)
+    prefilled: int = 0        # prompt tokens prefilled so far (chunked)
     # --------------------------------------------------- request lifecycle
     request_id: str = ""
     deadline: Optional[float] = None       # absolute monotonic; None = none
@@ -97,7 +104,11 @@ class DecodeEngine:
                  prefix_pool_entries: Optional[int] = None,
                  prefix_capacity: Optional[int] = None,
                  prefix_match_min_tokens: Optional[int] = None,
-                 queue_max: Optional[int] = None):
+                 queue_max: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_max_pages: Optional[int] = None):
         import jax
 
         from ray_tpu.core.config import config as rt_config
@@ -111,9 +122,55 @@ class DecodeEngine:
         self.slots = slots
         self.capacity = capacity
         self.prefill_bucket = prefill_bucket
-        self.cache = ld.init_cache(config, slots, capacity)
+        # -------------------------------------------------- paged KV pool
+        # page_tokens > 0 switches from per-slot monolithic cache rows to
+        # a shared device pool of fixed-size pages addressed through
+        # per-slot block tables: slots hold only the pages their sequence
+        # covers, prefix hits splice page ids with zero copies, and the
+        # pool may be overcommitted (more slots than whole rows fit).
+        pt = (rt_config.kv_page_tokens if page_tokens is None
+              else page_tokens)
+        self.page_tokens = int(pt)
+        self.paged = self.page_tokens > 0
+        chunk_tok = (rt_config.prefill_chunk_tokens
+                     if prefill_chunk_tokens is None
+                     else prefill_chunk_tokens)
+        # Chunked-prefill interleaving rides on the paged suffix program
+        # (a chunk IS a suffix prefill from pos=prefilled); contiguous
+        # engines ignore it and keep monolithic admission.
+        self.prefill_chunk_tokens = (int(chunk_tok) if self.paged else 0)
+        if self.prefill_chunk_tokens:
+            c = 1
+            while c * 2 <= self.prefill_chunk_tokens:
+                c *= 2
+            self.prefill_chunk_tokens = c  # pow2: bounds the bucket set
+        if self.paged:
+            if capacity % self.page_tokens:
+                raise ValueError(
+                    f"capacity ({capacity}) must be a multiple of "
+                    f"kv_page_tokens ({self.page_tokens})")
+            from ray_tpu.serve.paging import PageAllocator
+
+            self.slot_pages_max = capacity // self.page_tokens
+            pp = (rt_config.kv_pool_pages if pool_pages is None
+                  else pool_pages)
+            self.pool_pages = int(pp) or slots * self.slot_pages_max
+            self._pages = PageAllocator(self.pool_pages)
+            pool = ld.init_page_pool(config, self.pool_pages,
+                                     self.page_tokens)
+            self.cache = {"k": pool["k"], "v": pool["v"],
+                          "length": jax.numpy.zeros((slots,),
+                                                    jax.numpy.int32)}
+            self._block_tables = np.zeros(
+                (slots, self.slot_pages_max), np.int32)
+            self._slot_pages: List[List[int]] = [[] for _ in range(slots)]
+        else:
+            self._pages = None
+            self.cache = ld.init_cache(config, slots, capacity)
         self._free = list(range(slots))
         self._active: Dict[int, _Request] = {}
+        self._prefilling: Dict[int, _Request] = {}  # chunked, mid-prefill
+        self._requeue: List[_Request] = []  # preempted/pushed-back, FIFO
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._tokens = np.zeros((slots,), np.int32)
         self._rng = np.random.default_rng(0)
@@ -131,15 +188,21 @@ class DecodeEngine:
         self._requests: Dict[str, _Request] = {}
         self._reqs_lock = threading.Lock()
         self._queued_cancelled = 0  # cancelled but not yet dequeued
+        self._queued_tokens = 0     # prompt tokens waiting for prefill
+        #   (pending queue + requeue; advisory gauge, unlocked int ops)
         self.shed = 0               # requests rejected by the queue cap
         self.cancelled = 0          # requests ended by cancel()
         self.deadline_exceeded = 0  # requests ended by their deadline
+        self.preempted = 0          # requests requeued by page pressure
+        self.prefill_chunks = 0     # chunked-prefill programs dispatched
         self._ema_request_s = 0.0   # EMA of admitted-request service time
         self._last_purge = 0.0      # dead-entry queue-purge throttle
-        # Prefix KV cache: a device-resident pool of cached prompt-prefix
-        # K/V (P entries x C_prefix tokens) indexed by a host-side trie.
-        # At admission the longest cached prefix is spliced into the
-        # request's slot and only the suffix is prefilled.
+        # Prefix KV cache. Contiguous mode: a device-resident pool of
+        # cached prompt-prefix K/V (P entries x C_prefix tokens) indexed
+        # by a host-side trie; admission splices an entry row into the
+        # slot and prefills only the suffix. Paged mode: the index pins
+        # PAGE RANGES of the shared pool instead (PagedPrefixIndex) —
+        # inserts and splices are zero-copy block-table edits.
         entries = (rt_config.prefix_pool_entries
                    if prefix_pool_entries is None else prefix_pool_entries)
         min_tokens = (rt_config.prefix_match_min_tokens
@@ -149,9 +212,19 @@ class DecodeEngine:
             prefix_capacity = 1
             while prefix_capacity * 2 <= capacity // 2:
                 prefix_capacity *= 2
-        self.prefix: Optional[PrefixCache] = None
+        self.prefix = None
         self._pool = None
-        if entries > 0 and prefix_capacity >= max(2, min_tokens):
+        if self.paged:
+            if entries > 0:
+                from ray_tpu.serve.paging import PagedPrefixIndex
+
+                pmax = (rt_config.kv_prefix_max_pages
+                        if prefix_max_pages is None else prefix_max_pages)
+                self.prefix = PagedPrefixIndex(
+                    self._pages, self.page_tokens,
+                    max_pages=int(pmax) or max(1, self.pool_pages // 4),
+                    min_tokens=min_tokens)
+        elif entries > 0 and prefix_capacity >= max(2, min_tokens):
             self.prefix = PrefixCache(entries, prefix_capacity,
                                       min_tokens=min_tokens)
             c = config
@@ -168,26 +241,47 @@ class DecodeEngine:
         # shared cache. Donating the cache makes the slot insert in-place.
         # Params are ARGUMENTS (not closure captures), or jit would bake
         # the weights into the program as constants.
-        self._prefill_many = jax.jit(
-            self._prefill_many_impl, static_argnames=("n", "bucket"),
-            donate_argnums=(1,))
-        # Prefix-hit admission: splice pool entries into the wave's slots
-        # and prefill only the suffixes — one program per (n, bucket)
-        # power-of-two pair, like _prefill_many. Pool insert copies a
-        # freshly prefilled slot's leading positions into a pool row.
-        self._prefill_suffix_many = jax.jit(
-            self._prefill_suffix_many_impl,
-            static_argnames=("n", "bucket"), donate_argnums=(1,))
-        self._pool_insert = jax.jit(self._pool_insert_impl,
-                                    donate_argnums=(1, 2))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        if self.paged:
+            # Paged programs: same (n, bucket) jit-bucket discipline, but
+            # admission scatters K/V into pool pages through the wave's
+            # block tables, the suffix program doubles as the chunked-
+            # prefill continuation, and decode gathers each slot's pages
+            # back into logical order (bit-exact vs the contiguous dot).
+            # ``width`` (suffix) = static leading block-table columns the
+            # wave touches — cost scales with prefix+suffix, not max
+            # context, exactly like the contiguous ``lim``.
+            self._paged_prefill = jax.jit(
+                self._paged_prefill_impl, static_argnames=("n", "bucket"),
+                donate_argnums=(1,))
+            self._paged_suffix = jax.jit(
+                self._paged_suffix_impl,
+                static_argnames=("n", "bucket", "width"),
+                donate_argnums=(1,))
+            self._decode = jax.jit(self._paged_decode_impl,
+                                   donate_argnums=(1,))
+        else:
+            self._prefill_many = jax.jit(
+                self._prefill_many_impl, static_argnames=("n", "bucket"),
+                donate_argnums=(1,))
+            # Prefix-hit admission: splice pool entries into the wave's
+            # slots and prefill only the suffixes — one program per
+            # (n, bucket) power-of-two pair, like _prefill_many. Pool
+            # insert copies a freshly prefilled slot's leading positions
+            # into a pool row.
+            self._prefill_suffix_many = jax.jit(
+                self._prefill_suffix_many_impl,
+                static_argnames=("n", "bucket"), donate_argnums=(1,))
+            self._pool_insert = jax.jit(self._pool_insert_impl,
+                                        donate_argnums=(1, 2))
+            self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         # K greedy steps per device call (dispatch amortization); chunking
         # only engages when no admissions are pending and every active
         # request is greedy — sampling and joins stay per-token exact.
         self.decode_chunk = max(1, int(decode_chunk))
-        self._decode_k = jax.jit(self._decode_chunk_impl,
-                                 static_argnames=("k",),
-                                 donate_argnums=(1,))
+        self._decode_k = jax.jit(
+            self._paged_decode_chunk_impl if self.paged
+            else self._decode_chunk_impl,
+            static_argnames=("k",), donate_argnums=(1,))
         self.steps = 0
         self.tokens_out = 0
 
@@ -256,6 +350,126 @@ class DecodeEngine:
         return self._ld.decode_chunk(params, cache, tokens, self.config,
                                      k)
 
+    # ------------------------------------------------ paged jitted bodies
+
+    def _paged_prefill_impl(self, params, cache, tokens_rows, lengths,
+                            bt, slot_ids, n, bucket):
+        """Batched paged admission: causal prefill of ``n`` prompts in
+        ONE device call, K/V scattered into the pool pages ``bt`` maps
+        (one program per (n, bucket) power-of-two pair)."""
+        ld = self._ld
+        pool = {"k": cache["k"], "v": cache["v"]}
+        logits, pool = ld.paged_prefill(params, tokens_rows[:, :bucket],
+                                        pool, bt, self.config,
+                                        lengths=lengths)
+        return logits, {
+            "k": pool["k"], "v": pool["v"],
+            "length": cache["length"].at[slot_ids].set(lengths),
+        }
+
+    def _paged_suffix_impl(self, params, cache, tokens_rows, prefix_lens,
+                           lengths, bt, slot_ids, n, bucket, width):
+        """Suffix prefill over paged context: the prefix-hit splice
+        (shared pages arrive through ``bt`` — the block table IS the
+        splice, no copies) and the chunked-prefill continuation step.
+        ``bt`` is pre-sliced to ``width`` leading page columns so
+        gather/attention cost scales with prefix + suffix."""
+        ld = self._ld
+        pool = {"k": cache["k"], "v": cache["v"]}
+        logits, pool = ld.paged_prefill_suffix(
+            params, tokens_rows[:, :bucket], pool, bt, self.config,
+            prefix_lens, lengths)
+        return logits, {
+            "k": pool["k"], "v": pool["v"],
+            "length": cache["length"].at[slot_ids].set(lengths),
+        }
+
+    def _paged_decode_impl(self, params, cache, tokens, bt):
+        pool = {"k": cache["k"], "v": cache["v"]}
+        logits, pool, lens = self._ld.paged_decode_step(
+            params, pool, bt, cache["length"], tokens, self.config)
+        return logits, {"k": pool["k"], "v": pool["v"], "length": lens}
+
+    def _paged_decode_chunk_impl(self, params, cache, tokens, bt, k):
+        pool = {"k": cache["k"], "v": cache["v"]}
+        toks, pool, lens = self._ld.paged_decode_chunk(
+            params, pool, bt, cache["length"], tokens, self.config, k)
+        return toks, {"k": pool["k"], "v": pool["v"], "length": lens}
+
+    # --------------------------------------------- paged page accounting
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """``n`` pool pages, reclaiming prefix-index pins under pressure.
+        None = genuinely dry (caller preempts or backs off)."""
+        if self._pages.free_count < n and self.prefix is not None:
+            self.prefix.reclaim(n - self._pages.free_count)
+        return self._pages.alloc(n)
+
+    def _set_slot_pages(self, slot: int, pages: List[int]) -> None:
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, :len(pages)] = pages
+        self._slot_pages[slot] = pages
+
+    def _grow_slot(self, slot: int, pages: List[int]) -> None:
+        have = self._slot_pages[slot]
+        self._block_tables[slot, len(have):len(have) + len(pages)] = pages
+        self._slot_pages[slot] = have + pages
+
+    def _seq_pages(self, tokens: int) -> int:
+        return -(-tokens // self.page_tokens)
+
+    def _ensure_decode_pages(self, k: int) -> None:
+        """Every active slot can write its next ``k`` tokens. Oldest
+        slots are served first; when the pool is dry even after
+        reclaiming prefix pins, the YOUNGEST admitted request is
+        preempted (recompute-style requeue) — the oldest request always
+        makes progress, so this terminates."""
+        for slot in sorted(self._active,
+                           key=lambda s: self._active[s].submitted_at):
+            while True:
+                req = self._active.get(slot)
+                if req is None:
+                    break  # preempted while serving an older slot
+                need = self._seq_pages(req.prompt_len + req.generated
+                                       - 1 + k) \
+                    - len(self._slot_pages[slot])
+                if need <= 0:
+                    break
+                got = self._alloc_pages(need)
+                if got is not None:
+                    self._grow_slot(slot, got)
+                    break
+                if not self._preempt_one():
+                    break  # nothing left to preempt: caller's slot only
+
+    def _preempt_one(self) -> bool:
+        """Requeue the youngest admitted request to free its pages
+        (vLLM-style recompute preemption): its prompt plus every token
+        emitted so far re-enters the queue as one prefill, so the
+        stream continues exactly where it left off after re-admission."""
+        cands = list(self._active.items()) + list(self._prefilling.items())
+        if not cands:
+            return False
+        slot, req = max(cands, key=lambda it: it[1].submitted_at)
+        self._active.pop(slot, None)
+        self._prefilling.pop(slot, None)
+        self._release_slot(slot)
+        absorbed = len(req.tokens) - req.prompt_len
+        tail = np.asarray(req.output[absorbed:], np.int32)
+        if len(tail):
+            req.tokens = np.concatenate([req.tokens, tail])
+        req.slot = -1
+        req.prefix_pages = []
+        req.prefix_len = 0
+        req.prefilled = 0
+        self.preempted += 1
+        self._requeue.insert(0, req)
+        self._queued_tokens += len(req.tokens)
+        with self._reqs_lock:
+            req.admitted = False  # cancel-while-requeued counts as queued
+        self._work.set()
+        return True
+
     # ------------------------------------------------------------ intake
 
     def submit(self, prompt_tokens, max_new_tokens: int = 32,
@@ -267,6 +481,15 @@ class DecodeEngine:
                        int(max_new_tokens), float(temperature), eos_id,
                        on_token)
         req.request_id = request_id or f"req-{next(_req_ids)}"
+        req.prompt_len = len(req.tokens)
+        if self.paged and self._seq_pages(
+                len(req.tokens) + req.max_new_tokens) > self.pool_pages:
+            # A request no amount of preemption can seat must fail fast,
+            # not live forever in the requeue list.
+            raise ValueError(
+                f"prompt ({len(req.tokens)}) + max_new_tokens "
+                f"({req.max_new_tokens}) needs more pages than the pool "
+                f"holds ({self.pool_pages} x {self.page_tokens} tokens)")
         if len(req.tokens) >= self.capacity:
             raise ValueError(
                 f"prompt ({len(req.tokens)}) must be shorter than the "
@@ -299,6 +522,7 @@ class DecodeEngine:
                 retry_after_s=self.retry_after_estimate_s())
         with self._reqs_lock:
             self._requests[req.request_id] = req
+        self._queued_tokens += len(req.tokens)
         self._pending.put(req)
         self._work.set()
         return req
@@ -335,16 +559,25 @@ class DecodeEngine:
     # -------------------------------------------------------- the loop
 
     def _admit(self) -> None:
-        while self._free and not self._pending.empty():
-            # Drain up to len(free) pending requests, split them into
-            # prefix-cache hits and misses, and prefill each group as
-            # ONE batched device call per prompt/suffix bucket.
+        while self._free and (self._requeue
+                              or not self._pending.empty()):
+            # Drain up to len(free) pending requests (preempted requeues
+            # first — they were admitted before anything still queued),
+            # split them into prefix-cache hits and misses, and prefill
+            # each group as ONE batched device call per prompt/suffix
+            # bucket.
             wave: List[_Request] = []
             while len(wave) < len(self._free):
+                if self._requeue:
+                    wave.append(self._requeue.pop(0))
+                    self._queued_tokens -= len(wave[-1].tokens)
+                    continue
                 try:
-                    wave.append(self._pending.get_nowait())
+                    req = self._pending.get_nowait()
                 except queue.Empty:
                     break
+                self._queued_tokens -= len(req.tokens)
+                wave.append(req)
             if not wave:
                 return
             # Dead-on-arrival requests (cancelled while queued, or
@@ -366,6 +599,10 @@ class DecodeEngine:
                     live.append(req)
             if not live:
                 continue
+            if self.paged:
+                if not self._admit_paged(live):
+                    return  # pool dry: stop admitting this step
+                continue
             hits: List[_Request] = []
             misses: List[_Request] = []
             for req in live:
@@ -378,6 +615,201 @@ class DecodeEngine:
                     misses.append(req)
             self._admit_full(misses)
             self._admit_suffix(hits)
+
+    def _admit_paged(self, live: List[_Request]) -> bool:
+        """Seat a wave in paged mode: prefix pages splice into the slot's
+        block table with ZERO device copies, fresh pages come from the
+        allocator, and long prefills hand off to the chunked-prefill
+        interleaver instead of running one monolithic program. Returns
+        False when the pool ran dry mid-wave (unseated requests are
+        pushed back in order; admission pauses until pages free)."""
+        chunk = self.prefill_chunk_tokens
+        full_group: List[_Request] = []
+        suffix_group: List[_Request] = []
+        for i, req in enumerate(live):
+            m = (self.prefix.match(req.tokens)
+                 if self.prefix is not None else None)
+            if m is not None:
+                req.prefix_pages, req.prefix_len = m
+            else:
+                req.prefix_pages, req.prefix_len = [], 0
+            suffix_len = len(req.tokens) - req.prefix_len
+            if chunk > 0 and suffix_len > chunk:
+                # Chunked prefill: take the slot and the spliced prefix
+                # now; _prefill_tick runs the chunks between decode
+                # steps (and allocates pages chunk by chunk).
+                slot = self._free.pop()
+                req.slot = slot  # ownership on the request before any
+                #   fallible call: a raise must not strand the lease
+                self._set_slot_pages(slot, req.prefix_pages)
+                req.prefilled = req.prefix_len
+                # Park the device cursor at the spliced length NOW: the
+                # slot may sit un-ticked for several steps (one chunk
+                # per step, FIFO), and each decode step scribbles its
+                # idle-row junk at pos=length — at 0 that would land
+                # INSIDE a shared prefix page and corrupt it for every
+                # borrower. At prefix_len it lands in the slot's own
+                # (or scratch) territory, overwritten by the first
+                # chunk's scatter.
+                self.cache["length"] = \
+                    self.cache["length"].at[slot].set(req.prefix_len)
+                self._prefilling[slot] = req
+                continue
+            need = self._seq_pages(len(req.tokens)) - len(req.prefix_pages)
+            pages = self._alloc_pages(need)
+            if pages is None:
+                # Dry: drop the splice pins, push this and the rest of
+                # the wave back (front, original order) and pause.
+                self._pages.free(req.prefix_pages)
+                req.prefix_pages = []
+                req.prefix_len = 0
+                rest = live[i:]
+                for r in reversed(rest):
+                    with self._reqs_lock:
+                        r.admitted = False
+                    self._requeue.insert(0, r)
+                    self._queued_tokens += len(r.tokens)
+                break
+            slot = self._free.pop()
+            req.slot = slot
+            self._set_slot_pages(slot, req.prefix_pages + pages)
+            (suffix_group if req.prefix_len else full_group).append(req)
+        self._admit_paged_full(full_group)
+        self._admit_paged_suffix(suffix_group)
+        return not self._requeue
+
+    def _admit_paged_full(self, reqs: List[_Request]) -> None:
+        import jax.numpy as jnp
+
+        ld = self._ld
+        by_bucket: Dict[int, List[_Request]] = {}
+        for req in reqs:
+            bucket = min(ld.cache_bucket(len(req.tokens),
+                                         self.prefill_bucket),
+                         self.capacity)
+            by_bucket.setdefault(bucket, []).append(req)
+        T = self.page_tokens
+        for bucket, group in by_bucket.items():
+            n = 1
+            while n < len(group):
+                n *= 2
+            wp = max(1, -(-bucket // T))  # bt columns covering the bucket
+            rows = np.zeros((n, bucket), np.int32)
+            lengths = np.zeros((n,), np.int32)
+            slot_ids = np.full((n,), group[-1].slot, np.int32)
+            bt = np.zeros((n, wp), np.int32)
+            for i, req in enumerate(group):
+                rows[i, :len(req.tokens)] = req.tokens
+                lengths[i] = len(req.tokens)
+                slot_ids[i] = req.slot
+                bt[i] = self._block_tables[req.slot, :wp]
+            for i in range(len(group), n):  # idempotent pad rows
+                rows[i] = rows[len(group) - 1]
+                lengths[i] = lengths[len(group) - 1]
+                bt[i] = bt[len(group) - 1]
+            logits, self.cache = self._paged_prefill(
+                self.params, self.cache, jnp.asarray(rows),
+                jnp.asarray(lengths), jnp.asarray(bt),
+                jnp.asarray(slot_ids), n=n, bucket=bucket)
+            self._post_admit(group, [r.slot for r in group],
+                             np.asarray(logits))
+
+    def _admit_paged_suffix(self, reqs: List[_Request]) -> None:
+        """Prefix-hit paged admissions: the shared pages are already in
+        the slots' block tables (zero-copy splice at _admit_paged);
+        prefill only the uncached suffixes, one program per
+        (n, bucket, width) tuple."""
+        import jax.numpy as jnp
+
+        ld = self._ld
+        T = self.page_tokens
+        by_bucket: Dict[int, List[_Request]] = {}
+        for req in reqs:
+            suffix_len = len(req.tokens) - req.prefix_len
+            bucket = min(ld.cache_bucket(suffix_len,
+                                         self._suffix_bucket_min),
+                         self.capacity)
+            by_bucket.setdefault(bucket, []).append(req)
+        for bucket, group in by_bucket.items():
+            n = 1
+            while n < len(group):
+                n *= 2
+            need = max(-(-(r.prefix_len + bucket) // T) for r in group)
+            width = 1
+            while width < need:
+                width *= 2
+            width = min(width, self.slot_pages_max)
+            rows = np.zeros((n, bucket), np.int32)
+            plens = np.zeros((n,), np.int32)
+            lengths = np.zeros((n,), np.int32)
+            slot_ids = np.full((n,), group[-1].slot, np.int32)
+            bt = np.zeros((n, width), np.int32)
+            for i, req in enumerate(group):
+                suffix = req.tokens[req.prefix_len:]
+                rows[i, :len(suffix)] = suffix
+                plens[i] = req.prefix_len
+                lengths[i] = len(req.tokens)
+                slot_ids[i] = req.slot
+                bt[i] = self._block_tables[req.slot, :width]
+            for i in range(len(group), n):  # idempotent pad rows
+                rows[i] = rows[len(group) - 1]
+                plens[i] = plens[len(group) - 1]
+                lengths[i] = lengths[len(group) - 1]
+                bt[i] = bt[len(group) - 1]
+            logits, self.cache = self._paged_suffix(
+                self.params, self.cache, jnp.asarray(rows),
+                jnp.asarray(plens), jnp.asarray(lengths),
+                jnp.asarray(bt), jnp.asarray(slot_ids),
+                n=n, bucket=bucket, width=width)
+            self._post_admit(group, [r.slot for r in group],
+                             np.asarray(logits))
+
+    def _prefill_tick(self) -> None:
+        """Chunked-prefill interleaving: advance the OLDEST mid-prefill
+        slot by at most ONE ``prefill_chunk_tokens`` chunk, then return
+        so the decode step runs. A 4k-token admission thus costs active
+        streams one chunk of latency per token, never its whole
+        prefill. Page allocation is chunk-by-chunk; a dry pool skips
+        the tick (decode keeps draining; the chunk retries next step)."""
+        if not self._prefilling:
+            return
+        import jax.numpy as jnp
+
+        ld = self._ld
+        T = self.page_tokens
+        slot = min(self._prefilling,
+                   key=lambda s: self._prefilling[s].submitted_at)
+        req = self._prefilling[slot]
+        remaining = len(req.tokens) - req.prefilled
+        step_tok = min(self.prefill_chunk_tokens, remaining)
+        bucket = min(ld.cache_bucket(step_tok, self._suffix_bucket_min),
+                     self.prefill_chunk_tokens)
+        need = self._seq_pages(req.prefilled + step_tok) \
+            - len(self._slot_pages[slot])
+        if need > 0:
+            got = self._alloc_pages(need)
+            if got is None:
+                return
+            self._grow_slot(slot, got)
+        width = 1
+        while width * T < req.prefilled + bucket:
+            width *= 2
+        width = min(width, self.slot_pages_max)
+        rows = np.zeros((1, bucket), np.int32)
+        rows[0, :step_tok] = req.tokens[req.prefilled:
+                                        req.prefilled + step_tok]
+        bt = self._block_tables[slot:slot + 1, :width]
+        logits, self.cache = self._paged_suffix(
+            self.params, self.cache, jnp.asarray(rows),
+            jnp.asarray([req.prefilled], np.int32),
+            jnp.asarray([req.prefilled + step_tok], np.int32),
+            jnp.asarray(bt), jnp.asarray([slot], np.int32),
+            n=1, bucket=bucket, width=width)
+        self.prefill_chunks += 1
+        req.prefilled += step_tok
+        if req.prefilled >= len(req.tokens):
+            self._prefilling.pop(slot)
+            self._post_admit([req], [slot], np.asarray(logits))
 
     def _retire(self, req: _Request, status: str) -> None:
         """Terminal exit for a request that never held a slot."""
@@ -410,13 +842,33 @@ class DecodeEngine:
                     self._queued_cancelled -= 1
                     req.admitted = True
             if dead:
+                self._queued_tokens -= len(req.tokens)
                 self._retire(req, "cancelled")
             elif req.deadline is not None and now > req.deadline:
                 with self._reqs_lock:
                     req.admitted = True
+                self._queued_tokens -= len(req.tokens)
                 self._retire(req, "deadline_exceeded")
             else:
                 self._pending.put(req)
+        # Preempted/pushed-back requests wait in _requeue, not the
+        # queue: give their cancels/deadlines the same prompt exit.
+        for req in list(self._requeue):
+            with self._reqs_lock:
+                dead = req.cancelled
+                if dead:
+                    self._queued_cancelled -= 1
+                    req.admitted = True
+            expired = (not dead and req.deadline is not None
+                       and now > req.deadline)
+            if dead or expired:
+                self._requeue.remove(req)
+                self._queued_tokens -= len(req.tokens)
+                if expired:
+                    with self._reqs_lock:
+                        req.admitted = True
+                self._retire(req, "cancelled" if dead
+                             else "deadline_exceeded")
 
     def _admit_full(self, reqs: List[_Request]) -> None:
         import jax.numpy as jnp
@@ -501,6 +953,17 @@ class DecodeEngine:
 
     def _post_admit(self, group: List[_Request], slots: List[int],
                     logits: np.ndarray) -> None:
+        # Paged prefix insert runs BEFORE the emit/finish loop: a
+        # request that completes on its very first token (max_new=1 /
+        # instant EOS) is _finish-ed inside that loop, which FREES its
+        # pages — pinning them afterwards would pin recycled (soon
+        # overwritten) pages. Inserting first pins the slot's pages
+        # while the slot still owns them; _finish then drops only the
+        # slot's own references.
+        if self.prefix is not None and self.paged:
+            for req, slot in zip(group, slots):
+                self.prefix.insert(req.tokens, self._slot_pages[slot],
+                                   matched_len=req.prefix_len)
         now = time.monotonic()
         for i, req in enumerate(group):
             tok = self._sample_host(logits[i], req)
@@ -512,11 +975,12 @@ class DecodeEngine:
             if req.generated >= req.max_new_tokens or (
                     req.eos_id is not None and tok == req.eos_id):
                 self._finish(slots[i])
-        # Insert the freshly prefilled prompts back into the prefix pool
-        # NOW, before any later admission can recycle these slots: the
-        # slot rows still hold the full prompt K/V (a _finish only parks
-        # ``length``), and pool inserts dedup on the token key.
-        if self.prefix is not None:
+        # Contiguous insert stays AFTER: it copies the slot's leading
+        # positions into a separate pool row on device, and the rows
+        # still hold the full prompt K/V (a _finish only parks
+        # ``length``). Pool inserts dedup on the token key either way,
+        # and run before any later admission can recycle these slots.
+        if self.prefix is not None and not self.paged:
             for req, slot in zip(group, slots):
                 ins = self.prefix.insert(req.tokens,
                                          matched_len=req.prefix_len)
@@ -561,13 +1025,31 @@ class DecodeEngine:
                         "emitted): %s", req.slot, req.generated,
                         req.on_token_error, exc_info=True)
 
+    def _release_slot(self, slot: int) -> None:
+        """Slot teardown shared by _finish and preemption: paged mode
+        drops the slot's page references (shared prefix pages survive on
+        the index's pins; exclusively-owned pages recycle immediately)
+        and parks the block-table row on the scratch page."""
+        if self.paged:
+            pages = self._slot_pages[slot]
+            self._slot_pages[slot] = []
+            self._block_tables[slot, :] = 0
+            self._pages.free(pages)
+        self._free.append(slot)
+        # Park the freed slot at length 0 so idle slots don't walk their
+        # cursor toward the capacity edge while others decode.
+        self.cache["length"] = self.cache["length"].at[slot].set(0)
+        self._tokens[slot] = 0
+
     def _finish(self, slot: int, status: str = "completed") -> None:
-        req = self._active.pop(slot)
+        req = self._active.pop(slot, None)
+        if req is None:
+            req = self._prefilling.pop(slot)  # died mid-chunked-prefill
         # Return the slot IMMEDIATELY after the active-pop: _free is only
         # consumed by _admit on this same thread, but stats() reads both
         # cross-thread — a device dispatch between the pop and the append
         # would show active+free < slots (a phantom wedged slot).
-        self._free.append(slot)
+        self._release_slot(slot)
         req.status = status
         req.finished_at = time.monotonic()
         if status == "completed":
@@ -583,10 +1065,6 @@ class DecodeEngine:
         with self._reqs_lock:
             self._requests.pop(req.request_id, None)
         req.done.set()
-        # Park the freed slot at length 0 so idle slots don't walk their
-        # cursor toward the capacity edge while others decode.
-        self.cache["length"] = self.cache["length"].at[slot].set(0)
-        self._tokens[slot] = 0
 
     def _reap(self) -> None:
         """Free slots whose requests are dead (cancelled, or past their
@@ -597,7 +1075,7 @@ class DecodeEngine:
         now = time.monotonic()
         if (self._queued_cancelled > 0
                 or (now - self._last_purge > 0.5
-                    and not self._pending.empty())):
+                    and (self._requeue or not self._pending.empty()))):
             self._last_purge = now
             self._purge_pending()
         for slot in list(self._active):
@@ -606,22 +1084,24 @@ class DecodeEngine:
                 self._finish(slot, "cancelled")
             elif req.deadline is not None and now > req.deadline:
                 self._finish(slot, "deadline_exceeded")
+        # Mid-chunked-prefill slots die the same way: their pages (all
+        # non-shared ones) free within ONE step boundary, like actives.
+        for slot in list(self._prefilling):
+            req = self._prefilling[slot]
+            if req.cancelled:
+                self._finish(slot, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                self._finish(slot, "deadline_exceeded")
 
-    def step(self) -> int:
-        """Admit pending prefills, advance every active slot one token.
-        Returns the number of active slots stepped."""
-        import jax.numpy as jnp
-
-        self._reap()
-        self._admit()
-        if not self._active:
-            return 0
-        stepped = len(self._active)
-        chunk = 1
+    def _pick_chunk(self) -> int:
+        """Greedy decode steps fusable into one device call right now."""
         # Chunking engages when the batch can't change mid-chunk anyway
-        # (no free slot for a pending request) or nothing is waiting.
+        # (no free slot for a pending request) or nothing is waiting —
+        # and never while a chunked prefill is mid-flight (the whole
+        # point of interleaving is a prefill chunk between EVERY step).
         if (self.decode_chunk > 1
                 and (self._pending.empty() or not self._free)
+                and not self._requeue and not self._prefilling
                 and all(r.temperature <= 0.0
                         for r in self._active.values())):
             chunk = min(self.decode_chunk,
@@ -632,10 +1112,40 @@ class DecodeEngine:
             # ({1, 2, 4, ..., decode_chunk}), not one per remaining-count.
             while chunk & (chunk - 1):
                 chunk &= chunk - 1
+            return chunk
+        return 1
+
+    def step(self) -> int:
+        """Admit pending prefills, run at most one interleaved prefill
+        chunk, advance every active slot one token. Returns the number
+        of active slots stepped."""
+        import jax.numpy as jnp
+
+        self._reap()
+        self._admit()
+        if self.paged:
+            self._prefill_tick()
+        if not self._active:
+            return 0
+        chunk = self._pick_chunk()
+        if self.paged:
+            # Page the next k tokens in BEFORE the program runs: the
+            # block tables are static across the call. May preempt the
+            # youngest request (and so shrink the active set).
+            self._ensure_decode_pages(chunk)
+            if not self._active:
+                return 0
+            chunk = min(chunk, self._pick_chunk())
+        stepped = len(self._active)
         if chunk > 1:
-            toks, self.cache = self._decode_k(
-                self.params, self.cache, jnp.asarray(self._tokens),
-                k=chunk)
+            if self.paged:
+                toks, self.cache = self._decode_k(
+                    self.params, self.cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._block_tables), k=chunk)
+            else:
+                toks, self.cache = self._decode_k(
+                    self.params, self.cache, jnp.asarray(self._tokens),
+                    k=chunk)
             toks = np.asarray(toks)  # (chunk, slots)
             self.steps += chunk
             for slot in list(self._active):
@@ -650,8 +1160,13 @@ class DecodeEngine:
                         self._finish(slot)
                         break
             return stepped
-        logits, self.cache = self._decode(
-            self.params, self.cache, jnp.asarray(self._tokens))
+        if self.paged:
+            logits, self.cache = self._decode(
+                self.params, self.cache, jnp.asarray(self._tokens),
+                jnp.asarray(self._block_tables))
+        else:
+            logits, self.cache = self._decode(
+                self.params, self.cache, jnp.asarray(self._tokens))
         logits = np.asarray(logits)
         self.steps += 1
         for slot in list(self._active):
@@ -668,7 +1183,8 @@ class DecodeEngine:
         """Decode loop for a replica thread: steps while work exists,
         parks on an event while idle."""
         while not self._stop.is_set():
-            if self._active or not self._pending.empty():
+            if (self._active or self._prefilling or self._requeue
+                    or not self._pending.empty()):
                 self.step()
             else:
                 self._work.clear()
@@ -680,35 +1196,94 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ stats
 
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt tokens accepted but not yet prefilled: the queue (and
+        requeue) plus the un-prefilled remainder of mid-chunk slots.
+        TTFT debt the autoscaler must see — a replica with two queued
+        4k prompts is NOT as loaded as one with two queued 16-token
+        prompts, even at equal queue depth."""
+        backlog = max(0, self._queued_tokens)
+        for req in list(self._prefilling.values()):
+            backlog += max(0, len(req.tokens) - req.prefilled)
+        return backlog
+
     def stats(self) -> Dict[str, Any]:
         active = len(self._active)
+        prefilling = len(self._prefilling)
         # Live queue depth: cancelled-but-undequeued entries are dead
         # weight, not demand — the autoscaler must not scale out for
         # requests that will be dropped at admission.
-        queued = max(0, self._pending.qsize() - self._queued_cancelled)
+        queued = max(0, self._pending.qsize() + len(self._requeue)
+                     - self._queued_cancelled)
+        backlog = self.prefill_backlog_tokens()
+        # Backlog tokens -> load units: one prefill chunk (or one full
+        # prefill bucket, unchunked) of pending prompt is about one
+        # step's worth of work, i.e. one active-slot-equivalent.
+        denom = self.prefill_chunk_tokens or self.prefill_bucket
         out = {
             "steps": self.steps,
             "tokens_out": self.tokens_out,
             "active": active,
+            "prefilling": prefilling,
             "slots": self.slots,
             "free_slots": len(self._free),
             "queued": queued,
             "queue_max": self.queue_max,
             # Degradation counters: shed-at-enqueue, cooperative
-            # cancellations, and deadline expiries — surfaced through
-            # replica_metrics -> controller snapshot -> serve.status()
-            # so overload shows up as it happens.
+            # cancellations, deadline expiries, and page-pressure
+            # preemptions — surfaced through replica_metrics ->
+            # controller snapshot -> serve.status() so overload shows
+            # up as it happens.
             "shed": self.shed,
             "cancelled": self.cancelled,
             "deadline_exceeded": self.deadline_exceeded,
+            "preempted": self.preempted,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_backlog_tokens": backlog,
             # Decode backlog as replica load: occupied slots + pending
-            # queue depth. A full queue behind idle HTTP must read as
-            # load to the serve autoscaler, not zero.
-            "load": active + queued,
+            # queue depth + prefill-backlog tokens (in chunk-steps). A
+            # full queue behind idle HTTP must read as load to the
+            # serve autoscaler, not zero — and neither must a 4k
+            # prompt mid-chunked-prefill.
+            "load": active + prefilling + queued + backlog // max(1,
+                                                                 denom),
         }
+        if self.paged:
+            out.update(self._pages.stats())
+            out["page_tokens"] = self.page_tokens
+            out["pages_pinned"] = (self.prefix.pinned_pages
+                                   if self.prefix is not None else 0)
+            out["kv_fragmentation"] = self._fragmentation()
         if self.prefix is not None:
             out["prefix"] = self.prefix.stats()
         return out
+
+    def _fragmentation(self) -> float:
+        """Internal fragmentation of the page pool: the fraction of
+        allocated page-token capacity not backing a live token. Pages
+        are interchangeable, so EXTERNAL fragmentation is structurally
+        zero — waste is partial tail pages and dead junk, and this is
+        the number that says whether page_tokens is sized right."""
+        valid: Dict[int, int] = {}
+        T = self.page_tokens
+        rows = ([(s, r.prompt_len + r.generated)
+                 for s, r in list(self._active.items())]
+                + [(s, r.prefilled)
+                   for s, r in list(self._prefilling.items())])
+        for slot, length in rows:
+            for i, page in enumerate(self._slot_pages[slot]):
+                end = min(T, length - i * T)
+                if end > 0:
+                    valid[page] = max(valid.get(page, 0), end)
+        if self.prefix is not None:
+            # Prefix-pinned pages are always full by construction.
+            for page in self.prefix.pinned_page_ids():
+                valid[page] = T
+        in_use = self._pages.in_use
+        if not in_use:
+            return 0.0
+        used_tokens = sum(valid.values())
+        return round(max(0.0, 1.0 - used_tokens / (in_use * T)), 4)
 
 
 class LlamaDecodeDeployment:
@@ -723,7 +1298,10 @@ class LlamaDecodeDeployment:
                  prefix_pool_entries: Optional[int] = None,
                  prefix_capacity: Optional[int] = None,
                  prefix_match_min_tokens: Optional[int] = None,
-                 queue_max: Optional[int] = None):
+                 queue_max: Optional[int] = None,
+                 kv_page_tokens: Optional[int] = None,
+                 kv_pool_pages: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None):
         import jax
 
         from ray_tpu.models import llama
@@ -737,7 +1315,9 @@ class LlamaDecodeDeployment:
             prefix_pool_entries=prefix_pool_entries,
             prefix_capacity=prefix_capacity,
             prefix_match_min_tokens=prefix_match_min_tokens,
-            queue_max=queue_max)
+            queue_max=queue_max,
+            page_tokens=kv_page_tokens, pool_pages=kv_pool_pages,
+            prefill_chunk_tokens=prefill_chunk_tokens)
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()
@@ -752,7 +1332,17 @@ class LlamaDecodeDeployment:
         out: Dict[str, Any] = {"load": s["load"], "queued": s["queued"],
                                "shed": s["shed"],
                                "cancelled": s["cancelled"],
-                               "deadline_exceeded": s["deadline_exceeded"]}
+                               "deadline_exceeded": s["deadline_exceeded"],
+                               "prefill_backlog_tokens":
+                               s["prefill_backlog_tokens"]}
+        if self.engine.paged:
+            # Page-pool health, controller-aggregated into
+            # serve.status(): free/pinned pages and fragmentation say
+            # whether the replica can admit, what the prefix cache
+            # holds, and whether page_tokens is sized right.
+            for key in ("pages_total", "pages_free", "pages_in_use",
+                        "pages_pinned", "kv_fragmentation", "preempted"):
+                out[key] = s[key]
         if self.engine.prefix is not None:
             out["prefix"] = s.get("prefix", {})
             out["prefixes"] = self.engine.prefix.hashes()

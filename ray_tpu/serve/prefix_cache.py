@@ -23,6 +23,14 @@ prefill only for their uncached SUFFIX. The split of responsibilities:
 replicas advertise the hashes of their resident entries, and routers
 hash a request's leading token buckets to find the replica whose pool
 already holds the prompt (prefix-affinity routing).
+
+PAGED engines (``kv_page_tokens > 0``) use ``serve/paging.py``'s
+``PagedPrefixIndex`` instead: the same trie-style longest-prefix
+contract and hit/insert/evict accounting, but entries pin PAGE RANGES
+of the shared KV pool (refcounted, zero-copy insert and splice,
+page-granular tail eviction) rather than whole ``capacity``-sized rows
+— this class remains the contiguous-mode index. Both advertise hashes
+on the same power-of-two grid, so the router is mode-agnostic.
 """
 
 from __future__ import annotations

@@ -446,6 +446,70 @@ def test_lifetime_slot_pool_and_refcount_pairs():
                                                "Engine.leaky_slot"]
 
 
+def test_lifetime_page_allocator_leaks():
+    """The paged-KV allocator idiom (serve/paging.py): pages leased with
+    ``self._pages.alloc(n)`` must be freed or ownership-transferred on
+    every path — a block leak on a cancel/deadline/retire path pins HBM
+    forever."""
+    src = """
+        class Engine:
+            def leaky_admit(self, req):
+                pages = self._pages.alloc(4)
+                self.prefill(req, pages)      # raises -> pages stranded
+                self._pages.free(pages)
+
+            def early_return_leak(self, req):
+                pages = self._pages.alloc(4)
+                if req.cancelled:
+                    return None               # retire path drops pages
+                self._pages.free(pages)
+                return True
+    """
+    found = run_checker(lifetime.check, project_of(mod=src))
+    assert sorted(f.symbol for f in found) == ["Engine.early_return_leak",
+                                               "Engine.leaky_admit"]
+    assert all(f.rule == rules.RESOURCE_LEAK for f in found)
+
+
+def test_lifetime_page_allocator_clean_idioms():
+    """Release-in-finally, ownership transfer into engine state, and
+    freeing a collection CONTAINING the lease (``free(shared + fresh)``)
+    all discharge the page lease."""
+    src = """
+        class Engine:
+            def finally_frees(self, req):
+                pages = self._pages.alloc(4)
+                try:
+                    self.prefill(req, pages)
+                finally:
+                    self._pages.free(pages)
+
+            def transfers(self, slot):
+                pages = self._pages.alloc(4)
+                self._slot_pages[slot] = pages
+
+            def frees_collection(self, shared):
+                pages = self._pages.alloc(4)
+                self._pages.free(shared + pages)
+    """
+    found = run_checker(lifetime.check, project_of(mod=src))
+    assert found == [], [f.render() for f in found]
+
+
+def test_lifetime_page_incref_pair():
+    """allocator.incref/decref is a method pair: an escaping exception
+    between pin and unpin leaks the reference."""
+    src = """
+        class Index:
+            def leaky_pin(self, alloc, page):
+                alloc.incref(page)
+                self.splice(page)
+                alloc.decref(page)
+    """
+    found = run_checker(lifetime.check, project_of(mod=src))
+    assert [f.symbol for f in found] == ["Index.leaky_pin"]
+
+
 def test_lifetime_finally_loop_release_recognized():
     src = """
         def fork(a_path, b_path):
