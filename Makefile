@@ -1,7 +1,7 @@
 # Developer entry points. Tier-1 CI runs `make lint` semantics via
 # tests/test_analysis.py::test_repo_is_clean_under_strict.
 
-.PHONY: lint lint-diff lint-stats test bench-paged
+.PHONY: lint lint-diff lint-stats test bench-paged bench-sharded
 
 lint:
 	python -m ray_tpu.analysis --strict
@@ -28,3 +28,10 @@ test:
 BENCH_ARGS ?= --cpu
 bench-paged:
 	python bench_decode.py --sections paged $(BENCH_ARGS)
+
+# GSPMD model-parallel decode rows (sharded-vs-single-chip tokens/s +
+# HBM-per-chip headroom on a (2,4) batch x model mesh) ->
+# BENCH_SERVE.json. On CPU hosts the 8-device mesh is the forced
+# virtual one; logits bit-exactness is pinned by tests, not here.
+bench-sharded:
+	python bench_decode.py --sections sharded $(BENCH_ARGS)

@@ -546,15 +546,101 @@ def serve_stack_row(cfg, quick: bool, platform: str = "",
     return [row]
 
 
+def sharded_rows(quick: bool, platform: str):
+    """GSPMD model-parallel decode rows (ISSUE 7): a (2, 4) mesh engine
+    vs the single-chip engine on the same model — (a) decode tokens/s
+    (on the 1-core CPU host the 8 virtual devices time-slice, so the
+    sharded row measures partitioning OVERHEAD, not speedup — a real
+    slice gets the model-axis compute in parallel); (b) HBM-per-chip
+    headroom: bytes of weights + KV pool resident per device, sharded
+    vs single-chip — the model-size unlock, backend-independent.
+    Bit-exactness of the sharded logits is asserted in
+    tests/test_sharded_decode.py, not re-proven here."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.decode import DecodeEngine
+
+    if jax.device_count() < 8:
+        print("sharded section skipped: needs 8 devices "
+              f"(have {jax.device_count()})")
+        return []
+    # all sharded dims divisible by 8 so the (2, 4) mesh really shards
+    cfg = llama.LlamaConfig(
+        vocab_size=512, dim=128, n_layers=2 if quick else 4, n_heads=8,
+        n_kv_heads=8, mlp_dim=512, max_seq_len=1024)
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    slots, capacity, T = 8, 512, 64
+    gen = 16 if quick else 64
+    prompts = [rng.integers(0, cfg.vocab_size, 48).tolist()
+               for _ in range(slots)]
+
+    def per_chip_bytes(tree):
+        """Bytes one device holds of a (possibly sharded) pytree."""
+        dev0 = jax.devices()[0]
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            for shard in leaf.addressable_shards:
+                if shard.device == dev0:
+                    total += shard.data.nbytes
+        return total
+
+    def run(mesh_shape):
+        eng = DecodeEngine(params, cfg, slots=slots, capacity=capacity,
+                           page_tokens=T, prefix_pool_entries=0,
+                           mesh_shape=mesh_shape)
+        warm = [eng.submit(p, max_new_tokens=2) for p in prompts[:2]]
+        while not all(w.done.is_set() for w in warm):
+            eng.step()
+        reqs = [eng.submit(p, max_new_tokens=gen) for p in prompts]
+        t0 = time.monotonic()
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        wall = time.monotonic() - t0
+        toks = sum(len(r.output) for r in reqs)
+        return (toks / wall, per_chip_bytes(eng.params),
+                per_chip_bytes({"k": eng.cache["k"],
+                                "v": eng.cache["v"]}))
+
+    single_tps, single_pb, single_kb = run(None)
+    shard_tps, shard_pb, shard_kb = run((2, 4))
+    return [
+        {"metric": "decode_sharded_tokens_per_s",
+         "value": round(shard_tps, 1), "unit": "tok/s",
+         "note": (f"(2,4) batch x model mesh over 8 virtual devices vs "
+                  f"{single_tps:.1f} tok/s single-chip, same model/"
+                  f"capacity (slots={slots}, paged T={T}, +{gen} new); "
+                  f"1-core CPU host time-slices the mesh — partitioning "
+                  f"overhead row, NOT a speedup claim; logits bit-exact "
+                  f"(test_sharded_decode.py); {platform}")},
+        {"metric": "decode_sharded_hbm_params_per_chip",
+         "value": round(shard_pb / 1e6, 3), "unit": "MB",
+         "note": (f"weights resident per chip on the (2,4) mesh vs "
+                  f"{single_pb / 1e6:.3f} MB single-chip "
+                  f"({single_pb / max(1, shard_pb):.2f}x headroom; "
+                  f"wo/w_down stay replicated for bit-exactness); "
+                  f"{platform}")},
+        {"metric": "decode_sharded_hbm_kv_per_chip",
+         "value": round(shard_kb / 1e6, 3), "unit": "MB",
+         "note": (f"paged KV pool bytes per chip on the (2,4) mesh vs "
+                  f"{single_kb / 1e6:.3f} MB single-chip "
+                  f"({single_kb / max(1, shard_kb):.2f}x: kv-head dim "
+                  f"shards over the model axis); {platform}")},
+    ]
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
-        "--sections", default="engine,serve,shared_prefix,overload,paged",
+        "--sections",
+        default="engine,serve,shared_prefix,overload,paged,sharded",
         help="comma-set of row groups to (re)measure: engine, serve, "
-             "shared_prefix, overload, paged. Only the selected groups' "
-             "rows are replaced in BENCH_SERVE.json; the rest are "
-             "preserved.")
+             "shared_prefix, overload, paged, sharded. Only the "
+             "selected groups' rows are replaced in BENCH_SERVE.json; "
+             "the rest are preserved.")
     parser.add_argument(
         "--model", default=None,
         help="llama preset override (default: debug if --quick else "
@@ -565,6 +651,16 @@ def main() -> None:
              "(rows are annotated with the platform)")
     args = parser.parse_args()
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
+
+    if "sharded" in sections:
+        # The sharded rows span an 8-device mesh; on a CPU host that
+        # means the forced virtual devices (must be set before jax
+        # initializes its backend).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     import jax
 
@@ -592,6 +688,8 @@ def main() -> None:
         rows += overload_rows(params, cfg, args.quick, plat_note)
     if "paged" in sections:
         rows += paged_rows(args.quick, f"{platform} backend")
+    if "sharded" in sections:
+        rows += sharded_rows(args.quick, f"{platform} backend")
     if "serve" in sections:
         ray_tpu.init(num_cpus=4)
         try:

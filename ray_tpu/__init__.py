@@ -42,6 +42,9 @@ from ray_tpu.core.placement import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroup,
     PlacementGroupSchedulingStrategy,
+    SubSliceReservation,
+    cluster_topology,
     placement_group,
     remove_placement_group,
+    reserve_subslice,
 )

@@ -129,6 +129,12 @@ REACTOR_ROOT_NAME_PATTERNS = ("_on_readable", "_on_writable")
 # ---------------------------------------------------- jit decorators
 
 JIT_DOTTED_SUFFIXES = ("jit", "pjit", "shard_map")
+# A wrapping call carrying these kwargs is a trace scope regardless of
+# what the wrapper is NAMED (aliased imports, partial-built helpers,
+# mesh-context jit factories): in/out shardings only mean anything to a
+# jit-family compiler, so the wrapped function's body runs under trace
+# and every jit hazard (host syncs, tracer branches, retraces) applies.
+JIT_SHARDING_KWARGS = frozenset({"in_shardings", "out_shardings"})
 
 # Host-sync method calls that are always wrong under trace.
 TRACE_SYNC_METHODS = {

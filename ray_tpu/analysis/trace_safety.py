@@ -46,6 +46,14 @@ def _is_jit_dotted(d: Optional[str]) -> bool:
         d.split(".")[-1] in rules.JIT_DOTTED_SUFFIXES)
 
 
+def _has_sharding_kwargs(call: ast.Call) -> bool:
+    """True when a call carries in_shardings/out_shardings: a jit-family
+    wrapper whatever its name (aliased import, mesh-jit helper) — the
+    wrapped function is a trace scope (same hazards as plain jit)."""
+    return any(kw.arg in rules.JIT_SHARDING_KWARGS
+               for kw in call.keywords)
+
+
 def _jit_static_params(dec: ast.expr) -> Tuple[bool, Set[int], Set[str]]:
     """(is_jit, static positions, static names) for a decorator expr."""
     if _is_jit_dotted(dotted(dec)):
@@ -55,10 +63,11 @@ def _jit_static_params(dec: ast.expr) -> Tuple[bool, Set[int], Set[str]]:
         statics_pos: Set[int] = set()
         statics_name: Set[str] = set()
         target = None
-        if _is_jit_dotted(d):
+        if _is_jit_dotted(d) or _has_sharding_kwargs(dec):
             target = dec
         elif d is not None and d.split(".")[-1] == "partial" and dec.args \
-                and _is_jit_dotted(dotted(dec.args[0])):
+                and (_is_jit_dotted(dotted(dec.args[0]))
+                     or _has_sharding_kwargs(dec)):
             target = dec
         if target is not None:
             for kw in target.keywords:
@@ -85,12 +94,14 @@ def _find_jit_functions(graph: CallGraph
             is_jit, pos, names = _jit_static_params(dec)
             if is_jit:
                 marked[fqn] = (pos, names)
-    # wrapping form: anything(jax.jit(f)) / x = jit(self._step)
+    # wrapping form: anything(jax.jit(f)) / x = jit(self._step), plus
+    # wrappers identified only by their in_shardings/out_shardings
+    # kwargs (aliased or helper-built jit — the GSPMD serving idiom).
     for fqn, info in graph.functions.items():
         for node in ast.walk(info.node):
-            if not (isinstance(node, ast.Call)
-                    and _is_jit_dotted(graph.resolved_dotted(node, info))
-                    and node.args):
+            if not (isinstance(node, ast.Call) and node.args
+                    and (_is_jit_dotted(graph.resolved_dotted(node, info))
+                         or _has_sharding_kwargs(node))):
                 continue
             arg = node.args[0]
             callee = None

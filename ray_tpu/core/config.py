@@ -244,6 +244,18 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "decode steps — a long admission can stall active streams for at "
         "most ONE chunk instead of its whole prefill. 0 disables "
         "(monolithic prefill at admission, pre-chunking behavior)."),
+    "decode_mesh_shape": (str, "",
+        "Default (batch, model) decode mesh for DecodeEngines that are "
+        "not given an explicit mesh_shape, e.g. '2x4': the engine spans "
+        "that many devices with GSPMD-sharded weights/KV (NamedSharding "
+        "over a named 2-D mesh; sharded logits are bit-exact vs the "
+        "single-chip path). Empty = single-chip engines (pre-mesh "
+        "behavior). Deployment-level mesh_shape overrides per app."),
+    "slice_affinity_enabled": (bool, True,
+        "Serve routers prefer replicas on the caller's own pod slice "
+        "(ICI-local) over cross-slice replicas when both can take the "
+        "request; load still wins past saturation. No-op when nodes "
+        "advertise no slice topology."),
     "prefix_affinity_enabled": (bool, True,
         "Serve routers hash a request's leading token buckets and prefer "
         "the replica advertising that prefix in its cache (falling back "

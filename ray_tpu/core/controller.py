@@ -45,12 +45,16 @@ DEAD = "DEAD"
 
 class NodeRecord:
     def __init__(self, node_id: NodeID, addr: Addr, resources: Dict[str, float],
-                 labels: Dict[str, str]):
+                 labels: Dict[str, str],
+                 slice_info: Optional[Dict[str, Any]] = None):
         self.node_id = node_id
         self.addr = tuple(addr)
         self.total = dict(resources)
         self.available = dict(resources)
         self.labels = dict(labels)
+        # Advertised pod-slice membership (topology.SliceInfo.to_dict()):
+        # feeds the controller's TopologyView for mesh-aware placement.
+        self.slice_info = dict(slice_info) if slice_info else None
         self.queue_len = 0
         self.last_heartbeat = time.monotonic()
         self.alive = True
@@ -65,6 +69,7 @@ class NodeRecord:
             "resources": dict(self.total),
             "available": dict(self.available),
             "labels": dict(self.labels),
+            "slice": self.slice_info,
             "alive": self.alive,
             "queue_len": self.queue_len,
         }
@@ -135,6 +140,13 @@ class Controller:
         # shape key -> (resources, ts, labels-or-None): unmet scheduling
         # demand, labels carried so the autoscaler can match node types.
         self._pending_demand: Dict[tuple, tuple] = {}
+        # Pod-slice topology view: nodes advertise their slice at
+        # registration; mesh-parallel serve replicas reserve ICI-
+        # contiguous sub-slices through it (never a fragment straddling
+        # two slices). Internally locked — accessed outside self._lock.
+        from ray_tpu.core.topology import TopologyView
+
+        self._topology = TopologyView()
         self._clients = ClientPool()
         self._stopped = threading.Event()
         # Long-poll notification hub (reference: src/ray/pubsub/publisher.h
@@ -170,6 +182,9 @@ class Controller:
                 "get_placement_group": self.get_placement_group,
                 "remove_placement_group": self.remove_placement_group,
                 "cluster_resources": self.cluster_resources,
+                "reserve_subslice": self.reserve_subslice,
+                "release_subslice": self.release_subslice,
+                "topology_state": self.topology_state,
                 "autoscaler_state": self.autoscaler_state,
                 "push_metrics": self.push_metrics,
                 "list_metrics": self.list_metrics,
@@ -360,10 +375,17 @@ class Controller:
 
     def register_node(self, node_id_bytes: bytes, addr: Addr,
                       resources: Dict[str, float],
-                      labels: Dict[str, str]) -> None:
+                      labels: Dict[str, str],
+                      slice_info: Optional[Dict[str, Any]] = None) -> None:
         node_id = NodeID(node_id_bytes)
         with self._lock:
-            self._nodes[node_id] = NodeRecord(node_id, addr, resources, labels)
+            self._nodes[node_id] = NodeRecord(node_id, addr, resources,
+                                              labels, slice_info)
+        if slice_info:
+            from ray_tpu.core.topology import SliceInfo
+
+            self._topology.register(node_id.hex(),
+                                    SliceInfo.from_dict(slice_info))
 
     def unregister_node(self, node_id_bytes: bytes) -> None:
         node_id = NodeID(node_id_bytes)
@@ -418,6 +440,46 @@ class Controller:
                     resmath.credit(total, rec.total)
             return total
 
+    # ------------------------------------------------- pod-slice topology
+
+    def reserve_subslice(self, owner: str, chips: int,
+                         shape: Optional[List[int]] = None
+                         ) -> Optional[Dict[str, Any]]:
+        """Reserve an ICI-contiguous sub-slice for ``owner`` (a replica
+        id). ``shape`` pins the chip-grid rectangle (a replica's mesh
+        shape); bare ``chips`` folds to the most-square block. Returns
+        the assignment (slice, origin, shape, hosting nodes) or None
+        when no SINGLE slice can host it contiguously — a request that
+        would straddle two slices is refused, never fragmented.
+
+        This reserves the GRID only (which chips, contiguously). The
+        scalar accounting rides the normal lease path: the actor that
+        spans the sub-slice requests ``chips`` / ``slice:<id>``
+        resources (resources.chip_resources), which the hosting node's
+        own availability tracks — controller-side scalar deduction here
+        would just be overwritten by the node's next heartbeat."""
+        sub = self._topology.reserve(
+            owner, chips=chips,
+            shape=tuple(shape) if shape else None)
+        if sub is None:
+            # Unmet topology demand feeds the same autoscaler signal as
+            # unplaceable tasks: a provider that can add slices sees it.
+            shape_key = ((resmath.CHIPS, float(chips)),)
+            with self._lock:
+                self._pending_demand[shape_key] = (
+                    {resmath.CHIPS: float(chips)}, time.monotonic(), None)
+            return None
+        return sub
+
+    def release_subslice(self, reservation_id: str) -> bool:
+        """Release a sub-slice reservation (idempotent)."""
+        return self._topology.release(reservation_id)
+
+    def topology_state(self) -> Dict[str, Any]:
+        """Operator view: every advertised slice's grid, free chips,
+        fragmentation, and live reservations."""
+        return self._topology.state()
+
     def _health_loop(self) -> None:
         period = config.heartbeat_period_s
         threshold = config.health_check_failure_threshold * period
@@ -454,6 +516,10 @@ class Controller:
     def _on_node_dead(self, node_id: NodeID) -> None:
         """Fail (and maybe restart) actors on a dead node (reference:
         GcsActorManager node-death handling, gcs_actor_manager.h:88)."""
+        # Topology: a slice whose last live host died drops from the
+        # view with its sub-slice reservations (the replicas holding
+        # them died with the hosts; serve's reconcile re-reserves).
+        self._topology.node_dead(node_id.hex())
         with self._lock:
             affected = [rec.actor_id for rec in self._actors.values()
                         if rec.node_id == node_id and rec.state == ALIVE]

@@ -273,6 +273,25 @@ class Node:
         if resources is None:
             resources = {"CPU": float(os.cpu_count() or 1)}
         resources.setdefault("CPU", float(os.cpu_count() or 1))
+        # Pod-slice topology: a node on a TPU slice (or opted into a
+        # virtual slice via RAY_TPU_VIRTUAL_SLICE on the dev box)
+        # advertises its slice shape at registration and exposes the
+        # chip count as scalar `chips` / `slice:<id>` resources — the
+        # controller's TopologyView schedules ICI-contiguous sub-slices
+        # against the same totals.
+        from ray_tpu.core import topology as topo
+
+        # Virtual slices key on the NODE id: in the multi-node-in-one-
+        # machine fixture every node shares the host string, and two
+        # nodes must advertise two distinct 8-chip slices, not co-own
+        # one grid. Real slices key on pod metadata instead.
+        self.slice_info = topo.detect_slice(resources,
+                                            self.node_id.hex()[:12])
+        if self.slice_info is not None:
+            per_host = self.slice_info.chips / self.slice_info.hosts
+            resources.setdefault(resmath.CHIPS, per_host)
+            resources.setdefault(
+                resmath.slice_key(self.slice_info.slice_id), per_host)
         self.total_resources = dict(resources)
         self.labels = dict(labels or {})
         self._extra_env = dict(env or {})
@@ -349,7 +368,8 @@ class Node:
         self._controller = ReconnectingClient(self.controller_addr)
         self._controller.call(
             "register_node", self.node_id.binary(), self.address,
-            self.total_resources, self.labels)
+            self.total_resources, self.labels,
+            self.slice_info.to_dict() if self.slice_info else None)
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, name="node-heartbeat", daemon=True)
         self._heartbeat_thread.start()
@@ -1033,7 +1053,9 @@ class Node:
                     # follow with a full state refresh.
                     self._controller.call(
                         "register_node", self.node_id.binary(), self.address,
-                        self.total_resources, self.labels, timeout=5.0)
+                        self.total_resources, self.labels,
+                        self.slice_info.to_dict() if self.slice_info
+                        else None, timeout=5.0)
                     last_sent = None
             except Exception:
                 # Miss enough beats and the head declares this node dead

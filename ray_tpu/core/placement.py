@@ -12,6 +12,7 @@ into a scheduler-native mechanism.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -74,6 +75,70 @@ def placement_group(bundles: List[Dict[str, float]],
 def remove_placement_group(pg: PlacementGroup) -> None:
     core = get_core_worker()
     core.controller.call("remove_placement_group", pg.id.binary())
+
+
+# ------------------------------------------------ sub-slice reservations
+#
+# The mesh-parallel serving primitive (ROADMAP #1): a GSPMD replica does
+# not want "n chips somewhere" — it wants an ICI-CONTIGUOUS rectangle of
+# ONE slice's chip grid. The controller's TopologyView owns the grids
+# (nodes advertise their slice at registration, core/topology.py); this
+# is the client half.
+
+
+class SubSliceReservation:
+    """A held sub-slice: release it when the replica spanning it dies."""
+
+    def __init__(self, assignment: Dict[str, Any]):
+        self.assignment = dict(assignment)
+
+    @property
+    def reservation_id(self) -> str:
+        return self.assignment["reservation_id"]
+
+    @property
+    def slice_id(self) -> str:
+        return self.assignment["slice_id"]
+
+    @property
+    def chips(self) -> int:
+        return int(self.assignment["chips"])
+
+    @property
+    def nodes(self) -> List[str]:
+        return list(self.assignment.get("nodes", []))
+
+    def release(self) -> bool:
+        core = get_core_worker()
+        return core.controller.call("release_subslice",
+                                    self.reservation_id)
+
+    def __repr__(self):
+        return (f"SubSliceReservation({self.reservation_id!r}, "
+                f"slice={self.slice_id!r}, shape="
+                f"{tuple(self.assignment['shape'])})")
+
+
+def reserve_subslice(chips: int = 0,
+                     shape: Optional[Any] = None,
+                     owner: str = "") -> Optional[SubSliceReservation]:
+    """Reserve a contiguous sub-slice (``shape`` = chip-grid rectangle,
+    e.g. a replica's ``(batch, model)`` mesh footprint; bare ``chips``
+    folds to the most-square block). Returns None when no single
+    advertised slice can host it contiguously — the caller queues or
+    rejects, it never gets a fragment straddling slices."""
+    core = get_core_worker()
+    sub = core.controller.call(
+        "reserve_subslice", owner or f"driver-{os.getpid()}",
+        int(chips), list(shape) if shape is not None else None)
+    return SubSliceReservation(sub) if sub is not None else None
+
+
+def cluster_topology() -> Dict[str, Any]:
+    """Every advertised slice's grid, free chips, fragmentation, and
+    live sub-slice reservations (controller ``topology_state`` RPC)."""
+    core = get_core_worker()
+    return core.controller.call("topology_state")
 
 
 class PlacementGroupSchedulingStrategy:

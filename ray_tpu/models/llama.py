@@ -166,6 +166,21 @@ def param_axes(config: Optional[LlamaConfig] = None) -> Dict[str, Any]:
     }
 
 
+def decode_param_axes(config: Optional[LlamaConfig] = None) -> Dict[str, Any]:
+    """Logical axes for GSPMD *serving* (``sharding.DECODE_RULES``): like
+    :func:`param_axes` but the two row-parallel projections — ``wo`` and
+    ``w_down`` — are fully replicated. Their input dims are CONTRACTED, so
+    sharding them would split a reduction across the mesh and break the
+    decode plane's bit-exactness contract; every other projection shards
+    an output dim (heads/kv_heads/mlp/vocab over "model") and keeps the
+    single-chip reduction order."""
+    axes = param_axes(config)
+    layers = axes["layers"]
+    layers["wo"] = ("layers", None, None, None)
+    layers["w_down"] = ("layers", None, None)
+    return axes
+
+
 def init_params(config: LlamaConfig, key: jax.Array,
                 dtype=jnp.float32) -> Dict[str, Any]:
     """Initialize master params (fp32 by default). Layer params are stacked
@@ -254,6 +269,7 @@ def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
         attn = attention(q, k, v, causal=True, q_offset=q_offset,
                          impl=c.attention_impl)
     attn = checkpoint_name(attn, "attn_out")
+    attn = constrain(attn, ("batch", "length", "attn_heads", "head_dim"))
     out = jnp.einsum("bshd,hde->bse", attn, layer["wo"].astype(h.dtype))
     x = x + constrain(out, ("batch", "length", "act_embed"))
 
@@ -275,7 +291,7 @@ def _decoder_layer(config: LlamaConfig, x, layer, cos, sin, q_offset):
         up = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
     ffn = jax.nn.silu(gate) * up
     ffn = checkpoint_name(ffn, "mlp_hidden")
-    ffn = constrain(ffn, ("batch", "length", "mlp"))
+    ffn = constrain(ffn, ("batch", "length", "mlp_hidden"))
     down = jnp.einsum("bsm,me->bse", ffn, layer["w_down"].astype(h2.dtype))
     return x + constrain(down, ("batch", "length", "act_embed")), jnp.zeros(
         (), jnp.float32)

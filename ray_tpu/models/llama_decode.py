@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rotary import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import constrain
 
 Cache = Dict[str, jax.Array]
 
@@ -93,6 +94,10 @@ def _mlp(layer, x, config: LlamaConfig):
                           layer["w_gate"].astype(h2.dtype))
         up = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
     ffn = jax.nn.silu(gate) * up
+    # Pre-contraction anchor (see llama._decoder_layer): under DECODE
+    # rules this all-gathers the mlp-sharded hidden so the w_down
+    # reduction is never split across the mesh (bit-exactness contract).
+    ffn = constrain(ffn, ("batch", "length", "mlp_hidden"))
     down = jnp.einsum("bsm,me->bse", ffn, layer["w_down"].astype(h2.dtype))
     return x + down
 
@@ -130,6 +135,8 @@ def prefill(params: Dict[str, Any], tokens: jax.Array, cache: Cache,
         h = rms_norm(x, layer["attn_norm"], c.norm_eps)
         _, k, v = _qkv(layer, h, c)
         k = apply_rope(k, cos, sin)
+        k = constrain(k, ("batch", "length", "kv_heads", "head_dim"))
+        v = constrain(v, ("batch", "length", "kv_heads", "head_dim"))
         x, _aux = _decoder_layer(c, x, layer, cos, sin, 0)
         return x, (k.astype(cache["k"].dtype), v.astype(cache["v"].dtype))
 
@@ -188,6 +195,11 @@ def prefill_suffix(params: Dict[str, Any], tokens: jax.Array,
         q, k_new, v_new = _qkv(layer, h, c)  # (B, S, H/KV, D)
         q = apply_rope(q, cos, sin, positions=abs_pos)
         k_new = apply_rope(k_new, cos, sin, positions=abs_pos)
+        q = constrain(q, ("batch", "length", "heads", "head_dim"))
+        k_new = constrain(k_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
+        v_new = constrain(v_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
         k_c = k_c.at[rows[:, None], abs_pos].set(k_new.astype(k_c.dtype))
         v_c = v_c.at[rows[:, None], abs_pos].set(v_new.astype(v_c.dtype))
         qg = q.reshape(B, S, c.n_kv_heads, kv_groups, c.head_dim)
@@ -198,6 +210,7 @@ def prefill_suffix(params: Dict[str, Any], tokens: jax.Array,
         att = jnp.einsum("bkgsc,bckd->bkgsd", probs.astype(v_c.dtype), v_c)
         att = att.transpose(0, 3, 1, 2, 4).reshape(
             B, S, c.n_heads, c.head_dim).astype(x.dtype)
+        att = constrain(att, ("batch", "length", "attn_heads", "head_dim"))
         out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
         x = x + out
         x = _mlp(layer, x, c)
@@ -241,6 +254,11 @@ def decode_step(params: Dict[str, Any], cache: Cache, tokens: jax.Array,
         q, k_new, v_new = _qkv(layer, h, c)      # (B, 1, H/KV, D)
         q = apply_rope(q, cos, sin, positions=pos[:, None])
         k_new = apply_rope(k_new, cos, sin, positions=pos[:, None])
+        q = constrain(q, ("batch", "length", "heads", "head_dim"))
+        k_new = constrain(k_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
+        v_new = constrain(v_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
         k_c = k_c.at[rows, pos].set(k_new[:, 0].astype(k_c.dtype))
         v_c = v_c.at[rows, pos].set(v_new[:, 0].astype(v_c.dtype))
         # GQA attention against the cache at KV-head width: q grouped as
@@ -252,6 +270,7 @@ def decode_step(params: Dict[str, Any], cache: Cache, tokens: jax.Array,
         probs = jax.nn.softmax(scores, axis=-1)
         att = jnp.einsum("bkgc,bckd->bkgd", probs.astype(v_c.dtype), v_c)
         att = att.reshape(B, 1, c.n_heads, c.head_dim).astype(x.dtype)
+        att = constrain(att, ("batch", "length", "attn_heads", "head_dim"))
         out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
         x = x + out
         x = _mlp(layer, x, c)
@@ -398,6 +417,11 @@ def paged_prefill_suffix(params: Dict[str, Any], tokens: jax.Array,
         q, k_new, v_new = _qkv(layer, h, c)  # (B, S, H/KV, D)
         q = apply_rope(q, cos, sin, positions=abs_pos)
         k_new = apply_rope(k_new, cos, sin, positions=abs_pos)
+        q = constrain(q, ("batch", "length", "heads", "head_dim"))
+        k_new = constrain(k_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
+        v_new = constrain(v_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
         k_p = k_p.at[pages, offs].set(k_new.astype(k_p.dtype))
         v_p = v_p.at[pages, offs].set(v_new.astype(v_p.dtype))
         # Gather AFTER the scatter so the suffix's own causal K/V is in
@@ -413,6 +437,7 @@ def paged_prefill_suffix(params: Dict[str, Any], tokens: jax.Array,
         att = jnp.einsum("bkgsc,bckd->bkgsd", probs.astype(v_c.dtype), v_c)
         att = att.transpose(0, 3, 1, 2, 4).reshape(
             B, S, c.n_heads, c.head_dim).astype(x.dtype)
+        att = constrain(att, ("batch", "length", "attn_heads", "head_dim"))
         out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
         x = x + out
         x = _mlp(layer, x, c)
@@ -463,6 +488,11 @@ def paged_decode_step(params: Dict[str, Any], pool: Cache,
         q, k_new, v_new = _qkv(layer, h, c)       # (B, 1, H/KV, D)
         q = apply_rope(q, cos, sin, positions=pos[:, None])
         k_new = apply_rope(k_new, cos, sin, positions=pos[:, None])
+        q = constrain(q, ("batch", "length", "heads", "head_dim"))
+        k_new = constrain(k_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
+        v_new = constrain(v_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
         k_p = k_p.at[page, off].set(k_new[:, 0].astype(k_p.dtype))
         v_p = v_p.at[page, off].set(v_new[:, 0].astype(v_p.dtype))
         k_c = k_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
@@ -474,6 +504,7 @@ def paged_decode_step(params: Dict[str, Any], pool: Cache,
         probs = jax.nn.softmax(scores, axis=-1)
         att = jnp.einsum("bkgc,bckd->bkgd", probs.astype(v_c.dtype), v_c)
         att = att.reshape(B, 1, c.n_heads, c.head_dim).astype(x.dtype)
+        att = constrain(att, ("batch", "length", "attn_heads", "head_dim"))
         out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
         x = x + out
         x = _mlp(layer, x, c)
@@ -506,6 +537,62 @@ def paged_decode_chunk(params: Dict[str, Any], pool: Cache,
     (pool, lengths, _), toks = jax.lax.scan(
         body, (pool, lengths, tokens), None, length=k)
     return toks, pool, lengths
+
+
+# ------------------------------------------------- GSPMD serving (mesh)
+#
+# One replica spanning a pod (sub-)slice instead of one chip: weights,
+# KV state and activations carry NamedShardings over the named 2-D
+# ``decode_mesh`` (("batch", "model")) and every program above is jitted
+# with in/out shardings — XLA inserts the collectives (no hand-rolled
+# ring/all-reduce anywhere in the serve plane). The sharding rules
+# (``parallel.sharding.DECODE_RULES``) never partition a contraction
+# dim, so sharded logits are BIT-EXACT vs the single-chip programs:
+# model size scales with the "model" axis (HBM per chip drops), slot
+# count with the "batch" axis, and correctness is byte-identical.
+
+
+def decode_shardings(config: LlamaConfig, mesh) -> Dict[str, Any]:
+    """Sharding bundle for a decode replica on ``mesh`` (a
+    ``parallel.mesh.decode_mesh``): NamedShardings for the params pytree,
+    the contiguous KV cache, the paged pool, the contiguous prefix pool,
+    and host-facing (replicated) outputs, plus the resolved rule table.
+
+    ``cache["length"]`` stays replicated: it is a few bytes, every
+    decode step scatters it at a traced slot index, and the host reads
+    it back for admission accounting."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ray_tpu.models.llama import decode_param_axes
+    from ray_tpu.parallel.sharding import (decode_rules, spec_for,
+                                           tree_shardings)
+
+    rules = decode_rules(config, mesh)
+
+    def ns(*axes):
+        return NamedSharding(mesh, spec_for(axes, rules))
+
+    kv_row = ("layers", "batch", None, "kv_heads", "head_dim")
+    pool_row = ("layers", None, None, "kv_heads", "head_dim")
+    return {
+        "rules": rules,
+        "params": tree_shardings(mesh, decode_param_axes(config), rules),
+        "cache": {"k": ns(*kv_row), "v": ns(*kv_row),
+                  "length": NamedSharding(mesh, PartitionSpec())},
+        "pool": {"k": ns(*pool_row), "v": ns(*pool_row),
+                 "length": NamedSharding(mesh, PartitionSpec())},
+        "prefix_pool": {"k": ns(*pool_row), "v": ns(*pool_row)},
+        "replicated": NamedSharding(mesh, PartitionSpec()),
+    }
+
+
+def shard_decode_state(params: Dict[str, Any], config: LlamaConfig,
+                       mesh) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Device-put ``params`` onto ``mesh`` with the decode shardings.
+    Returns ``(sharded_params, shardings_bundle)`` — the engine commits
+    the weights once at construction; the jitted programs inherit the
+    committed input shardings and pin their outputs with the bundle."""
+    shardings = decode_shardings(config, mesh)
+    return jax.device_put(params, shardings["params"]), shardings
 
 
 def _sample(logits: jax.Array, temperature: float, key) -> jax.Array:

@@ -125,6 +125,41 @@ def single_device_mesh() -> Mesh:
     return MeshSpec(data=1, fsdp=1).build(jax.devices()[:1])
 
 
+# Serving meshes use their own 2-axis naming (SNIPPETS [1]: ``batch`` x
+# ``model``): a decode replica has no optimizer state, so the train-side
+# data/fsdp/tensor split collapses to "which slots" x "which shard of the
+# weights". Kept separate from AXES so train and serve rule tables can't
+# cross-contaminate.
+DECODE_AXES = ("batch", "model")
+
+
+def decode_mesh(shape: Tuple[int, int],
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Named 2-D serving mesh: ``shape = (batch, model)`` over the first
+    ``batch * model`` addressable devices (or the explicit ``devices`` a
+    sub-slice reservation mapped). ICI ordering comes from
+    ``mesh_utils.create_device_mesh`` on real slices; virtual/CPU devices
+    fall back to a plain reshape, like :meth:`MeshSpec.build`."""
+    b, m = int(shape[0]), int(shape[1])
+    if b < 1 or m < 1:
+        raise ValueError(f"mesh shape must be positive, got {shape}")
+    if devices is None:
+        devices = jax.devices()[:b * m]
+    devices = np.asarray(devices)
+    if devices.size != b * m:
+        raise ValueError(
+            f"decode mesh {b}x{m} needs {b * m} devices, have "
+            f"{devices.size}")
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            (b, m), devices=list(devices.flat))
+    except Exception:
+        dev_array = devices.reshape((b, m))
+    return Mesh(dev_array, DECODE_AXES)
+
+
 # Topology presets keyed by (pod type prefix, device count) intent. These are
 # starting points, not laws: the scaling-book recipe is pick mesh -> profile
 # -> iterate.

@@ -41,6 +41,15 @@ DEFAULT_RULES: Rules = {
     "kv_heads": "tensor",
     "head_dim": None,
     "mlp": "tensor",
+    # Pre-contraction anchors: the attention output entering the wo
+    # projection and the ffn hidden entering w_down. Under the train rules
+    # these equal what propagation already picks (tensor-sharded — the
+    # Megatron row-parallel input), so constraining them is free; the
+    # DECODE rules map them to None instead, forcing an all-gather BEFORE
+    # the contraction so no reduction is ever split across the mesh (the
+    # bit-exactness contract of sharded serving).
+    "attn_heads": "tensor",
+    "mlp_hidden": "tensor",
     "experts": "expert",
     "expert": "expert",      # stacked per-expert weights (MoE)
     "expert_dim": None,      # router output dim (E as a feature axis)
@@ -86,6 +95,58 @@ def shard_tree(tree: Any, shardings: Any):
     return jax.tree.map(jax.device_put, tree, shardings)
 
 
+# Serving (GSPMD model-parallel decode) rules over the 2-axis
+# ``decode_mesh`` (("batch", "model"), parallel.mesh.DECODE_AXES). The
+# load-bearing difference from DEFAULT_RULES: **no contraction dimension
+# is ever partitioned.** Output dims shard (heads/kv_heads/mlp/vocab over
+# "model", slots over "batch"); the pre-contraction anchors
+# (attn_heads/mlp_hidden) replicate, so XLA inserts all-gathers instead
+# of psums and every output element is produced by the exact reduction
+# order of the single-chip program — sharded decode logits are BIT-EXACT
+# vs the single-chip engine (the serve plane's correctness contract; the
+# cost is that wo / w_down stay replicated, see
+# ``llama.decode_param_axes``).
+DECODE_RULES: Rules = {
+    "batch": "batch",
+    "length": None,
+    "vocab": "model",
+    "embed": None,         # contracted by every projection: never shard
+    "act_embed": None,
+    "heads": "model",
+    "kv_heads": "model",
+    "head_dim": None,
+    "mlp": "model",
+    "attn_heads": None,    # all-gather before the wo contraction
+    "mlp_hidden": None,    # all-gather before the w_down contraction
+    "layers": None,
+    "norm": None,
+    "patch": None,
+}
+
+
+def decode_rules(config, mesh: Mesh) -> Rules:
+    """DECODE_RULES specialized to a config + mesh: a dim only shards
+    over "model" when its size divides the axis (an indivisible head or
+    vocab dim replicates instead of forcing GSPMD's padded sharding —
+    padding is correct but wastes the ragged shard's HBM and compute)."""
+    model = mesh.shape.get("model", 1)
+    rules = dict(DECODE_RULES)
+    if model > 1:
+        for axis, size in (("heads", config.n_heads),
+                           ("kv_heads", config.n_kv_heads),
+                           ("mlp", config.mlp_dim),
+                           ("vocab", config.vocab_size)):
+            if size % model:
+                rules[axis] = None
+        # GQA reshape constraint: q's heads axis regroups as
+        # (kv_heads, groups) inside attention, which only stays a local
+        # reshape when the kv split is at least as fine as the head
+        # split — otherwise replicate heads with the kv cache.
+        if rules["kv_heads"] is None:
+            rules["heads"] = None
+    return rules
+
+
 _ctx = threading.local()
 
 
@@ -104,13 +165,32 @@ def axis_rules(mesh: Mesh, rules: Optional[Rules] = None):
 
 def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]]):
     """Apply a GSPMD sharding constraint by logical axis names; no-op when
-    no axis_rules context is active (single-device paths, tests)."""
+    no axis_rules context is active (single-device paths, tests).
+
+    A mesh axis that does not divide the tensor's actual dim is dropped
+    for that dim (replicate instead): jaxlib 0.4.37 rejects uneven
+    shardings outright, and the decode plane traces the same constraint
+    sites at many batch sizes (admission waves of 1..slots rows) — a
+    2-row wave on an 8-way batch axis must replicate, not crash."""
     ctx = getattr(_ctx, "value", None)
     if ctx is None:
         return x
     mesh, rules = ctx
+    spec = spec_for(logical_axes, rules)
+    parts = list(spec) + [None] * (x.ndim - len(spec))
+    for i, part in enumerate(parts):
+        if part is None:
+            continue
+        axes = part if isinstance(part, tuple) else (part,)
+        size = 1
+        for ax in axes:
+            size *= mesh.shape.get(ax, 1)
+        if size and x.shape[i] % size:
+            parts[i] = None
+    while parts and parts[-1] is None:
+        parts.pop()
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, spec_for(logical_axes, rules)))
+        x, NamedSharding(mesh, P(*parts)))
 
 
 def current_mesh() -> Optional[Mesh]:

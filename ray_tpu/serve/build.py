@@ -15,6 +15,7 @@ Schema (subset of the reference's, same shape)::
         route_prefix: /summarize        # optional
         num_replicas: 2                 # optional override
         max_ongoing_requests: 8         # optional override
+        mesh_shape: [2, 4]              # optional: chips per replica
         init_args: []                   # optional (unbound deployments)
         init_kwargs: {}
 
@@ -67,7 +68,8 @@ def deploy_config(path_or_dict: Union[str, Dict[str, Any]],
                 f"expected a @serve.deployment object")
         overrides = {k: app_cfg[k] for k in
                      ("num_replicas", "max_ongoing_requests",
-                      "autoscaling_config") if k in app_cfg}
+                      "autoscaling_config", "mesh_shape")
+                     if k in app_cfg}
         if isinstance(overrides.get("autoscaling_config"), dict):
             from ray_tpu.serve.deployment import AutoscalingConfig
 

@@ -29,10 +29,14 @@ SNAPSHOT_CHANNEL = "serve_routes"
 
 
 class ReplicaRecord:
-    def __init__(self, handle, replica_id: str):
+    def __init__(self, handle, replica_id: str,
+                 sub_slice: Optional[Dict[str, Any]] = None):
         self.handle = handle
         self.replica_id = replica_id
         self.last_stats: Dict[str, Any] = {}
+        # Sub-slice assignment a mesh-parallel replica spans (controller
+        # ``reserve_subslice`` result): released when the replica dies.
+        self.sub_slice = sub_slice
         self.created = time.monotonic()
 
 
@@ -141,27 +145,94 @@ class ServeController:
     def _settle(self, rec: DeploymentRecord) -> List[ReplicaRecord]:
         """Converge the replica count toward target under rec.lock.
         Returns the replicas a downscale removed — the caller kills them
-        after releasing the lock."""
+        after releasing the lock. A replica that cannot be PLACED (no
+        ICI-contiguous sub-slice free for its mesh) stops the upscale:
+        the deployment stays below target and the reconcile loop retries
+        when topology frees up — it is never placed on a fragment."""
         target = self._target_replicas(rec)
         doomed: List[ReplicaRecord] = []
         while len(rec.replicas) < target:
-            self._add_replica(rec)
+            if not self._add_replica(rec):
+                break
         while len(rec.replicas) > target:
             doomed.append(self._remove_replica(rec))
         return doomed
 
-    def _add_replica(self, rec: DeploymentRecord) -> None:
+    @staticmethod
+    def _mesh_shape(rec: DeploymentRecord) -> Optional[tuple]:
+        ms = rec.cfg.get("mesh_shape")
+        return tuple(int(x) for x in ms) if ms else None
+
+    @staticmethod
+    def _mesh_chips(rec: DeploymentRecord) -> int:
+        ms = ServeController._mesh_shape(rec)
+        return ms[0] * ms[1] if ms else 1
+
+    def _add_replica(self, rec: DeploymentRecord) -> bool:
         from ray_tpu.serve.replica import ReplicaActor
 
+        replica_id = f"{rec.name}#{rec.next_replica_ord}"
+        mesh_shape = self._mesh_shape(rec)
+        sub = None
+        if mesh_shape is not None:
+            # Mesh-parallel replica: reserve an ICI-contiguous sub-slice
+            # BEFORE spawning. A refusal (None) means no single slice
+            # can host the mesh — the replica queues (reconcile retries)
+            # rather than spawning on a fragment straddling slices.
+            from ray_tpu.core.runtime import get_core_worker
+
+            chips = mesh_shape[0] * mesh_shape[1]
+            try:
+                sub = get_core_worker().controller.call(
+                    "reserve_subslice", replica_id, chips,
+                    list(mesh_shape))
+            except Exception:
+                sub = None  # head unreachable counts as no capacity
+            if sub is None:
+                log_every(f"serve.subslice.{rec.name}", 5.0, logger,
+                          "no contiguous %sx%s sub-slice for replica %s "
+                          "of %r; deployment stays below target until "
+                          "topology frees", mesh_shape[0], mesh_shape[1],
+                          replica_id, rec.name)
+                return False
         actor_cls = ray_tpu.remote(ReplicaActor)
         opts = dict(rec.cfg.get("actor_options") or {})
         opts.setdefault("max_concurrency",
                         rec.cfg.get("max_ongoing_requests", 8))
-        replica_id = f"{rec.name}#{rec.next_replica_ord}"
+        init_kwargs = rec.init_kwargs
+        if sub is not None:
+            from ray_tpu.core import resources as resmath
+            from ray_tpu.core.placement import (
+                NodeAffinitySchedulingStrategy)
+
+            # The scalar accounting half of the reservation: the actor
+            # lease holds chips/slice:<id> against the hosting node, so
+            # vector scheduling and the topology grid agree.
+            res = dict(opts.get("resources") or {})
+            for k, v in resmath.chip_resources(
+                    sub["chips"], sub["slice_id"]).items():
+                res.setdefault(k, v)
+            opts["resources"] = res
+            opts.setdefault("scheduling_strategy",
+                            NodeAffinitySchedulingStrategy(
+                                sub["nodes"][0]))
+            if "mesh_shape" not in (init_kwargs or {}):
+                init_kwargs = dict(init_kwargs or {})
+                init_kwargs["mesh_shape"] = tuple(mesh_shape)
         rec.next_replica_ord += 1
         handle = actor_cls.options(**opts).remote(
-            rec.cls_blob, rec.init_args, rec.init_kwargs)
-        rec.replicas.append(ReplicaRecord(handle, replica_id))
+            rec.cls_blob, rec.init_args, init_kwargs)
+        rec.replicas.append(ReplicaRecord(handle, replica_id, sub))
+        if sub is not None:
+            try:
+                # Advisory push (fire-and-forget): the replica reports
+                # its sub-slice back through replica_metrics.
+                handle.set_topology.remote(sub)
+            except Exception:
+                log_every("serve.set_topology", 10.0, logger,
+                          "pushing sub-slice to replica %s failed",
+                          replica_id, exc_info=True)
+        return True
 
     def _remove_replica(self, rec: DeploymentRecord,
                         index: int = -1) -> ReplicaRecord:
@@ -178,6 +249,26 @@ class ServeController:
             # so a systematic kill failure still surfaces.
             log_every("serve.kill_replica", 10.0, logger,
                       "kill of replica %s failed", replica.replica_id,
+                      exc_info=True)
+        self._release_subslice(replica)
+
+    def _release_subslice(self, replica: ReplicaRecord) -> None:
+        """Return a dead/downscaled replica's sub-slice to the topology
+        view (idempotent; a leaked reservation would strand its chips
+        until the hosting node dies)."""
+        sub = replica.sub_slice
+        if sub is None:
+            return
+        replica.sub_slice = None
+        from ray_tpu.core.runtime import get_core_worker
+
+        try:
+            get_core_worker().controller.call(
+                "release_subslice", sub["reservation_id"])
+        except Exception:
+            log_every("serve.release_subslice", 10.0, logger,
+                      "releasing sub-slice %s of replica %s failed",
+                      sub.get("reservation_id"), replica.replica_id,
                       exc_info=True)
 
     def _drain(self, rec: DeploymentRecord) -> None:
@@ -196,7 +287,13 @@ class ServeController:
                 {"actor_id": r.handle.actor_id.binary(),
                  "replica_id": r.replica_id,
                  "models": r.last_stats.get("models", []),
-                 "prefixes": r.last_stats.get("prefixes", [])}
+                 "prefixes": r.last_stats.get("prefixes", []),
+                 # Topology in the routing snapshot: routers prefer
+                 # ICI-local (same-slice) replicas without any
+                 # controller round-trip on the request path.
+                 "slice_id": ((r.sub_slice or {}).get("slice_id")
+                              or r.last_stats.get("slice_id")),
+                 "mesh_shape": r.last_stats.get("mesh_shape")}
                 for r in rec.replicas],
             "max_ongoing_requests": rec.cfg.get("max_ongoing_requests", 8),
             "deleted": rec.deleting,
@@ -254,6 +351,29 @@ class ServeController:
                         for r in rec.replicas),
                     "preempted": sum(r.last_stats.get("preempted", 0)
                                      for r in rec.replicas),
+                    # Topology: total chips this deployment occupies
+                    # (a (2,4)-mesh replica counts 8, a single-chip
+                    # replica 1) and each replica's mesh footprint +
+                    # sub-slice assignment — serve.status() shows WHERE
+                    # every model-parallel replica lives.
+                    "chips_in_use": sum(
+                        r.last_stats.get("chips",
+                                         (r.sub_slice or {}).get("chips",
+                                                                 1))
+                        for r in rec.replicas),
+                    "replica_topology": [
+                        {"replica_id": r.replica_id,
+                         "mesh_shape": r.last_stats.get("mesh_shape"),
+                         "chips": r.last_stats.get(
+                             "chips",
+                             (r.sub_slice or {}).get("chips", 1)),
+                         "slice_id": ((r.sub_slice or {}).get("slice_id")
+                                      or r.last_stats.get("slice_id")),
+                         "sub_slice": ({
+                             "origin": r.sub_slice["origin"],
+                             "shape": r.sub_slice["shape"],
+                         } if r.sub_slice else None)}
+                        for r in rec.replicas],
                 }
                 for name, rec in self._deployments.items()
             }
@@ -565,8 +685,9 @@ class ServeController:
                 changed = True
             while (len(rec.replicas) < self._min_replicas(rec)
                    and not self._stale(rec)):
-                self._add_replica(rec)
-                changed = True
+                if not self._add_replica(rec):
+                    break  # unplaceable (no contiguous sub-slice): retry
+                changed = True  # next tick, never spawn on a fragment
         # Idempotent cleanup kills happen after rec.lock is released —
         # an RPC under the record lock would stall deploy/settle on this
         # deployment (graftlint: lock-held-blocking).
@@ -587,19 +708,21 @@ class ServeController:
                 ongoing = sum(max(r.last_stats.get("ongoing", 0),
                                   r.last_stats.get("load", 0))
                               for r in rec.replicas)
+                # A mesh-parallel replica is chips-many units of
+                # capacity, not one: load per CHIP drives the count, so
+                # an 8-chip replica absorbs 8x the target before a
+                # second replica (and its whole sub-slice) spawns.
+                cap = max(1e-9, auto["target_ongoing_requests"]
+                          * self._mesh_chips(rec))
                 desired = max(auto["min_replicas"],
                               min(auto["max_replicas"],
-                                  math.ceil(ongoing /
-                                            max(1e-9,
-                                                auto[
-                                                    "target_ongoing_requests"
-                                                ]))))
+                                  math.ceil(ongoing / cap)))
                 now = time.monotonic()
                 if (desired > len(rec.replicas)
                         and now - rec.last_scale > auto["upscale_delay_s"]):
-                    self._add_replica(rec)
-                    rec.last_scale = now
-                    changed = True
+                    if self._add_replica(rec):
+                        rec.last_scale = now
+                        changed = True
                 elif (desired < len(rec.replicas)
                         and now - rec.last_scale >
                         auto["downscale_delay_s"]):

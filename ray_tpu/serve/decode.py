@@ -108,7 +108,8 @@ class DecodeEngine:
                  page_tokens: Optional[int] = None,
                  pool_pages: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefix_max_pages: Optional[int] = None):
+                 prefix_max_pages: Optional[int] = None,
+                 mesh_shape=None, mesh=None):
         import jax
 
         from ray_tpu.core.config import config as rt_config
@@ -122,6 +123,38 @@ class DecodeEngine:
         self.slots = slots
         self.capacity = capacity
         self.prefill_bucket = prefill_bucket
+        # ------------------------------------------- GSPMD serving mesh
+        # mesh/mesh_shape turns the engine model-parallel: one replica
+        # spans every device of a (batch, model) decode_mesh. Weights,
+        # KV state and activations carry NamedShardings; the jitted
+        # programs below get out_shardings and trace under the decode
+        # axis rules (parallel.sharding.DECODE_RULES) — XLA inserts all
+        # collectives, and because no contraction dim is ever
+        # partitioned, logits stay BIT-EXACT vs the single-chip engine.
+        if mesh is None:
+            ms = mesh_shape
+            if ms is None and rt_config.decode_mesh_shape:
+                from ray_tpu.core.topology import parse_topology
+
+                ms = parse_topology(rt_config.decode_mesh_shape)
+            if ms is not None:
+                from ray_tpu.parallel.mesh import decode_mesh
+
+                mesh = decode_mesh(tuple(ms))
+        self.mesh = mesh
+        if mesh is not None:
+            batch_ax = mesh.shape.get("batch", 1)
+            if slots % batch_ax:
+                raise ValueError(
+                    f"slots ({slots}) must be a multiple of the mesh "
+                    f"batch axis ({batch_ax}) — per-slot cache rows "
+                    f"shard over it")
+            self.params, self._shardings = ld.shard_decode_state(
+                params, config, mesh)
+            self._rules = self._shardings["rules"]
+        else:
+            self._shardings = None
+            self._rules = None
         # -------------------------------------------------- paged KV pool
         # page_tokens > 0 switches from per-slot monolithic cache rows to
         # a shared device pool of fixed-size pages addressed through
@@ -167,6 +200,18 @@ class DecodeEngine:
         else:
             self._pages = None
             self.cache = ld.init_cache(config, slots, capacity)
+        if self.mesh is not None:
+            # Commit the KV state onto the mesh: the shared page pool
+            # shards its kv-head dim over "model" (HBM-per-chip drops
+            # with the model axis); contiguous rows additionally shard
+            # slots over "batch". ``length`` stays replicated (bytes,
+            # host-read every step).
+            self._cache_sharding = dict(
+                self._shardings["pool"] if self.paged
+                else self._shardings["cache"])
+            self.cache = jax.device_put(self.cache, self._cache_sharding)
+        else:
+            self._cache_sharding = None
         self._free = list(range(slots))
         self._active: Dict[int, _Request] = {}
         self._prefilling: Dict[int, _Request] = {}  # chunked, mid-prefill
@@ -233,6 +278,9 @@ class DecodeEngine:
             import jax.numpy as jnp
             self._pool = {"k": jnp.zeros(pool_shape, c.dtype),
                           "v": jnp.zeros(pool_shape, c.dtype)}
+            if self.mesh is not None:
+                self._pool = jax.device_put(
+                    self._pool, self._shardings["prefix_pool"])
         # Suffix prefills bucket on a finer grid than full prefills: the
         # whole point is that the suffix is short, so padding it back up
         # to prefill_bucket would refund most of the win.
@@ -241,6 +289,21 @@ class DecodeEngine:
         # shared cache. Donating the cache makes the slot insert in-place.
         # Params are ARGUMENTS (not closure captures), or jit would bake
         # the weights into the program as constants.
+        # Mesh engines pin program outputs to the committed shardings
+        # (logits/token outputs replicated for the host sampler, KV
+        # state staying exactly where device_put placed it, so
+        # donation reuses the sharded buffers); single-chip engines
+        # pass no shardings at all — their jaxprs are byte-identical
+        # to pre-mesh builds.
+        if self.mesh is not None:
+            rep = self._shardings["replicated"]
+            cache_out = {"out_shardings": (rep, self._cache_sharding)}
+            pool_ins = {"out_shardings": (
+                self._shardings["prefix_pool"]["k"],
+                self._shardings["prefix_pool"]["v"])}
+        else:
+            cache_out = {}
+            pool_ins = {}
         if self.paged:
             # Paged programs: same (n, bucket) jit-bucket discipline, but
             # admission scatters K/V into pool pages through the wave's
@@ -250,40 +313,58 @@ class DecodeEngine:
             # ``width`` (suffix) = static leading block-table columns the
             # wave touches — cost scales with prefix+suffix, not max
             # context, exactly like the contiguous ``lim``.
-            self._paged_prefill = jax.jit(
+            self._paged_prefill = self._mesh_scoped(jax.jit(
                 self._paged_prefill_impl, static_argnames=("n", "bucket"),
-                donate_argnums=(1,))
-            self._paged_suffix = jax.jit(
+                donate_argnums=(1,), **cache_out))
+            self._paged_suffix = self._mesh_scoped(jax.jit(
                 self._paged_suffix_impl,
                 static_argnames=("n", "bucket", "width"),
-                donate_argnums=(1,))
-            self._decode = jax.jit(self._paged_decode_impl,
-                                   donate_argnums=(1,))
+                donate_argnums=(1,), **cache_out))
+            self._decode = self._mesh_scoped(jax.jit(
+                self._paged_decode_impl, donate_argnums=(1,),
+                **cache_out))
         else:
-            self._prefill_many = jax.jit(
+            self._prefill_many = self._mesh_scoped(jax.jit(
                 self._prefill_many_impl, static_argnames=("n", "bucket"),
-                donate_argnums=(1,))
+                donate_argnums=(1,), **cache_out))
             # Prefix-hit admission: splice pool entries into the wave's
             # slots and prefill only the suffixes — one program per
             # (n, bucket) power-of-two pair, like _prefill_many. Pool
             # insert copies a freshly prefilled slot's leading positions
             # into a pool row.
-            self._prefill_suffix_many = jax.jit(
+            self._prefill_suffix_many = self._mesh_scoped(jax.jit(
                 self._prefill_suffix_many_impl,
-                static_argnames=("n", "bucket"), donate_argnums=(1,))
-            self._pool_insert = jax.jit(self._pool_insert_impl,
-                                        donate_argnums=(1, 2))
-            self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+                static_argnames=("n", "bucket"), donate_argnums=(1,),
+                **cache_out))
+            self._pool_insert = self._mesh_scoped(jax.jit(
+                self._pool_insert_impl, donate_argnums=(1, 2),
+                **pool_ins))
+            self._decode = self._mesh_scoped(jax.jit(
+                self._decode_impl, donate_argnums=(1,), **cache_out))
         # K greedy steps per device call (dispatch amortization); chunking
         # only engages when no admissions are pending and every active
         # request is greedy — sampling and joins stay per-token exact.
         self.decode_chunk = max(1, int(decode_chunk))
-        self._decode_k = jax.jit(
+        self._decode_k = self._mesh_scoped(jax.jit(
             self._paged_decode_chunk_impl if self.paged
             else self._decode_chunk_impl,
-            static_argnames=("k",), donate_argnums=(1,))
+            static_argnames=("k",), donate_argnums=(1,), **cache_out))
         self.steps = 0
         self.tokens_out = 0
+
+    def _mesh_scoped(self, fn):
+        """Mesh engines trace every program inside the decode axis-rules
+        context (``constrain`` sites in the model resolve against it);
+        single-chip engines get the callable back untouched."""
+        if self.mesh is None:
+            return fn
+        from ray_tpu.parallel.sharding import axis_rules
+
+        def scoped(*args, **kwargs):
+            with axis_rules(self.mesh, self._rules):
+                return fn(*args, **kwargs)
+
+        return scoped
 
     # ------------------------------------------------------ jitted bodies
 
@@ -1223,6 +1304,12 @@ class DecodeEngine:
         out = {
             "steps": self.steps,
             "tokens_out": self.tokens_out,
+            # Mesh footprint: chips this engine spans (1 = single-chip).
+            # The serve autoscaler divides load by it — a (2, 4) replica
+            # is 8 chips of capacity, not one replica-unit.
+            "chips": self.mesh.size if self.mesh is not None else 1,
+            "mesh_shape": (list(self.mesh.devices.shape)
+                           if self.mesh is not None else None),
             "active": active,
             "prefilling": prefilling,
             "slots": self.slots,
@@ -1301,13 +1388,15 @@ class LlamaDecodeDeployment:
                  queue_max: Optional[int] = None,
                  kv_page_tokens: Optional[int] = None,
                  kv_pool_pages: Optional[int] = None,
-                 prefill_chunk_tokens: Optional[int] = None):
+                 prefill_chunk_tokens: Optional[int] = None,
+                 mesh_shape=None):
         import jax
 
         from ray_tpu.models import llama
 
         cfg = config or llama.PRESETS[preset]
         self.cfg = cfg
+        self._sub_slice: Optional[Dict[str, Any]] = None
         params = llama.init_params(cfg, jax.random.key(seed))
         self.engine = DecodeEngine(
             params, cfg, slots=slots, capacity=capacity,
@@ -1317,10 +1406,20 @@ class LlamaDecodeDeployment:
             prefix_match_min_tokens=prefix_match_min_tokens,
             queue_max=queue_max,
             page_tokens=kv_page_tokens, pool_pages=kv_pool_pages,
-            prefill_chunk_tokens=prefill_chunk_tokens)
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            mesh_shape=mesh_shape)
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()
+
+    def set_topology(self, assignment: Dict[str, Any]) -> None:
+        """Sub-slice assignment pushed by the serve controller after it
+        reserved this replica's chips: advisory on the virtual CPU mesh
+        (the process's devices ARE the slice), the device-selection
+        input on real multi-host slices. Reported back through
+        ``replica_metrics`` so status/routing see where the replica
+        lives."""
+        self._sub_slice = dict(assignment)
 
     def replica_metrics(self) -> Dict[str, Any]:
         """Replica-reported load + prefix residency + degradation
@@ -1334,7 +1433,14 @@ class LlamaDecodeDeployment:
                                "cancelled": s["cancelled"],
                                "deadline_exceeded": s["deadline_exceeded"],
                                "prefill_backlog_tokens":
-                               s["prefill_backlog_tokens"]}
+                               s["prefill_backlog_tokens"],
+                               "chips": s["chips"],
+                               "mesh_shape": s["mesh_shape"]}
+        sub = getattr(self, "_sub_slice", None)  # tests build bare
+        #   instances around an engine without running __init__
+        if sub is not None:
+            out["sub_slice"] = dict(sub)
+            out["slice_id"] = sub.get("slice_id")
         if self.engine.paged:
             # Page-pool health, controller-aggregated into
             # serve.status(): free/pinned pages and fragmentation say

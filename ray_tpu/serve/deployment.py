@@ -62,20 +62,27 @@ class Deployment:
                  num_replicas: int = 1,
                  ray_actor_options: Optional[Dict] = None,
                  autoscaling_config: Optional[AutoscalingConfig] = None,
-                 max_ongoing_requests: int = 8):
+                 max_ongoing_requests: int = 8,
+                 mesh_shape: Optional[Any] = None):
         self.cls = cls
         self.name = name or cls.__name__
         self.num_replicas = num_replicas
         self.actor_options = ray_actor_options or {}
         self.autoscaling = autoscaling_config
         self.max_ongoing_requests = max_ongoing_requests
+        # (batch, model) decode-mesh footprint per replica: the serve
+        # controller reserves an ICI-contiguous sub-slice of that many
+        # chips before spawning each replica, and the replica's engine
+        # spans it with GSPMD-sharded weights/KV (single replica, many
+        # devices — the model-parallel serving mode).
+        self.mesh_shape = tuple(mesh_shape) if mesh_shape else None
         self._init_args: tuple = ()
         self._init_kwargs: dict = {}
 
     def options(self, **overrides) -> "Deployment":
         dep = Deployment(self.cls, self.name, self.num_replicas,
                          dict(self.actor_options), self.autoscaling,
-                         self.max_ongoing_requests)
+                         self.max_ongoing_requests, self.mesh_shape)
         dep._init_args = self._init_args
         dep._init_kwargs = self._init_kwargs
         for k, v in overrides.items():
@@ -90,12 +97,17 @@ class Deployment:
         return self
 
     def config_dict(self) -> Dict[str, Any]:
+        mesh = self.mesh_shape or self._init_kwargs.get("mesh_shape")
         return {
             "num_replicas": self.num_replicas,
             "actor_options": dict(self.actor_options),
             "autoscaling": (self.autoscaling.to_dict()
                             if self.autoscaling else None),
             "max_ongoing_requests": self.max_ongoing_requests,
+            # Explicit deployment-level mesh wins; a mesh_shape bound
+            # into the class's init kwargs (LlamaDecodeDeployment-style)
+            # reaches placement the same way.
+            "mesh_shape": list(mesh) if mesh else None,
         }
 
 
@@ -132,6 +144,31 @@ def _affinity_hashes(args: tuple):
             tokens, rt_config.prefix_match_min_tokens) or None
     except Exception:
         return None
+
+
+_local_slice_cache: List[Optional[str]] = []  # memo: [] = not probed yet
+
+
+def _local_slice_id() -> Optional[str]:
+    """The pod slice THIS process's node advertises (None when the node
+    carries no topology). One controller round-trip, memoized for the
+    process lifetime — slice membership doesn't change under a live
+    process. Routers use it to prefer ICI-local replicas."""
+    if not _local_slice_cache:
+        slice_id = None
+        try:
+            from ray_tpu.core.runtime import get_core_worker
+
+            core = get_core_worker()
+            me = core.node_id.hex()
+            for n in core.controller.call("list_nodes"):
+                if n["node_id"] == me and n.get("slice"):
+                    slice_id = n["slice"]["slice_id"]
+                    break
+        except Exception:
+            slice_id = None
+        _local_slice_cache.append(slice_id)
+    return _local_slice_cache[0]
 
 
 class _Router:
@@ -178,7 +215,8 @@ class _Router:
                 {"handle": ActorHandle(ActorID(r["actor_id"])),
                  "id": r["replica_id"],
                  "models": set(r.get("models", [])),
-                 "prefixes": set(r.get("prefixes", []))}
+                 "prefixes": set(r.get("prefixes", [])),
+                 "slice_id": r.get("slice_id")}
                 for r in snapshot.get("replicas", [])]
             live = {r["id"] for r in self._replicas}
             self._inflight = {k: v for k, v in self._inflight.items()
@@ -260,6 +298,14 @@ class _Router:
         token bucket win (prefix-cache affinity) — a hot system prompt
         stays resident on ONE replica's prefix pool instead of being
         re-prefilled on every replica."""
+        from ray_tpu.core.config import config as rt_config
+
+        # Resolved BEFORE taking the router lock: the first call is a
+        # controller round-trip (memoized after), and an RPC under this
+        # lock would head-of-line-block every concurrent pick (the
+        # dial-under-lock class graftlint polices).
+        here = (_local_slice_id() if rt_config.slice_affinity_enabled
+                else None)
         with self._lock:
             replicas = self._replicas
             if not replicas:
@@ -284,6 +330,17 @@ class _Router:
                     if warm:
                         pool = warm
                         break
+            # ICI locality, weakest preference (model residency and a
+            # prefix hit both save real compute; same-slice only saves
+            # network): among the remaining candidates, stay on the
+            # caller's own pod slice when an unsaturated replica lives
+            # there — controller snapshots carry each replica's slice.
+            if here is not None:
+                near = [r for r in pool if r.get("slice_id") == here
+                        and self._inflight.get(r["id"], 0)
+                        < self._max_ongoing]
+                if near:
+                    pool = near
             if len(pool) == 1:
                 chosen = pool[0]
             else:

@@ -106,6 +106,7 @@ class ReplicaActor:
 
         cls = serialization.loads_function(cls_blob)
         self._instance = cls(*args, **kwargs)
+        self._sub_slice: Optional[Dict[str, Any]] = None
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
@@ -215,6 +216,17 @@ class ReplicaActor:
                           exc_info=True)
             with self._lock:
                 self._ongoing -= 1
+
+    def set_topology(self, assignment: Dict[str, Any]) -> None:
+        """Sub-slice assignment from the serve controller (which chips
+        of which slice this replica spans). Stored here and forwarded to
+        the user instance when it cares (e.g. LlamaDecodeDeployment
+        reports it through replica_metrics; a real multi-host replica
+        would select jax devices by the assignment's chip coords)."""
+        self._sub_slice = dict(assignment)
+        fwd = getattr(self._instance, "set_topology", None)
+        if callable(fwd):
+            fwd(assignment)
 
     def stats(self) -> Dict[str, Any]:
         models = loaded_model_ids(self._instance)

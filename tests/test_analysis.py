@@ -192,6 +192,51 @@ def test_trace_sync_in_jit_called_helper():
     assert found[0].rule == rules.TRACE_HOST_SYNC
 
 
+def test_sharded_jit_wrappers_are_trace_scopes():
+    """GSPMD serving idiom: functions jitted with in_shardings /
+    out_shardings — including through ALIASED or helper wrappers the
+    name-based jit detection can't see — carry the same trace hazards
+    as plain jit."""
+    src = """
+        from jax import jit as compile_sharded
+
+        def body(x):
+            if x > 0:          # tracer branch
+                return x
+            return float(x)    # host sync
+
+        def build(shardings):
+            return compile_sharded(body, out_shardings=shardings)
+
+        def mesh_scoped_body(x):
+            return x.item()    # host sync
+
+        def wire(mesh_jit, sh):
+            return mesh_jit(mesh_scoped_body, in_shardings=(sh,),
+                            out_shardings=sh)
+    """
+    found = run_checker(trace_safety.check, project_of(mod=src))
+    got = {(f.symbol, f.rule) for f in found}
+    assert ("body", rules.TRACE_PY_BRANCH) in got
+    assert ("body", rules.TRACE_HOST_SYNC) in got
+    assert ("mesh_scoped_body", rules.TRACE_HOST_SYNC) in got
+
+
+def test_sharding_kwargs_on_non_function_args_are_ignored():
+    """A sharding-kwarg call whose first arg is data (not a package
+    function) marks nothing: no false positives on e.g. device_put-like
+    helpers."""
+    src = """
+        def place(arr, helper):
+            return helper(arr, out_shardings=None)
+
+        def innocent(x):
+            return x.item()  # never jitted, never called from jit
+    """
+    found = run_checker(trace_safety.check, project_of(mod=src))
+    assert found == []
+
+
 # ------------------------------------------------------ lock-discipline
 
 LOCK_CYCLE_TP = """

@@ -1,0 +1,212 @@
+"""Mesh-native topology: slices, sub-slice placement, chip resources.
+
+The scheduler-side half of GSPMD serving (ROADMAP #1): nodes advertise
+their pod slice, the controller reserves ICI-contiguous sub-slices —
+NEVER a fragment straddling two slices — and the resource vector carries
+``chips`` / ``slice:<id>`` keys alongside the old scalars.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import resources as resmath
+from ray_tpu.core.topology import (SliceGrid, SliceInfo, TopologyView,
+                                   detect_slice, most_square,
+                                   parse_topology)
+
+# ------------------------------------------------------------ pure units
+
+
+def test_parse_topology_and_most_square():
+    assert parse_topology("2x4") == (2, 4)
+    assert parse_topology("8") == (2, 4)
+    assert most_square(16) == (4, 4)
+    assert most_square(1) == (1, 1)
+    assert most_square(6) == (2, 3)
+    with pytest.raises(ValueError):
+        most_square(0)
+
+
+def test_slice_info_roundtrip():
+    info = SliceInfo("v5e-16", (4, 4), chips_per_host=4)
+    assert info.chips == 16 and info.hosts == 4
+    assert SliceInfo.from_dict(info.to_dict()) == info
+
+
+def test_detect_slice_virtual(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_VIRTUAL_SLICE", "2x4")
+    info = detect_slice({}, "hostA")
+    assert info.topology == (2, 4) and info.chips == 8
+    assert info.slice_id.startswith("virtual-")
+    monkeypatch.delenv("RAY_TPU_VIRTUAL_SLICE")
+    assert detect_slice({"CPU": 4.0}) is None  # pure CPU: no topology
+    assert detect_slice({"TPU": 8.0}).chips == 8
+
+
+# -------------------------------------------------------- grid allocator
+
+
+def test_grid_slice_aligned_accept():
+    g = SliceGrid(SliceInfo("s", (4, 4)))
+    subs = [g.reserve((2, 2)) for _ in range(4)]
+    assert all(s is not None for s in subs)
+    # buddy alignment: origins are multiples of the block shape
+    assert sorted(s.origin for s in subs) == [(0, 0), (0, 2), (2, 0),
+                                             (2, 2)]
+    assert g.free_chips == 0
+    assert g.reserve((1, 1)) is None  # full
+
+
+def test_grid_rejects_unaligned_fragment():
+    g = SliceGrid(SliceInfo("s", (4, 4)))
+    a = g.reserve((2, 2))
+    b = g.reserve((2, 2))
+    c = g.reserve((2, 2))
+    d = g.reserve((2, 2))
+    # free two diagonal blocks: 8 chips free but no aligned 2x4 exists
+    g.release(b.reservation_id)
+    g.release(c.reservation_id)
+    assert g.free_chips == 8
+    assert g.reserve((2, 4)) is None
+    assert g.reserve((4, 2)) is None
+    # the freed blocks ARE individually reusable (coalescing by
+    # construction — no compaction needed)
+    assert g.reserve((2, 2)) is not None
+    assert g.reserve((2, 2)) is not None
+    assert g.release(a.reservation_id) and g.release(d.reservation_id)
+    assert not g.release(a.reservation_id)  # idempotent
+
+
+def test_grid_orientation_flip():
+    g = SliceGrid(SliceInfo("s", (2, 4)))
+    # a (4, 2) ask fits the (2, 4) grid transposed
+    sub = g.reserve((4, 2))
+    assert sub is not None and sub.shape == (2, 4)
+
+
+def test_fragmentation_accounting():
+    g = SliceGrid(SliceInfo("s", (4, 4)))
+    assert g.fragmentation() == 0.0
+    subs = [g.reserve((2, 2)) for _ in range(4)]
+    assert g.fragmentation() == 0.0  # nothing free -> no waste signal
+    g.release(subs[1].reservation_id)
+    g.release(subs[2].reservation_id)
+    # 8 free chips, largest contiguous aligned block = 4 -> 0.5
+    assert g.largest_free_block() == 4
+    assert g.fragmentation() == 0.5
+    g.release(subs[0].reservation_id)
+    g.release(subs[3].reservation_id)
+    assert g.fragmentation() == 0.0  # all free again: one 4x4 block
+
+
+# --------------------------------------------------------- cluster view
+
+
+def test_view_never_straddles_slices():
+    v = TopologyView()
+    v.register("n1", SliceInfo("s1", (2, 2)))
+    v.register("n2", SliceInfo("s2", (2, 2)))
+    # 8 chips exist cluster-wide, but no single slice holds 8:
+    # the reservation is REFUSED, not assembled from fragments.
+    assert v.reserve("r", chips=8) is None
+    assert v.reserve("r", shape=(2, 4)) is None
+    a = v.reserve("r1", chips=4)
+    b = v.reserve("r2", chips=4)
+    assert a is not None and b is not None
+    assert a["slice_id"] != b["slice_id"]
+    assert v.reserve("r3", chips=4) is None
+
+
+def test_view_best_fit_prefers_fuller_slice():
+    v = TopologyView()
+    v.register("n1", SliceInfo("big", (4, 4)))
+    v.register("n2", SliceInfo("small", (2, 2)))
+    # best-fit: the 2x2 ask lands on the smaller slice, keeping the
+    # 4x4 block intact for a later big replica
+    sub = v.reserve("r1", shape=(2, 2))
+    assert sub["slice_id"] == "small"
+    assert v.reserve("r2", shape=(4, 4))["slice_id"] == "big"
+
+
+def test_view_release_and_owner_cleanup():
+    v = TopologyView()
+    v.register("n1", SliceInfo("s1", (2, 4)))
+    sub = v.reserve("replica#0", shape=(2, 4))
+    assert v.reserve("replica#1", shape=(2, 4)) is None
+    assert v.release(sub["reservation_id"])
+    assert v.reserve("replica#1", shape=(2, 4)) is not None
+    assert v.release_owner("replica#1") == 1
+    assert v.reserve("replica#2", chips=8) is not None
+
+
+def test_view_node_death_drops_slice():
+    v = TopologyView()
+    v.register("n1", SliceInfo("s1", (2, 2)))
+    v.register("n2", SliceInfo("s2", (2, 2)))
+    v.reserve("r1", chips=4)
+    v.node_dead("n1")
+    state = v.state()
+    assert "s1" not in state["slices"]
+    assert v.reserve("r2", chips=4) is not None  # s2 still serves
+
+
+# ------------------------------------------------- resource-vector keys
+
+
+def test_chip_resource_keys_are_plain_scalars():
+    res = resmath.chip_resources(8, "sliceA")
+    assert res == {"chips": 8.0, "slice:sliceA": 8.0}
+    avail = {"CPU": 4.0, **res}
+    assert resmath.chip_count(avail) == 8.0
+    assert resmath.slice_of(avail) == "sliceA"
+    assert resmath.slice_of({"CPU": 1.0}) is None
+    # the epsilon-tolerant set arithmetic needs no special cases
+    assert resmath.fits(avail, resmath.chip_resources(8, "sliceA"))
+    assert not resmath.fits(avail, resmath.chip_resources(9, "sliceA"))
+    assert resmath.take(avail, resmath.chip_resources(8, "sliceA"))
+    assert avail["chips"] == 0.0 and avail["slice:sliceA"] == 0.0
+    resmath.credit(avail, resmath.chip_resources(8, "sliceA"))
+    assert avail["chips"] == 8.0
+
+
+# ------------------------------------------------ controller RPC plane
+
+
+@pytest.fixture
+def slice_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_VIRTUAL_SLICE", "2x4")
+    core = ray_tpu.init(num_cpus=4)
+    yield core
+    ray_tpu.shutdown()
+
+
+def test_reserve_subslice_rpc_roundtrip(slice_cluster):
+    topo = ray_tpu.cluster_topology()
+    (slice_id, summary), = topo["slices"].items()
+    assert summary["topology"] == [2, 4] and summary["chips_free"] == 8
+
+    sub = ray_tpu.reserve_subslice(shape=(2, 4), owner="replica#0")
+    assert sub is not None and sub.chips == 8
+    assert sub.slice_id == slice_id and len(sub.nodes) == 1
+    # second ask must be refused, and surfaces as pending demand
+    assert ray_tpu.reserve_subslice(chips=8, owner="replica#1") is None
+    assert ray_tpu.reserve_subslice(chips=4, owner="replica#1") is None
+
+    state = ray_tpu.cluster_topology()["slices"][slice_id]
+    assert state["chips_free"] == 0
+    assert sub.reservation_id in state["reservations"]
+
+    assert sub.release()
+    assert (ray_tpu.cluster_topology()["slices"][slice_id]["chips_free"]
+            == 8)
+    # release is idempotent
+    assert not sub.release()
+
+
+def test_node_advertises_chip_resources(slice_cluster):
+    nodes = [n for n in slice_cluster.controller.call("list_nodes")
+             if n["alive"]]
+    (node,) = nodes
+    assert node["resources"]["chips"] == 8.0
+    assert node["slice"]["topology"] == [2, 4]
+    assert any(k.startswith("slice:") for k in node["resources"])
