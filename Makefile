@@ -1,10 +1,17 @@
 # Developer entry points. Tier-1 CI runs `make lint` semantics via
-# tests/test_analysis.py::test_repo_is_clean_under_strict.
+# tests/test_analysis.py::test_repo_is_clean_under_strict (+ the v2/v3
+# per-family gates and the stub-drift gate in tests/test_analysis_v3.py).
 
-.PHONY: lint lint-diff lint-stats test bench-paged bench-sharded
+.PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
+	bench-paged bench-sharded
 
-lint:
-	python -m ray_tpu.analysis --strict
+# The full gate: regenerate-and-diff the typed RPC stubs, then the
+# strict 9-family run WITH the stats.json refresh folded in (one
+# analysis pass serves both; a drifted stats artifact shows up as a
+# dirty tree, same as drifted stubs).
+lint: lint-stubs-check
+	python -m ray_tpu.analysis --strict \
+		--stats-json ray_tpu/analysis/stats.json
 
 # Pre-push fast path: findings only in files changed vs origin/main
 # (override with DIFF_REF=<ref>); whole-program indexes still span the
@@ -13,11 +20,21 @@ DIFF_REF ?= origin/main
 lint-diff:
 	python -m ray_tpu.analysis --strict --diff $(DIFF_REF)
 
-# Full strict run + per-rule timing/finding-count artifact
-# (analysis/stats.json is the trajectory input for BENCH_NOTES.md).
+# Back-compat alias: the artifact now refreshes on every `make lint`.
 lint-stats:
 	python -m ray_tpu.analysis --strict --stats \
 		--stats-json ray_tpu/analysis/stats.json
+
+# Drift gate for the generated typed RPC stubs (core/rpc_stubs.py):
+# regenerate in place and fail when the checked-in module changed —
+# i.e. a handler signature moved without rerunning --gen-stubs. The
+# rpc-stub-drift rule enforces the same in-process for `--strict`.
+lint-stubs-check:
+	python -m ray_tpu.analysis --gen-stubs
+	git diff --exit-code -- ray_tpu/core/rpc_stubs.py
+
+gen-stubs:
+	python -m ray_tpu.analysis --gen-stubs
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

@@ -1,6 +1,6 @@
 """graftlint: AST-based concurrency & trace-safety analysis for ray_tpu.
 
-Seven checker families fitted to this codebase's real failure modes
+Nine checker families fitted to this codebase's real failure modes
 (each rule is documented in docs/ANALYSIS.md):
 
 =====================  ==================================================
@@ -18,14 +18,27 @@ missing-finally-release  lock acquire/release in one function w/o finally
 unguarded-field-access guarded-by inference: a field locked at a majority
                        of sites, accessed lock-free from 2+-thread code
 resource-leak-path     a path (incl. exception edges) exiting a scope
-                       with a socket/registration/slot/pin still live
+                       with a socket/registration/slot/pin/topology
+                       lease still live
 rpc-unknown-method     .call("x")/.notify("x") with no registered handler
 rpc-arity-mismatch     call arg shape no registration of the name accepts
 rpc-dead-endpoint      handler registered but never called in-package
+sharding-partitioned-contraction  a DECODE_RULES entry partitioning a
+                       contraction dim at an einsum/matmul site (split
+                       reduction = bit-exactness broken), statically
+sharding-missing-anchor  a row-parallel reduction (wo / w_down) whose
+                       activation operand has no ``constrain`` anchor
+sharding-unpinned-mesh-call  jit/device_put inside a mesh scope without
+                       in_shardings/out_shardings
+sharding-unscoped-trace  a sharded program (reaches ``constrain``)
+                       jitted with sharding kwargs outside axis_rules
+rpc-stub-drift         core/rpc_stubs.py stale vs the handler index
+                       (regenerate with ``--gen-stubs``)
 =====================  ==================================================
 
 Run it: ``python -m ray_tpu.analysis [--strict] [--format json]
-[--jobs N] [--diff REF]``, or ``make lint`` / ``make lint-diff``.
+[--jobs N] [--diff REF] [--gen-stubs]``, or ``make lint`` /
+``make lint-diff``.
 Suppress a deliberate site with ``# graftlint: disable=<rule>`` (same
 line or the line above); defer a triaged finding via
 ``analysis/baseline.json`` (``--write-baseline``, then fill in the
@@ -70,7 +83,8 @@ def _family_checks():
     outside ``emit_files`` (the --diff fast path)."""
     from ray_tpu.analysis import (guarded_by, lifecycle_hygiene, lifetime,
                                   lock_discipline, reactor_safety,
-                                  rpc_contract, trace_safety)
+                                  rpc_contract, sharding_safety, stubgen,
+                                  trace_safety)
 
     return {
         "reactor-safety": (True, reactor_safety.check),
@@ -80,6 +94,8 @@ def _family_checks():
         "guarded-by": (True, guarded_by.check),
         "lifetime": (True, lifetime.check),
         "rpc-contract": (True, rpc_contract.check),
+        "sharding-safety": (True, sharding_safety.check),
+        "rpc-stubs": (True, stubgen.check),
     }
 
 

@@ -52,6 +52,11 @@ def main(argv=None) -> int:
                         help="only report findings in package files "
                              "changed vs this git ref (plus untracked "
                              "files); implies --jobs auto")
+    parser.add_argument("--gen-stubs", nargs="?", const="", default=None,
+                        metavar="PATH",
+                        help="regenerate the typed RPC client stubs "
+                             "from the handler index (default: "
+                             "ray_tpu/core/rpc_stubs.py) and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -69,6 +74,8 @@ def main(argv=None) -> int:
             return 2
 
     root = args.root or repo_root()
+    if args.gen_stubs is not None:
+        return _gen_stubs(root, args.gen_stubs)
     paths = list(args.paths)
     emit_files = None
     if args.diff is not None:
@@ -144,6 +151,25 @@ def main(argv=None) -> int:
 
     if args.strict and (new or stale):
         return 1
+    return 0
+
+
+def _gen_stubs(root, out_path):
+    """Regenerate ray_tpu/core/rpc_stubs.py from the handler index."""
+    import os
+
+    from ray_tpu.analysis import Project
+    from ray_tpu.analysis import rules as r
+    from ray_tpu.analysis import stubgen
+    from ray_tpu.analysis.callgraph import CallGraph
+
+    project = Project.load(root)
+    graph = CallGraph(project)
+    src = stubgen.generate(graph)
+    path = out_path or os.path.join(root, r.RPC_STUBS_PATH)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(src)
+    print(f"wrote {path} ({len(src.splitlines())} lines)")
     return 0
 
 

@@ -24,7 +24,19 @@ still live and unowned. Resource idioms are fitted to this codebase
   collections;
 * refcounts — ``ent.refcount += 1`` pins, ``-= 1`` unpins (prefix-cache
   rows); ``alloc.incref(p)``/``decref(p)`` pin/unpin pool pages
-  (method-pair form).
+  (method-pair form);
+* topology leases — ``sub = client.call("reserve_subslice", ...)``
+  acquires a lease that ``client.call("release_subslice", id)`` (on ANY
+  client object — leases are keyed by reservation id on the head, not
+  by the receiver; release may live in a self.-callee like the serve
+  controller's ``_release_subslice``/``_kill_replica`` chain) must
+  discharge on every exception path. Like receiver-keyed pairs, a lease
+  surviving a *normal* exit is the design (the replica record owns it);
+  only an escaping exception between reserve and release/handoff leaks
+  — the stranded reservation pins its chips until the hosting node
+  dies. Handoff is recognized when the lease local is passed as a BARE
+  argument to any call (``ReplicaRecord(handle, rid, sub)``) — nested
+  reads (``chip_resources(sub["chips"], ...)``) stay borrows.
 
 Ownership transfer kills liveness: storing the resource (assignment
 value — including wrapping constructors like ``_Conn(sock)``),
@@ -53,13 +65,36 @@ _EXITS = ("fall", "return", "raise", "break", "continue")
 @dataclass
 class Resource:
     rid: int
-    kind: str            # ctor | pair | pool | ref
-    name: Optional[str]  # local var holding it (ctor/pool), else None
-    recv_key: Optional[str]   # receiver dotted key (pair/ref/pool)
+    kind: str            # ctor | pair | pool | ref | lease
+    name: Optional[str]  # local var holding it (ctor/pool/lease)
+    recv_key: Optional[str]   # receiver dotted key (pair/ref/pool),
+    #                           or "rpc:<release name>" (lease)
     release_verb: str
     label: str
     line: int
     node_id: int         # id() of the acquire AST node
+
+
+_LEASE_NAMES = frozenset(rules.RPC_LEASE_PAIRS) \
+    | frozenset(rules.RPC_LEASE_PAIRS.values())
+
+
+def _lease_rpc_name(node: ast.AST) -> Optional[str]:
+    """The RPC name of a lease-pair site, in either spelling: the raw
+    ``.call("reserve_subslice", ...)`` verb form, or the generated-stub
+    method form (``stub.reserve_subslice(...)`` — the method name IS
+    the endpoint name, core/rpc_stubs.py)."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return None
+    if node.func.attr in rules.RPC_LEASE_VERBS and node.args \
+            and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        name = node.args[0].value
+        return name if name in _LEASE_NAMES else None
+    if node.func.attr in _LEASE_NAMES:
+        return node.func.attr
+    return None
 
 
 def _release_summaries(graph: CallGraph) -> Dict[str, Set[Tuple[str, str]]]:
@@ -82,6 +117,12 @@ def _release_summaries(graph: CallGraph) -> Dict[str, Set[Tuple[str, str]]]:
             d = dotted(node.target.value)
             if d is not None:
                 direct[info.fqn].add((d, "refdec"))
+    lease_releases = set(rules.RPC_LEASE_PAIRS.values())
+    for tail in tuple(rules.RPC_LEASE_VERBS) + tuple(lease_releases):
+        for node, info in graph.calls_by_tail.get(tail, ()):
+            name = _lease_rpc_name(node)
+            if name in lease_releases:
+                direct[info.fqn].add((f"rpc:{name}", name))
 
     closure = {fqn: set(rel) for fqn, rel in direct.items()}
     changed = True
@@ -97,9 +138,11 @@ def _release_summaries(graph: CallGraph) -> Dict[str, Set[Tuple[str, str]]]:
             for callee, _line, via_self in rows:
                 if via_self and callee in closure:
                     # only self.-keyed releases survive the hop (the
-                    # callee's ``self`` is the caller's ``self``)
+                    # callee's ``self`` is the caller's ``self``); lease
+                    # releases are global (reservation-id keyed on the
+                    # head), so they survive too
                     cur.update(k for k in closure[callee]
-                               if k[0].startswith("self."))
+                               if k[0].startswith(("self.", "rpc:")))
             if len(cur) != before:
                 changed = True
     return closure
@@ -151,6 +194,16 @@ def _collect_resources(graph: CallGraph, info: FunctionInfo,
                 out.append(Resource(
                     rid, "ctor", node.targets[0].id, None,
                     rules.RESOURCE_CTOR_DOTTED[rd], rd, node.lineno,
+                    id(node)))
+                rid += 1
+                continue
+            # topology lease: sub = client.call("reserve_subslice", ...)
+            rpc_name = _lease_rpc_name(node.value)
+            if rpc_name in rules.RPC_LEASE_PAIRS:
+                release = rules.RPC_LEASE_PAIRS[rpc_name]
+                out.append(Resource(
+                    rid, "lease", node.targets[0].id, f"rpc:{release}",
+                    release, f'call("{rpc_name}") lease', node.lineno,
                     id(node)))
                 rid += 1
                 continue
@@ -240,15 +293,22 @@ class _FnAnalysis:
                         # frees collections (``free(shared + fresh)``),
                         # not just the bare local
                         out.add(r.rid)
+                    elif r.kind == "lease" \
+                            and _lease_rpc_name(node) == r.release_verb:
+                        # any client object discharges a lease: the
+                        # reservation id, not the receiver, keys it
+                        out.add(r.rid)
                 # release-through-self-call (``self._drop(st)``)
                 callee, _vs = self.graph.resolve_call_cached(
                     node, self.info)
                 if callee is not None:
                     rel = self.summaries.get(callee, ())
                     for r in self.resources:
-                        if r.rid in state and r.kind in ("pair", "ref") \
+                        if r.rid in state \
+                                and r.kind in ("pair", "ref", "lease") \
                                 and r.recv_key is not None \
-                                and r.recv_key.startswith("self.") \
+                                and r.recv_key.startswith(("self.",
+                                                           "rpc:")) \
                                 and (r.recv_key, r.release_verb) in rel:
                             out.add(r.rid)
             elif isinstance(node, ast.AugAssign) \
@@ -288,6 +348,23 @@ class _FnAnalysis:
                     for r in self.resources:
                         if r.rid in state and r.recv_key is not None \
                                 and d == r.recv_key:
+                            out.add(r.rid)
+        # Lease handoff: the lease local passed as a BARE argument to
+        # any call transfers ownership (``ReplicaRecord(h, rid, sub)``
+        # — the record now owns the reservation); a nested read
+        # (``f(sub["chips"])``) stays a borrow.
+        lease_names = {r.name: r for r in self.resources
+                       if r.kind == "lease" and r.name is not None}
+        if lease_names:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                args = list(node.args) + [kw.value
+                                          for kw in node.keywords]
+                for a in args:
+                    if isinstance(a, ast.Name) and a.id in lease_names:
+                        r = lease_names[a.id]
+                        if r.rid in state:
                             out.add(r.rid)
         return out
 
@@ -348,9 +425,20 @@ class _FnAnalysis:
         if isinstance(stmt, ast.If):
             if self._may_raise_expr(stmt.test):
                 out["raise"].add((state, line))
-            for branch in (stmt.body, stmt.orelse):
-                res = self._block(branch, state) if branch else \
-                    {k: (set() if k != "fall" else {(state, line)})
+            # ``if x is None:`` / ``if x is not None:`` — the branch in
+            # which x is None cannot hold the resource bound to x (the
+            # failed-acquire guard idiom: ``sub = reserve(); if sub is
+            # None: return False``), so prune it there.
+            none_name, when_none = self._none_test(stmt.test)
+            branch_states = [state, state]
+            if none_name is not None:
+                dead = frozenset(r.rid for r in self.resources
+                                 if r.name == none_name)
+                branch_states[0 if when_none else 1] = state - dead
+            for branch, bstate in zip((stmt.body, stmt.orelse),
+                                      branch_states):
+                res = self._block(branch, bstate) if branch else \
+                    {k: (set() if k != "fall" else {(bstate, line)})
                      for k in _EXITS}
                 for k in _EXITS:
                     out[k] |= res[k]
@@ -444,10 +532,12 @@ class _FnAnalysis:
             return out
 
         if isinstance(stmt, ast.Return):
-            s = state
+            # transfer BEFORE the raise edge: ``return Wrap(res)`` whose
+            # constructor raises is assumed to have taken the resource,
+            # same optimism as the assignment form below
+            s = state - self._transferred_in(stmt, state)
             if stmt.value is not None and self._may_raise_expr(stmt.value):
                 out["raise"].add((s, line))
-            s = s - self._transferred_in(stmt, s)
             out["return"].add((s, line))
             return out
 
@@ -504,6 +594,21 @@ class _FnAnalysis:
                         kills.add(r.rid)
         return kills
 
+    @staticmethod
+    def _none_test(test: ast.AST) -> Tuple[Optional[str], bool]:
+        """-> (name, True) for ``name is None``, (name, False) for
+        ``name is not None``, else (None, False)."""
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.left, ast.Name) \
+                and len(test.comparators) == 1 \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            if isinstance(test.ops[0], ast.Is):
+                return test.left.id, True
+            if isinstance(test.ops[0], ast.IsNot):
+                return test.left.id, False
+        return None, False
+
     def _may_raise_expr(self, expr: Optional[ast.AST]) -> bool:
         if expr is None:
             return False
@@ -532,6 +637,11 @@ def _candidate_fqns(graph: CallGraph) -> Set[str]:
         if node.target.attr in rules.RESOURCE_REFCOUNT_ATTRS \
                 and isinstance(node.op, ast.Add):
             cands.add(info.fqn)
+    for tail in tuple(rules.RPC_LEASE_VERBS) + tuple(
+            rules.RPC_LEASE_PAIRS):
+        for node, info in graph.calls_by_tail.get(tail, ()):
+            if _lease_rpc_name(node) in rules.RPC_LEASE_PAIRS:
+                cands.add(info.fqn)
     return cands
 
 
@@ -556,11 +666,13 @@ def check(graph: CallGraph, emit_files=None) -> List[Finding]:
         for kind in ("fall", "return", "raise"):
             for s, ln in outcomes[kind]:
                 for rid in s:
-                    # receiver-keyed registrations live at a NORMAL exit
-                    # are the design (a long-lived registration); only an
-                    # exception escaping between acquire and release is a
-                    # leak for those.
-                    if by_rid[rid].kind == "pair" and kind != "raise":
+                    # receiver-keyed registrations (and topology leases)
+                    # live at a NORMAL exit are the design (a long-lived
+                    # registration / record-owned reservation); only an
+                    # exception escaping between acquire and release is
+                    # a leak for those.
+                    if by_rid[rid].kind in ("pair", "lease") \
+                            and kind != "raise":
                         continue
                     prev = leaks.get(rid)
                     if prev is None or ln < prev[1]:

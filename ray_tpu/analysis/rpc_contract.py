@@ -50,6 +50,7 @@ class Registration:
     has_kwargs: bool = False
     kw_names: Tuple[str, ...] = ()    # every keyword it can accept
     required_kwonly: Tuple[str, ...] = ()
+    handler_fqn: Optional[str] = None  # resolved handler (stub gen)
 
 
 @dataclass
@@ -154,6 +155,7 @@ def collect_registrations(graph: CallGraph
                     hfqn = graph.resolve_callable_expr(value, info)
                     if hfqn is not None and hfqn in graph.functions:
                         handler_fqns.setdefault(key.value, hfqn)
+                        reg.handler_fqn = hfqn
                     regs.append(reg)
     for node, info in graph.calls_by_kwarg.get(
             rules.RPC_INLINE_KWARG, ()):
@@ -185,10 +187,48 @@ def collect_registrations(graph: CallGraph
             hfqn = graph.resolve_callable_expr(node.args[1], info)
             if hfqn is not None and hfqn in graph.functions:
                 handler_fqns.setdefault(node.args[0].value, hfqn)
+                reg.handler_fqn = hfqn
             regs.append(reg)
     result = (regs, inline, handler_fqns)
     graph._rpc_registrations = result  # memoized: guarded-by reuses it
     return result
+
+
+def _stub_classes(graph: CallGraph) -> Dict[str, frozenset]:
+    """class name -> method names for the generated stub module
+    (``<Owner>Stub`` classes in rules.RPC_STUBS_MODULE)."""
+    out: Dict[str, frozenset] = {}
+    for (mod, cls), ci in graph.classes.items():
+        if mod == rules.RPC_STUBS_MODULE and cls.endswith("Stub") \
+                and not cls.startswith("_"):
+            out[cls] = frozenset(m for m in ci.methods
+                                 if not m.startswith("_"))
+    return out
+
+
+def _stub_receiver_class(graph: CallGraph, recv: ast.AST,
+                         info: FunctionInfo) -> Optional[str]:
+    """The stub class a receiver expression is an instance of, in the
+    three migrated spellings: chained ``ControllerStub(c).m(...)``, a
+    local alias ``st = ControllerStub(c); st.m(...)``, and a typed
+    self-attribute ``self._stub = ControllerStub(c)``."""
+    if isinstance(recv, ast.Call):
+        hit = graph._class_of_ctor(recv, info)
+    elif isinstance(recv, ast.Name):
+        alias = info.aliases.get(recv.id)
+        if not isinstance(alias, ast.Call):
+            return None
+        hit = graph._class_of_ctor(alias, info)
+    elif isinstance(recv, ast.Attribute) \
+            and isinstance(recv.value, ast.Name) \
+            and recv.value.id in ("self", "cls") and info.cls is not None:
+        hit = graph.self_attr_types.get((info.module, info.cls,
+                                         recv.attr))
+    else:
+        return None
+    if hit is not None and hit[0] == rules.RPC_STUBS_MODULE:
+        return hit[1]
+    return None
 
 
 def collect_call_sites(graph: CallGraph) -> List[CallSite]:
@@ -221,6 +261,39 @@ def collect_call_sites(graph: CallGraph) -> List[CallSite]:
                 n_pos=None if has_splat else len(payload) + extra,
                 kw_names=kw_names, has_kw_splat=has_kw_splat,
                 verb=verb))
+    # Generated-stub call sites: ``<StubCls>(client).method(...)``-shaped
+    # calls are literal uses of the endpoint the method mirrors — they
+    # count toward dead-endpoint coverage and get the same shape check
+    # (the stub signature mirrors the handler, but a drifted call site
+    # should fail HERE, not at the peer). The stub module's own
+    # ``self._call(method, ...)`` forwarding is deliberately NOT a use:
+    # counting it would mark every endpoint alive.
+    stub_cls = _stub_classes(graph)
+    if stub_cls:
+        for cls, methods in stub_cls.items():
+            for meth in methods:
+                for node, info in graph.calls_by_tail.get(meth, ()):
+                    if not isinstance(node.func, ast.Attribute) \
+                            or info.module == rules.RPC_STUBS_MODULE:
+                        continue
+                    recv_cls = _stub_receiver_class(
+                        graph, node.func.value, info)
+                    if recv_cls != cls:
+                        continue
+                    has_splat = any(isinstance(a, ast.Starred)
+                                    for a in node.args)
+                    kw_names = tuple(
+                        kw.arg for kw in node.keywords
+                        if kw.arg is not None
+                        and kw.arg not in rules.RPC_CLIENT_KWARGS)
+                    sites.append(CallSite(
+                        name=meth, path=info.file.relpath,
+                        line=node.lineno, symbol=info.qualname,
+                        n_pos=None if has_splat else len(node.args),
+                        kw_names=kw_names,
+                        has_kw_splat=any(kw.arg is None
+                                         for kw in node.keywords),
+                        verb="stub"))
     return sites
 
 

@@ -22,6 +22,11 @@ RESOURCE_LEAK = "resource-leak-path"
 RPC_UNKNOWN = "rpc-unknown-method"
 RPC_ARITY = "rpc-arity-mismatch"
 RPC_DEAD = "rpc-dead-endpoint"
+SHARDING_CONTRACTION = "sharding-partitioned-contraction"
+SHARDING_ANCHOR = "sharding-missing-anchor"
+SHARDING_UNPINNED = "sharding-unpinned-mesh-call"
+SHARDING_UNSCOPED = "sharding-unscoped-trace"
+RPC_STUB_DRIFT = "rpc-stub-drift"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -31,9 +36,12 @@ ALL_RULES = (
     UNGUARDED_FIELD,
     RESOURCE_LEAK,
     RPC_UNKNOWN, RPC_ARITY, RPC_DEAD,
+    SHARDING_CONTRACTION, SHARDING_ANCHOR,
+    SHARDING_UNPINNED, SHARDING_UNSCOPED,
+    RPC_STUB_DRIFT,
 )
 
-# The seven checker families, for ``--jobs`` scheduling and per-family
+# The nine checker families, for ``--jobs`` scheduling and per-family
 # stats: family name -> tuple of rule ids it emits.
 FAMILIES = {
     "reactor-safety": (REACTOR_BLOCKING,),
@@ -43,6 +51,9 @@ FAMILIES = {
     "guarded-by": (UNGUARDED_FIELD,),
     "lifetime": (RESOURCE_LEAK,),
     "rpc-contract": (RPC_UNKNOWN, RPC_ARITY, RPC_DEAD),
+    "sharding-safety": (SHARDING_CONTRACTION, SHARDING_ANCHOR,
+                        SHARDING_UNPINNED, SHARDING_UNSCOPED),
+    "rpc-stubs": (RPC_STUB_DRIFT,),
 }
 
 # ------------------------------------------------- blocking-API tables
@@ -226,6 +237,67 @@ RESOURCE_POOL_ATTRS = {
 # Refcount attributes: ``ent.refcount += 1`` pins, ``-= 1`` unpins
 # (prefix-cache row pinning).
 RESOURCE_REFCOUNT_ATTRS = ("refcount",)
+
+# --------------------------------------- v3: topology-lease pairing
+
+# RPC-name-keyed lease pairs: ``client.call("reserve_subslice", ...)``
+# acquires a topology lease that some ``client.call("release_subslice",
+# id)`` (possibly in a self.-callee — the serve controller's
+# ``_release_subslice``/``_kill_replica`` chain) must discharge on every
+# exception path. Unlike receiver-keyed pairs, leases are GLOBAL (keyed
+# by reservation id on the head), so any release call discharges them
+# regardless of which client object carries it. A lease surviving a
+# normal exit is the design (the replica record owns it); only an
+# escaping exception between reserve and release/handoff is a leak —
+# a leaked reservation strands its chips until the hosting node dies.
+RPC_LEASE_PAIRS = {
+    "reserve_subslice": "release_subslice",
+}
+# The RPC verbs lease acquire/release ride on (client.call today;
+# notify releases would also discharge).
+RPC_LEASE_VERBS = ("call", "notify")
+
+# ------------------------------------------ v3: sharding/mesh safety
+
+# Module holding the logical-axis rule tables, and the names of the
+# tables whose contract is BIT-EXACTNESS (no contraction dim ever
+# partitions — the GSPMD serving invariant). DEFAULT_RULES (train) is
+# also parsed: train tables may shard contraction dims (psum is fine
+# for training), but they identify which logical axes CAN shard, which
+# is how the row-parallel weights are derived.
+SHARDING_RULES_MODULE = "ray_tpu.parallel.sharding"
+SHARDING_BITEXACT_TABLES = ("DECODE_RULES",)
+SHARDING_TRAIN_TABLE = "DEFAULT_RULES"
+# Module + function names the weight logical-axes tables live in: the
+# train table plus the decode overrides (``decode_param_axes`` re-binds
+# the row-parallel weights to fully-replicated tuples).
+SHARDING_PARAM_AXES_MODULE = "ray_tpu.models.llama"
+SHARDING_PARAM_AXES_FUNCS = ("param_axes",)
+SHARDING_DECODE_AXES_FUNCS = ("decode_param_axes",)
+# Files whose einsum/dot/matmul sites are checked against the tables
+# (path prefixes; the sharded model + parallelism code).
+SHARDING_SCOPE_PREFIXES = ("ray_tpu/models/", "ray_tpu/parallel/")
+# The logical-axis anchor call (``constrain(x, (...axes...))``) —
+# matched by trailing name so aliased imports still count.
+SHARDING_CONSTRAIN_FUNCS = ("constrain",)
+# Mesh-scope spellings: a ``with axis_rules(mesh, rules):`` block, or a
+# jit passed through a ``*_mesh_scoped``-style wrapper, marks the
+# region where sharded programs are traced.
+SHARDING_SCOPE_CTXS = ("axis_rules",)
+MESH_SCOPE_WRAPPERS = ("_mesh_scoped",)
+# einsum/dot/matmul trailing names checked for contraction hazards.
+SHARDING_CONTRACT_FUNCS = ("einsum",)
+SHARDING_MATMUL_FUNCS = ("matmul", "dot")
+
+# ------------------------------------------- v3: generated RPC stubs
+
+# The generated typed-stub module (``--gen-stubs``): one ``<Cls>Stub``
+# class per RpcServer owner, methods mirroring handler signatures.
+# Stub-method call sites count as literal RPC uses (dead-endpoint +
+# arity checking); the module itself is gated against drift by the
+# ``rpc-stub-drift`` rule and ``make lint-stubs-check``.
+RPC_STUBS_MODULE = "ray_tpu.core.rpc_stubs"
+RPC_STUBS_PATH = "ray_tpu/core/rpc_stubs.py"
 
 # ------------------------------------------- v2: RPC contract checking
 

@@ -29,6 +29,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID
 from ray_tpu.core.pubsub import Pubsub
 from ray_tpu.core.rpc import ClientPool, RpcServer
+from ray_tpu.core.rpc_stubs import CoreWorkerStub, NodeStub
 from ray_tpu.util.ratelimit import log_every
 
 logger = logging.getLogger(__name__)
@@ -706,8 +707,9 @@ class Controller:
                     picked_node_id = node_id_bytes
                     bundle = None
                 try:
-                    lease = self._clients.get(tuple(node_addr)).call(
-                        "create_actor_worker",
+                    lease = NodeStub(
+                        self._clients.get(tuple(node_addr))
+                    ).create_actor_worker(
                         opts.get("resources", {"CPU": 1.0}), bundle, None,
                         opts.get("runtime_env"),
                         timeout=config.worker_lease_timeout_s + 10.0)
@@ -725,8 +727,9 @@ class Controller:
                     time.sleep(0.2)
                     continue
                 worker_addr = tuple(lease["addr"])
-                reply = self._clients.get(worker_addr).call(
-                    "start_actor", spec, timeout=None)
+                reply = CoreWorkerStub(
+                    self._clients.get(worker_addr)).start_actor(
+                        spec, timeout=None)
                 if reply["ok"]:
                     raced = False
                     with self._lock:
@@ -748,8 +751,9 @@ class Controller:
                             rec.node_id = NodeID(node_id_bytes)
                             self._publish_actor(rec)
                     if raced:
-                        self._clients.get(tuple(node_addr)).call(
-                            "kill_worker", lease["worker_id"], True)
+                        NodeStub(self._clients.get(
+                            tuple(node_addr))).kill_worker(
+                                lease["worker_id"], True)
                     return
                 # __init__ raised: permanent failure, no restart (parity with
                 # the reference: creation-task errors kill the actor).
@@ -826,8 +830,9 @@ class Controller:
         if addr is not None:
             worker_addr, worker_id, node_addr = addr
             try:
-                self._clients.get(tuple(node_addr)).call(
-                    "kill_worker", worker_id, True, timeout=5.0)
+                NodeStub(self._clients.get(
+                    tuple(node_addr))).kill_worker(
+                        worker_id, True, timeout=5.0)
             except Exception:
                 # The node may already be dead (its reaper got the
                 # worker); a live node failing kills leaks workers.
@@ -950,8 +955,9 @@ class Controller:
         ok = True
         for idx, node_rec in plan:
             try:
-                granted = self._clients.get(node_rec.addr).call(
-                    "reserve_bundle", pg_id_bytes, idx, rec.bundles[idx])
+                granted = NodeStub(
+                    self._clients.get(node_rec.addr)).reserve_bundle(
+                        pg_id_bytes, idx, rec.bundles[idx])
             except Exception:
                 granted = False
             if granted:
@@ -962,8 +968,9 @@ class Controller:
         if not ok:
             for idx, node_rec in reserved:
                 try:
-                    self._clients.get(node_rec.addr).call(
-                        "release_bundle", pg_id_bytes, idx)
+                    NodeStub(
+                        self._clients.get(node_rec.addr)).release_bundle(
+                            pg_id_bytes, idx)
                 except Exception:
                     # A failed rollback strands the bundle's resources
                     # until the node re-registers — worth a trail.
@@ -1053,7 +1060,8 @@ class Controller:
             return
         for idx, (node_id, addr) in rec.placement.items():
             try:
-                self._clients.get(addr).call("release_bundle", pg_id_bytes, idx)
+                NodeStub(self._clients.get(addr)).release_bundle(
+                    pg_id_bytes, idx)
             except Exception:
                 log_every("controller.release_bundle", 10.0, logger,
                           "placement-group bundle release failed",

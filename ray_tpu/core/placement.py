@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.rpc_stubs import ControllerStub
 from ray_tpu.core.runtime import get_core_worker
 
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -35,13 +36,13 @@ class PlacementGroup:
         core = get_core_worker()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            info = core.controller.call("get_placement_group", self.id.binary())
+            stub = ControllerStub(core.controller)
+            info = stub.get_placement_group(self.id.binary())
             if info is not None and info["state"] == "CREATED":
                 return True
             # Retry the 2PC reservation (capacity may have freed up).
-            info = core.controller.call(
-                "create_placement_group", self.id.binary(), self.bundles,
-                self.strategy)
+            info = stub.create_placement_group(
+                self.id.binary(), self.bundles, self.strategy)
             if info.get("state") == "CREATED":
                 return True
             time.sleep(0.2)
@@ -50,7 +51,8 @@ class PlacementGroup:
     def bundle_node(self, index: int):
         """Return (node_id_bytes, node_addr) hosting bundle ``index``."""
         core = get_core_worker()
-        info = core.controller.call("get_placement_group", self.id.binary())
+        info = ControllerStub(core.controller).get_placement_group(
+            self.id.binary())
         if info is None or index not in info["placement"]:
             raise RayTpuError(f"bundle {index} of pg {self.id.hex()} not placed")
         return info["placement"][index]
@@ -67,14 +69,15 @@ def placement_group(bundles: List[Dict[str, float]],
         raise ValueError("placement group needs at least one bundle")
     core = get_core_worker()
     pg_id = PlacementGroupID.from_random()
-    core.controller.call("create_placement_group", pg_id.binary(),
-                         [dict(b) for b in bundles], strategy)
+    ControllerStub(core.controller).create_placement_group(
+        pg_id.binary(), [dict(b) for b in bundles], strategy)
     return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     core = get_core_worker()
-    core.controller.call("remove_placement_group", pg.id.binary())
+    ControllerStub(core.controller).remove_placement_group(
+        pg.id.binary())
 
 
 # ------------------------------------------------ sub-slice reservations
@@ -110,8 +113,8 @@ class SubSliceReservation:
 
     def release(self) -> bool:
         core = get_core_worker()
-        return core.controller.call("release_subslice",
-                                    self.reservation_id)
+        return ControllerStub(core.controller).release_subslice(
+            self.reservation_id)
 
     def __repr__(self):
         return (f"SubSliceReservation({self.reservation_id!r}, "
@@ -128,8 +131,8 @@ def reserve_subslice(chips: int = 0,
     advertised slice can host it contiguously — the caller queues or
     rejects, it never gets a fragment straddling slices."""
     core = get_core_worker()
-    sub = core.controller.call(
-        "reserve_subslice", owner or f"driver-{os.getpid()}",
+    sub = ControllerStub(core.controller).reserve_subslice(
+        owner or f"driver-{os.getpid()}",
         int(chips), list(shape) if shape is not None else None)
     return SubSliceReservation(sub) if sub is not None else None
 
@@ -138,7 +141,7 @@ def cluster_topology() -> Dict[str, Any]:
     """Every advertised slice's grid, free chips, fragmentation, and
     live sub-slice reservations (controller ``topology_state`` RPC)."""
     core = get_core_worker()
-    return core.controller.call("topology_state")
+    return ControllerStub(core.controller).topology_state()
 
 
 class PlacementGroupSchedulingStrategy:

@@ -165,13 +165,19 @@ def _block(x, layer, c: ViTConfig, n_valid: Optional[int] = None):
 
     # scale applied in the kernel; tile-padding keys masked out
     out = attention(q, k, v, causal=False, kv_valid=n_valid)
+    # Pre-contraction anchors, same idiom as llama._decoder_layer: the
+    # attention output entering wo and the ffn hidden entering w_down
+    # use the ANCHOR axes (attn_heads/mlp_hidden = "tensor" under train
+    # rules, exactly what propagation picks, and None under DECODE
+    # rules so no reduction is ever split across the mesh).
+    out = constrain(out, ("batch", "length", "attn_heads", "head_dim"))
     out = jnp.einsum("bnhd,hde->bne", out, layer["wo"].astype(c.dtype))
     x = x + constrain(out, ("batch", "length", "act_embed"))
 
     h2 = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
     up = jnp.einsum("bne,em->bnm", h2, layer["w_up"].astype(c.dtype))
     up = jax.nn.gelu(up + layer["b_up"].astype(c.dtype))
-    up = constrain(up, ("batch", "length", "mlp"))
+    up = constrain(up, ("batch", "length", "mlp_hidden"))
     down = jnp.einsum("bnm,me->bne", up, layer["w_down"].astype(c.dtype))
     down = down + layer["b_down"].astype(c.dtype)
     return x + constrain(down, ("batch", "length", "act_embed"))

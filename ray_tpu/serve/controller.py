@@ -20,6 +20,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.rpc_stubs import ControllerStub
 from ray_tpu.util.ratelimit import log_every
 
 logger = logging.getLogger(__name__)
@@ -78,6 +79,11 @@ class ServeController:
         # one ProxyActor per alive node, reconciled below.
         self._http_cfg: Optional[Dict[str, Any]] = None
         self._proxies: Dict[str, ProxyRecord] = {}  # node hex -> record
+        # Sub-slice reservation ids whose release RPC failed (head
+        # briefly unreachable): retried every reconcile tick — a
+        # silently dropped release would strand the chips until the
+        # hosting node dies. Guarded by _lock.
+        self._pending_releases: List[str] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reconciler = threading.Thread(
@@ -183,9 +189,9 @@ class ServeController:
 
             chips = mesh_shape[0] * mesh_shape[1]
             try:
-                sub = get_core_worker().controller.call(
-                    "reserve_subslice", replica_id, chips,
-                    list(mesh_shape))
+                sub = ControllerStub(
+                    get_core_worker().controller).reserve_subslice(
+                        replica_id, chips, list(mesh_shape))
             except Exception:
                 sub = None  # head unreachable counts as no capacity
             if sub is None:
@@ -195,33 +201,44 @@ class ServeController:
                           "topology frees", mesh_shape[0], mesh_shape[1],
                           replica_id, rec.name)
                 return False
-        actor_cls = ray_tpu.remote(ReplicaActor)
-        opts = dict(rec.cfg.get("actor_options") or {})
-        opts.setdefault("max_concurrency",
-                        rec.cfg.get("max_ongoing_requests", 8))
-        init_kwargs = rec.init_kwargs
-        if sub is not None:
-            from ray_tpu.core import resources as resmath
-            from ray_tpu.core.placement import (
-                NodeAffinitySchedulingStrategy)
-
-            # The scalar accounting half of the reservation: the actor
-            # lease holds chips/slice:<id> against the hosting node, so
-            # vector scheduling and the topology grid agree.
-            res = dict(opts.get("resources") or {})
-            for k, v in resmath.chip_resources(
-                    sub["chips"], sub["slice_id"]).items():
-                res.setdefault(k, v)
-            opts["resources"] = res
-            opts.setdefault("scheduling_strategy",
-                            NodeAffinitySchedulingStrategy(
-                                sub["nodes"][0]))
-            if "mesh_shape" not in (init_kwargs or {}):
-                init_kwargs = dict(init_kwargs or {})
-                init_kwargs["mesh_shape"] = tuple(mesh_shape)
         rec.next_replica_ord += 1
-        handle = actor_cls.options(**opts).remote(
-            rec.cls_blob, rec.init_args, init_kwargs)
+        # Everything fallible between the reservation and the record
+        # append runs under this try: a spawn failure (head blip, bad
+        # actor options) must hand the sub-slice back, or the chips
+        # stay stranded until the hosting node dies (the reservation
+        # has no other owner yet — graftlint: resource-leak-path).
+        try:
+            actor_cls = ray_tpu.remote(ReplicaActor)
+            opts = dict(rec.cfg.get("actor_options") or {})
+            opts.setdefault("max_concurrency",
+                            rec.cfg.get("max_ongoing_requests", 8))
+            init_kwargs = rec.init_kwargs
+            if sub is not None:
+                from ray_tpu.core import resources as resmath
+                from ray_tpu.core.placement import (
+                    NodeAffinitySchedulingStrategy)
+
+                # The scalar accounting half of the reservation: the
+                # actor lease holds chips/slice:<id> against the hosting
+                # node, so vector scheduling and the topology grid agree.
+                res = dict(opts.get("resources") or {})
+                for k, v in resmath.chip_resources(
+                        sub["chips"], sub["slice_id"]).items():
+                    res.setdefault(k, v)
+                opts["resources"] = res
+                opts.setdefault("scheduling_strategy",
+                                NodeAffinitySchedulingStrategy(
+                                    sub["nodes"][0]))
+                if "mesh_shape" not in (init_kwargs or {}):
+                    init_kwargs = dict(init_kwargs or {})
+                    init_kwargs["mesh_shape"] = tuple(mesh_shape)
+            handle = actor_cls.options(**opts).remote(
+                rec.cls_blob, rec.init_args, init_kwargs)
+        except Exception:
+            if sub is not None:
+                self._release_reservation(sub["reservation_id"],
+                                          replica_id)
+            raise
         rec.replicas.append(ReplicaRecord(handle, replica_id, sub))
         if sub is not None:
             try:
@@ -260,16 +277,48 @@ class ServeController:
         if sub is None:
             return
         replica.sub_slice = None
+        self._release_reservation(sub["reservation_id"],
+                                  replica.replica_id)
+
+    def _release_reservation(self, reservation_id: str,
+                             owner: str) -> None:
+        """Release a reservation id, parking it for reconcile-loop
+        retry when the head is unreachable — the release must
+        eventually land, or the chips stay stranded."""
         from ray_tpu.core.runtime import get_core_worker
 
         try:
-            get_core_worker().controller.call(
-                "release_subslice", sub["reservation_id"])
+            ControllerStub(get_core_worker().controller) \
+                .release_subslice(reservation_id)
         except Exception:
+            with self._lock:
+                self._pending_releases.append(reservation_id)
             log_every("serve.release_subslice", 10.0, logger,
-                      "releasing sub-slice %s of replica %s failed",
-                      sub.get("reservation_id"), replica.replica_id,
+                      "releasing sub-slice %s of replica %s failed; "
+                      "queued for retry", reservation_id, owner,
                       exc_info=True)
+
+    def _retry_pending_releases(self) -> None:
+        """Reconcile-tick retry of release RPCs that failed (head
+        blip): idempotent on the controller, so replaying an id that
+        already released is harmless."""
+        with self._lock:
+            if not self._pending_releases:
+                return
+            pending = self._pending_releases
+            self._pending_releases = []
+        from ray_tpu.core.runtime import get_core_worker
+
+        for rid in pending:
+            try:
+                ControllerStub(get_core_worker().controller) \
+                    .release_subslice(rid)
+            except Exception:
+                with self._lock:
+                    self._pending_releases.append(rid)
+                log_every("serve.release_retry", 10.0, logger,
+                          "retrying sub-slice release %s failed", rid,
+                          exc_info=True)
 
     def _drain(self, rec: DeploymentRecord) -> None:
         while rec.replicas:
@@ -302,9 +351,10 @@ class ServeController:
             # min_version keeps subscriber clocks monotonic across a hub
             # (head) restart: routers long-poll with the last version they
             # saw, so a republish below it would never wake them.
-            rec.pub_version = get_core_worker().controller.call(
-                "psub_publish", SNAPSHOT_CHANNEL, rec.name, snapshot,
-                rec.pub_version + 1)
+            rec.pub_version = ControllerStub(
+                get_core_worker().controller).psub_publish(
+                    SNAPSHOT_CHANNEL, rec.name, snapshot,
+                    rec.pub_version + 1)
             return rec.pub_version
         except Exception:
             return None
@@ -487,7 +537,8 @@ class ServeController:
         from ray_tpu.core.runtime import get_core_worker
 
         try:
-            nodes = get_core_worker().controller.call("list_nodes")
+            nodes = ControllerStub(
+                get_core_worker().controller).list_nodes()
         except Exception:
             return None
         alive = [n["node_id"] for n in nodes if n["alive"]]
@@ -538,8 +589,9 @@ class ServeController:
                 from ray_tpu.core.runtime import get_core_worker
 
                 try:
-                    record = get_core_worker().controller.call(
-                        "get_actor", proxy.handle.actor_id.binary())
+                    record = ControllerStub(
+                        get_core_worker().controller).get_actor(
+                            proxy.handle.actor_id.binary())
                 except Exception:
                     # Actor table unreachable: we can neither verify nor
                     # replace (starting a proxy needs the head too), so
@@ -613,6 +665,12 @@ class ServeController:
 
     def _reconcile_loop(self) -> None:
         while not self._stop.wait(0.25):
+            try:
+                self._retry_pending_releases()
+            except Exception:
+                log_every("serve.release_retry_pass", 10.0, logger,
+                          "pending-release retry pass failed",
+                          exc_info=True)
             with self._lock:
                 recs = list(self._deployments.values())
             for rec in recs:
@@ -664,8 +722,9 @@ class ServeController:
             try:
                 from ray_tpu.core.runtime import get_core_worker
 
-                record = get_core_worker().controller.call(
-                    "get_actor", replica.handle.actor_id.binary())
+                record = ControllerStub(
+                    get_core_worker().controller).get_actor(
+                        replica.handle.actor_id.binary())
             except Exception:
                 continue
             if record is None or record["state"] == "DEAD":
@@ -746,9 +805,10 @@ class ServeController:
                 try:
                     from ray_tpu.core.runtime import get_core_worker
 
-                    cur = get_core_worker().controller.call(
-                        "psub_poll", SNAPSHOT_CHANNEL, rec.name, 0, 0.0,
-                        timeout=5.0)
+                    cur = ControllerStub(
+                        get_core_worker().controller).psub_poll(
+                            SNAPSHOT_CHANNEL, rec.name, 0, 0.0,
+                            timeout=5.0)
                 except Exception:
                     cur = rec.pub_version  # unreachable hub: not a reset
                 if cur is None or (isinstance(cur, tuple)

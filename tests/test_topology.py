@@ -210,3 +210,98 @@ def test_node_advertises_chip_resources(slice_cluster):
     assert node["resources"]["chips"] == 8.0
     assert node["slice"]["topology"] == [2, 4]
     assert any(k.startswith("slice:") for k in node["resources"])
+
+
+# ----------------------------- lease lifecycle on serve failure paths
+#
+# Regression tests for the PR 8 lease-leak fixes (graftlint's
+# topology-lease rule found them): a spawn failure between
+# reserve_subslice and the record append must hand the sub-slice back,
+# and a failed release RPC must be queued and retried — either way the
+# chips must never stay stranded.
+
+
+def _bare_serve_controller():
+    """A ServeController shell with just the lease plumbing (no
+    reconcile threads, no cluster)."""
+    import threading
+
+    from ray_tpu.serve.controller import ServeController
+
+    ctl = ServeController.__new__(ServeController)
+    ctl._pending_releases = []
+    ctl._lock = threading.Lock()
+    return ctl
+
+
+class _ScriptedController:
+    """Stands in for the core controller client behind ControllerStub."""
+
+    def __init__(self, fail_releases=0):
+        self.calls = []
+        self._fail_releases = fail_releases
+
+    def call(self, method, *args, **kwargs):
+        self.calls.append((method, args))
+        if method == "reserve_subslice":
+            return {"reservation_id": "resv-1", "slice_id": "s0",
+                    "chips": 4, "nodes": ["n0"], "origin": (0, 0),
+                    "shape": (2, 2)}
+        if method == "release_subslice":
+            if self._fail_releases > 0:
+                self._fail_releases -= 1
+                raise RuntimeError("head unreachable")
+            return True
+        raise AssertionError(f"unexpected RPC {method}")
+
+
+def test_add_replica_releases_reservation_on_spawn_failure(monkeypatch):
+    from ray_tpu.serve import controller as sc
+
+    ctl = _bare_serve_controller()
+    client = _ScriptedController()
+
+    class FakeCore:
+        controller = client
+
+    monkeypatch.setattr("ray_tpu.core.runtime.get_core_worker",
+                        lambda: FakeCore())
+
+    def boom(cls):
+        raise RuntimeError("spawn failed")
+
+    monkeypatch.setattr(sc.ray_tpu, "remote", boom)
+    rec = sc.DeploymentRecord("d", b"", (), {}, {"mesh_shape": (2, 2)})
+    with pytest.raises(RuntimeError, match="spawn failed"):
+        ctl._add_replica(rec)
+    methods = [m for m, _ in client.calls]
+    assert methods == ["reserve_subslice", "release_subslice"]
+    assert client.calls[1][1] == ("resv-1",)
+    assert rec.replicas == []  # nothing half-added
+    assert ctl._pending_releases == []  # released inline, not parked
+
+
+def test_failed_release_is_queued_and_retried(monkeypatch):
+    from ray_tpu.serve import controller as sc
+
+    ctl = _bare_serve_controller()
+    client = _ScriptedController(fail_releases=1)
+
+    class FakeCore:
+        controller = client
+
+    monkeypatch.setattr("ray_tpu.core.runtime.get_core_worker",
+                        lambda: FakeCore())
+    replica = sc.ReplicaRecord(
+        None, "d#0", sub_slice={"reservation_id": "resv-1",
+                                "slice_id": "s0", "chips": 4})
+    ctl._release_subslice(replica)
+    assert replica.sub_slice is None  # idempotence: never re-released
+    assert ctl._pending_releases == ["resv-1"]  # parked, not dropped
+    # the reconcile tick replays it once the head answers again
+    ctl._retry_pending_releases()
+    assert ctl._pending_releases == []
+    releases = [(m, a) for m, a in client.calls
+                if m == "release_subslice"]
+    assert releases == [("release_subslice", ("resv-1",)),
+                        ("release_subslice", ("resv-1",))]
