@@ -3,7 +3,7 @@
 # per-family gates and the stub-drift gate in tests/test_analysis_v3.py).
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
-	bench-paged bench-sharded
+	bench-paged bench-sharded bench-trace trace-demo
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -52,3 +52,16 @@ bench-paged:
 # virtual one; logits bit-exactness is pinned by tests, not here.
 bench-sharded:
 	python bench_decode.py --sections sharded $(BENCH_ARGS)
+
+# Tracing/metrics overhead on the decode step loop (instrumented vs
+# stripped engine; acceptance bar <2%) -> BENCH_SERVE.json.
+bench-trace:
+	python bench_decode.py --sections trace_overhead $(BENCH_ARGS)
+
+# Tiny serve session through the real HTTP proxy -> Chrome trace JSON,
+# validated (loads as JSON, >=1 cross-process parent/child span,
+# engine step slices merged). Tier-1 runs the same demo in-process
+# (tests/test_trace_demo.py).
+trace-demo:
+	JAX_PLATFORMS=cpu python -m ray_tpu.serve.trace_demo \
+		--output /tmp/serve_trace.json

@@ -37,6 +37,30 @@ def pctl(xs, p):
     return xs[max(0, min(len(xs) - 1, math.ceil(p * len(xs)) - 1))]
 
 
+def http_hist_pctl_ms(deployment: str, p: float, timeout_s: float = 15.0):
+    """HTTP latency percentile (ms) from the PROXY's
+    ``serve_http_request_s`` histogram, aggregated by the cluster
+    controller — the bench reads the production instrument (same
+    source as /metrics and the dashboard serve panel) instead of its
+    own client-side list. Bucket-interpolated; polls for the proxy's
+    first metrics flush. None when it never lands."""
+    import time as _t
+
+    from ray_tpu.core.runtime import get_core_worker
+    from ray_tpu.util.metrics import histogram_quantile, merge_histograms
+
+    deadline = _t.monotonic() + timeout_s
+    while _t.monotonic() < deadline:
+        agg = get_core_worker().controller.call("list_metrics",
+                                                timeout=10.0)
+        entry = merge_histograms(agg, "serve_http_request_s").get(
+            (("deployment", deployment),))
+        if entry is not None and entry["count"]:
+            return histogram_quantile(entry, p) * 1e3
+        _t.sleep(0.5)
+    return None
+
+
 SEQ_LEN = 128
 # Two buckets: small for latency at low load, large for throughput under
 # saturation. Probed on-chip: bucket 64 runs at ~109 ms/batch (588 seq/s)
@@ -181,12 +205,30 @@ def main() -> None:
         with urllib.request.urlopen(req, timeout=120) as resp:
             resp.read()
         http_lats.append(time.perf_counter() - t0)
-    rows.append({
-        "metric": "serve_http_latency_p50",
-        "value": round(pctl(http_lats, 0.5) * 1000, 1), "unit": "ms",
-        "note": f"p99={pctl(http_lats, 0.99) * 1000:.1f}ms via per-node "
-                f"ProxyActor (single-threaded client)",
-    })
+    # Proxy-side histogram (serve/metrics.py serve_http_request_s) is
+    # the source of record; the client-side list is kept only as the
+    # cross-check in the note (client ms include connection setup).
+    h_p50 = http_hist_pctl_ms("llama", 0.5)
+    h_p99 = http_hist_pctl_ms("llama", 0.99, timeout_s=1.0)
+    if h_p50 is not None:
+        rows.append({
+            "metric": "serve_http_latency_p50",
+            "value": round(h_p50, 1), "unit": "ms",
+            "note": (f"p99={h_p99:.1f}ms from the proxy's "
+                     f"serve_http_request_s histogram (bucket-"
+                     f"interpolated pctl; same source as /metrics); "
+                     f"client-side cross-check p50="
+                     f"{pctl(http_lats, 0.5) * 1000:.1f}ms via per-node "
+                     f"ProxyActor (single-threaded client)"),
+        })
+    else:
+        rows.append({
+            "metric": "serve_http_latency_p50",
+            "value": round(pctl(http_lats, 0.5) * 1000, 1), "unit": "ms",
+            "note": f"p99={pctl(http_lats, 0.99) * 1000:.1f}ms via "
+                    f"per-node ProxyActor (single-threaded client; "
+                    f"proxy histogram never flushed — fallback)",
+        })
     serve.delete("llama")
 
     # ---- 4: autoscale-up-under-load (CPU replicas; one chip = one TPU

@@ -82,9 +82,9 @@ def _family_checks():
     always built, but per-file emission work is skipped for files
     outside ``emit_files`` (the --diff fast path)."""
     from ray_tpu.analysis import (guarded_by, lifecycle_hygiene, lifetime,
-                                  lock_discipline, reactor_safety,
-                                  rpc_contract, sharding_safety, stubgen,
-                                  trace_safety)
+                                  lock_discipline, metrics_lint,
+                                  reactor_safety, rpc_contract,
+                                  sharding_safety, stubgen, trace_safety)
 
     return {
         "reactor-safety": (True, reactor_safety.check),
@@ -96,6 +96,7 @@ def _family_checks():
         "rpc-contract": (True, rpc_contract.check),
         "sharding-safety": (True, sharding_safety.check),
         "rpc-stubs": (True, stubgen.check),
+        "metrics": (False, metrics_lint.check_project),
     }
 
 

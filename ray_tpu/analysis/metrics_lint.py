@@ -1,0 +1,140 @@
+"""metrics-name-collision: one metric name, one definition.
+
+The metrics registry keys entries by (name, tags); two call sites
+registering the SAME name as different KINDS (Counter vs Histogram) or
+with different histogram BUCKET grids silently produce entries that can
+never be merged — the controller aggregation, ``slo_summary`` and the
+Prometheus text all key by name, so the collision corrupts every
+downstream percentile instead of failing anywhere visible. This check
+makes it fail at ``make lint``.
+
+Collected package-wide: constructor calls of ``Counter`` / ``Gauge`` /
+``Histogram`` that resolve (via the module's imports) to
+``ray_tpu.util.metrics`` — ``collections.Counter`` and friends are not
+confused — whose first argument is a literal string. The definition
+signature is (kind, boundaries-literal); the first site wins and every
+later disagreeing site is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.analysis import rules
+from ray_tpu.analysis.core import Finding, Project, qualname_of
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRICS_MODULE = "ray_tpu.util.metrics"
+
+
+def _metric_aliases(tree: ast.AST) -> Tuple[Dict[str, str], set]:
+    """(direct aliases: local name -> metric class) and (module
+    aliases: local names bound to ray_tpu.util.metrics itself)."""
+    direct: Dict[str, str] = {}
+    mod_aliases: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == _METRICS_MODULE:
+                for a in node.names:
+                    if a.name in _METRIC_CLASSES:
+                        direct[a.asname or a.name] = a.name
+            elif node.module == "ray_tpu.util":
+                for a in node.names:
+                    if a.name == "metrics":
+                        mod_aliases.add(a.asname or "metrics")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _METRICS_MODULE:
+                    mod_aliases.add(a.asname or "ray_tpu")
+    return direct, mod_aliases
+
+
+def _resolve_metric_class(call: ast.Call, direct: Dict[str, str],
+                          mod_aliases: set) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return direct.get(fn.id)
+    if (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_CLASSES
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in mod_aliases):
+        return fn.attr
+    return None
+
+
+def _boundaries_literal(call: ast.Call) -> Optional[str]:
+    """Canonical text of the ``boundaries`` argument (kwarg or the
+    Histogram signature's 3rd positional). None = registry default.
+    Compared as AST dumps: a NON-literal expression only matches
+    itself spelled identically, which is exactly the conservative
+    behavior wanted (same constant name = same grid)."""
+    for kw in call.keywords:
+        if kw.arg == "boundaries":
+            return ast.dump(kw.value)
+    if len(call.args) >= 3:
+        return ast.dump(call.args[2])
+    return None
+
+
+def check_project(project: Project, emit_files=None) -> List[Finding]:
+    # First pass: every literal-name registration in the package, in
+    # deterministic file order, so "first site wins" is stable.
+    sites: Dict[str, List[dict]] = {}
+    for f in sorted(project.files, key=lambda s: s.relpath):
+        direct, mod_aliases = _metric_aliases(f.tree)
+        if not direct and not mod_aliases:
+            continue
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            is_scope = isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))
+            if is_scope:
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+            if not isinstance(node, ast.Call):
+                return
+            cls = _resolve_metric_class(node, direct, mod_aliases)
+            if cls is None or not node.args:
+                return
+            name_arg = node.args[0]
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                return
+            sites.setdefault(name_arg.value, []).append({
+                "relpath": f.relpath, "line": node.lineno,
+                "symbol": qualname_of(stack), "cls": cls,
+                "boundaries": (_boundaries_literal(node)
+                               if cls == "Histogram" else None),
+            })
+
+        visit(f.tree)
+
+    findings: List[Finding] = []
+    for name, regs in sites.items():
+        first = regs[0]
+        for site in regs[1:]:
+            if site["cls"] != first["cls"]:
+                msg = (f"metric {name!r} registered as {site['cls']} "
+                       f"here but as {first['cls']} at "
+                       f"{first['relpath']}:{first['line']} — one name, "
+                       f"one kind")
+            elif (site["cls"] == "Histogram"
+                  and site["boundaries"] != first["boundaries"]):
+                msg = (f"histogram {name!r} registered with different "
+                       f"bucket boundaries than "
+                       f"{first['relpath']}:{first['line']} — entries "
+                       f"with mismatched grids can never be merged")
+            else:
+                continue
+            if (emit_files is not None
+                    and site["relpath"] not in emit_files):
+                continue
+            findings.append(Finding(
+                rule=rules.METRICS_COLLISION, path=site["relpath"],
+                line=site["line"], symbol=site["symbol"], message=msg))
+    return findings

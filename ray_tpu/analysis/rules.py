@@ -27,6 +27,7 @@ SHARDING_ANCHOR = "sharding-missing-anchor"
 SHARDING_UNPINNED = "sharding-unpinned-mesh-call"
 SHARDING_UNSCOPED = "sharding-unscoped-trace"
 RPC_STUB_DRIFT = "rpc-stub-drift"
+METRICS_COLLISION = "metrics-name-collision"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -39,9 +40,10 @@ ALL_RULES = (
     SHARDING_CONTRACTION, SHARDING_ANCHOR,
     SHARDING_UNPINNED, SHARDING_UNSCOPED,
     RPC_STUB_DRIFT,
+    METRICS_COLLISION,
 )
 
-# The nine checker families, for ``--jobs`` scheduling and per-family
+# The ten checker families, for ``--jobs`` scheduling and per-family
 # stats: family name -> tuple of rule ids it emits.
 FAMILIES = {
     "reactor-safety": (REACTOR_BLOCKING,),
@@ -54,6 +56,7 @@ FAMILIES = {
     "sharding-safety": (SHARDING_CONTRACTION, SHARDING_ANCHOR,
                         SHARDING_UNPINNED, SHARDING_UNSCOPED),
     "rpc-stubs": (RPC_STUB_DRIFT,),
+    "metrics": (METRICS_COLLISION,),
 }
 
 # ------------------------------------------------- blocking-API tables

@@ -262,6 +262,31 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "to pow-2 least-loaded), so hot system prompts stay resident on "
         "one replica's prefix pool instead of re-prefilling on every "
         "replica."),
+    "serve_metrics_enabled": (bool, True,
+        "Serve SLO instruments (serve/metrics.py): TTFT, inter-token and "
+        "queue-wait histograms plus request-outcome/retry/preemption "
+        "counters, labeled by deployment, flushed through the cluster "
+        "metrics pipeline and served as Prometheus text from the HTTP "
+        "proxy's /metrics route. All observations are per-REQUEST (never "
+        "per token), so the decode step loop pays nothing per step."),
+    "serve_trace_spans": (bool, True,
+        "Request tracing through the serve plane: the HTTP proxy, router "
+        "and DecodeEngine record spans (admission/queue wait, prefill "
+        "chunks, decode, retries, preemption, outcome) into the task-event "
+        "buffer so `python -m ray_tpu timeline --serve` renders one "
+        "causally-linked Chrome trace across processes. Spans are "
+        "per-request/per-chunk, never per token or per step."),
+    "decode_step_timeline": (int, 256,
+        "Entries in a DecodeEngine's step-timeline ring "
+        "(serve/steplog.py): per-step phase (prefill chunk vs decode), "
+        "batch occupancy and page alloc/free/preempt + jit-compile "
+        "events, dumpable via engine stats / the replica RPC and merged "
+        "into the serve Chrome trace. 0 disables the recorder."),
+    "metrics_flush_interval_s": (float, 5.0,
+        "Period of the per-process metrics flusher pushing registry "
+        "snapshots to the cluster controller. Snapshots are CUMULATIVE, "
+        "so a missed push (controller restart) never double-counts — the "
+        "next successful push supersedes it."),
 }
 
 

@@ -1045,7 +1045,8 @@ class CoreWorker:
             self._current_task_desc.value = spec.get("desc", "")
             from ray_tpu.util import tracing
 
-            with tracing.activate(spec.get("trace")):
+            with tracing.activate(spec.get("trace"),
+                                  name=f"task:{spec.get('desc', '')}"):
                 result = fn(*args, **kwargs)
                 if spec.get("streaming"):
                     # Streaming-generator task: push each yielded item to
@@ -1844,7 +1845,8 @@ class ActorExecutionRuntime:
 
             method = getattr(self.instance, method_name)
             args, kwargs = self.core._resolve_args(spec["args_blob"])
-            with tracing.activate(spec.get("trace")):
+            with tracing.activate(spec.get("trace"),
+                                  name=f"actor:{method_name}"):
                 if self.is_async:
                     result = self._run_async(method, args, kwargs)
                 elif self.max_concurrency > 1:

@@ -188,6 +188,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(json.dumps(self._logs(query)).encode())
             elif path == "/api/history":
                 self._send(json.dumps(self.history.snapshot()).encode())
+            elif path == "/api/serve":
+                self._send(json.dumps(self._serve_slo()).encode())
             elif path == "/metrics":
                 self._send(self.client.call("metrics_text").encode(),
                            "text/plain")
@@ -380,6 +382,56 @@ class _Handler(BaseHTTPRequestHandler):
                      f"this node</a></p>")
         return _PAGE % html
 
+    def _serve_slo(self) -> Dict:
+        """Per-deployment serve SLO summaries from the controller's
+        aggregated metrics — the SAME ``serve.metrics.slo_summary``
+        read that backs ``serve.status()``'s slo dicts, so the panel
+        and the API can never disagree about a latency number."""
+        from ray_tpu.serve.metrics import slo_summary
+
+        return slo_summary(self.client.call("list_metrics", timeout=5.0))
+
+    @staticmethod
+    def _fmt_ms(summary: Optional[Dict], field: str) -> str:
+        if not summary:
+            return ""
+        v = summary.get(field)
+        return f"{v * 1000:.1f}ms" if v is not None else ""
+
+    def _render_serve_panel(self) -> str:
+        """Serve panel rows: one per deployment with TTFT / inter-token
+        / queue-wait p50+p99 and outcome counters."""
+        try:
+            slo = self._serve_slo()
+        except Exception:
+            return ""
+        if not slo:
+            return ""
+        rows = []
+        for dep, rec in sorted(slo.items()):
+            outcomes = rec.get("outcomes", {})
+            rows.append({
+                "deployment": _esc(dep),
+                "requests": sum(outcomes.values()),
+                "ttft_p50": self._fmt_ms(rec.get("ttft_s"), "p50"),
+                "ttft_p99": self._fmt_ms(rec.get("ttft_s"), "p99"),
+                "tok_p50": self._fmt_ms(rec.get("inter_token_s"), "p50"),
+                "tok_p99": self._fmt_ms(rec.get("inter_token_s"), "p99"),
+                "queue_p99": self._fmt_ms(rec.get("queue_wait_s"), "p99"),
+                "degraded": _esc(", ".join(
+                    f"{k}={v}" for k, v in sorted(outcomes.items())
+                    if k != "completed" and v)
+                    + (f", retries={rec['retries']}"
+                       if rec.get("retries") else "")
+                    + (f", preempted={rec['preempted']}"
+                       if rec.get("preempted") else "")),
+            })
+        return ("<h2>serve SLOs</h2>"
+                + _table(rows, ["deployment", "requests", "ttft_p50",
+                                "ttft_p99", "tok_p50", "tok_p99",
+                                "queue_p99", "degraded"])
+                + "<p><a href='/api/serve'>/api/serve</a></p>")
+
     def _memory(self, nodes=None):
         """Per-node object-store usage via the shared node-info poll
         (bounded RPCs: one hung supervisor can't wedge the page; the
@@ -442,6 +494,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
         html += "<h2>object store</h2>" + _table(
             mem, ["node_id", "store", "spilled", "workers", "oom_kills"])
+        html += self._render_serve_panel()
         # Recent tasks with drill-down links.
         events = self.client.call("list_task_events", 20)
         trows = [{

@@ -151,30 +151,95 @@ def cmd_list(args) -> None:
         raise SystemExit(f"unknown kind {kind!r}")
 
 
-def cmd_timeline(args) -> None:
-    """Dump task events as a Chrome trace (chrome://tracing /
-    ui.perfetto.dev) — reference: ``ray timeline``,
-    ``_private/state.py:942``."""
-    client = _client(args)
-    events = client.call("list_task_events", args.limit)
-    trace = []
+def build_chrome_trace(events: List[Dict[str, Any]],
+                       serve_timelines: Optional[Dict[str, Any]] = None
+                       ) -> List[Dict[str, Any]]:
+    """Task events (+ optional serve engine step timelines) -> Chrome
+    trace events (chrome://tracing / ui.perfetto.dev). Spans carry
+    span_id/parent_span in args AND emit flow arrows between parent and
+    child — the rendering of the causal chain a serve request leaves
+    across proxy, router and replica processes. Shared by the timeline
+    CLI, ``serve/trace_demo.py`` and the tests that assert on it."""
+    trace: List[Dict[str, Any]] = []
+    span_pid: Dict[str, tuple] = {}  # span_id -> (pid, tid, end_ts)
     for e in events:
         if not e.get("lease_ts") or not e.get("end_ts"):
             continue
+        is_span = e.get("state") == "SPAN"
+        pid = str(e.get("owner", "driver"))
+        tid = e.get("worker") or "worker"
         trace.append({
             "name": e.get("desc", e["task_id"][:8]),
-            "cat": "span" if e.get("state") == "SPAN" else "task",
+            "cat": "span" if is_span else "task",
             "ph": "X",
             "ts": e["lease_ts"] * 1e6,
             "dur": (e["end_ts"] - e["lease_ts"]) * 1e6,
-            "pid": str(e.get("owner", "driver")),
-            "tid": e.get("worker") or "worker",
+            "pid": pid,
+            "tid": tid,
             "args": {"state": e.get("state"),
-                     "trace_id": e.get("trace_id")},
+                     "trace_id": e.get("trace_id"),
+                     "span_id": e.get("span_id"),
+                     "parent_span": e.get("parent_span"),
+                     **(e.get("attrs") or {})},
         })
+        if is_span and e.get("span_id"):
+            span_pid[e["span_id"]] = (pid, tid, e["lease_ts"])
+    # Flow arrows parent -> child (chrome renders them as curved links;
+    # perfetto groups them as one flow per trace step).
+    for e in events:
+        parent = e.get("parent_span")
+        if (e.get("state") != "SPAN" or not parent
+                or parent not in span_pid or not e.get("lease_ts")):
+            continue
+        src_pid, src_tid, _ = span_pid[parent]
+        flow_id = f"{parent}->{e['span_id']}"
+        trace.append({"name": "causal", "cat": "flow", "ph": "s",
+                      "id": flow_id, "ts": span_pid[parent][2] * 1e6,
+                      "pid": src_pid, "tid": src_tid})
+        trace.append({"name": "causal", "cat": "flow", "ph": "f",
+                      "bp": "e", "id": flow_id,
+                      "ts": e["lease_ts"] * 1e6,
+                      "pid": str(e.get("owner", "driver")),
+                      "tid": e.get("worker") or "worker"})
+    for deployment, replicas in (serve_timelines or {}).items():
+        from ray_tpu.serve.steplog import timeline_chrome_events
+
+        for replica_id, dump in replicas.items():
+            pid = f"engine:{replica_id}"
+            trace.append({"name": "process_name", "ph": "M", "pid": pid,
+                          "args": {"name": f"engine {replica_id}"}})
+            trace.extend(timeline_chrome_events(dump, pid=pid))
+    return trace
+
+
+def cmd_timeline(args) -> None:
+    """Dump task events as a Chrome trace (chrome://tracing /
+    ui.perfetto.dev) — reference: ``ray timeline``,
+    ``_private/state.py:942``. With ``--serve``, additionally joins the
+    cluster, pulls every decode replica's engine step timeline through
+    the serve controller and merges it into the same trace: request
+    spans (proxy http -> router -> attempts -> replica -> engine
+    queue-wait/prefill/decode) alongside the per-step engine record
+    that explains WHY a given token was slow."""
+    serve_timelines = None
+    if getattr(args, "serve", False):
+        import ray_tpu
+        from ray_tpu import serve
+
+        ray_tpu.init(address=resolve_address(args.address))
+        try:
+            serve_timelines = serve.timelines()
+        finally:
+            ray_tpu.shutdown()
+    client = _client(args)
+    events = client.call("list_task_events", args.limit)
+    trace = build_chrome_trace(events, serve_timelines)
     with open(args.output, "w") as f:
         json.dump(trace, f)
-    print(f"wrote {len(trace)} events to {args.output}")
+    n_spans = sum(1 for t in trace if t.get("cat") == "span")
+    n_engine = sum(1 for t in trace if t.get("cat") == "engine-step")
+    print(f"wrote {len(trace)} events ({n_spans} spans, {n_engine} "
+          f"engine-step slices) to {args.output}")
 
 
 def cmd_start(args) -> int:
@@ -490,6 +555,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
+    p_tl.add_argument("--serve", action="store_true",
+                      help="merge every serve replica's engine step "
+                           "timeline into the trace (joins the cluster "
+                           "to reach the serve controller)")
     sub.add_parser("stacks")
     p_prof = sub.add_parser("profile")
     p_prof.add_argument("worker", help="worker id (hex prefix ok)")

@@ -10,7 +10,9 @@ from ray_tpu.serve.api import (  # noqa: F401
     start_http,
     status,
     stop_http,
+    timelines,
 )
+from ray_tpu.serve.metrics import slo_summary  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.decode import (  # noqa: F401
     DecodeEngine,

@@ -50,9 +50,44 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
 
-def status(timeout: float = 30.0) -> Dict[str, Any]:
+def status(timeout: float = 30.0, include_slo: bool = True
+           ) -> Dict[str, Any]:
+    """Per-deployment control-plane state, plus (``include_slo``) the
+    SLO DISTRIBUTIONS from the metrics pipeline: each deployment gains
+    an ``slo`` dict with TTFT / inter-token / queue-wait / HTTP-latency
+    histogram summaries (count, mean, p50, p99) and outcome counters —
+    the same numbers the dashboard serve panel and the proxy's
+    ``/metrics`` route report, because all three read the controller's
+    aggregated registry through ``serve.metrics.slo_summary``."""
     controller = get_or_create_controller()
-    return ray_tpu.get(controller.status.remote(), timeout=timeout)
+    st = ray_tpu.get(controller.status.remote(), timeout=timeout)
+    if include_slo:
+        try:
+            from ray_tpu.core.runtime import get_core_worker
+            from ray_tpu.serve.metrics import slo_summary
+
+            agg = get_core_worker().controller.call("list_metrics",
+                                                    timeout=10.0)
+            slo = slo_summary(agg)
+            for name, rec in st.items():
+                rec["slo"] = slo.get(name, {})
+        except Exception:
+            # Histograms are additive detail: a briefly unreachable
+            # head must not fail the whole status() call.
+            from ray_tpu.util.ratelimit import log_every
+
+            log_every("serve.status_slo", 30.0,
+                      __import__("logging").getLogger(__name__),
+                      "SLO summary fetch failed", exc_info=True)
+    return st
+
+
+def timelines(timeout: float = 30.0) -> Dict[str, Any]:
+    """Engine step timelines per deployment/replica (see
+    ``serve/steplog.py``); merged into a Chrome trace by
+    ``python -m ray_tpu timeline --serve``."""
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.timelines.remote(), timeout=timeout)
 
 
 def proxy_status(timeout: float = 30.0) -> Dict[str, Any]:

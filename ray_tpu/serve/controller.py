@@ -233,7 +233,8 @@ class ServeController:
                     init_kwargs = dict(init_kwargs or {})
                     init_kwargs["mesh_shape"] = tuple(mesh_shape)
             handle = actor_cls.options(**opts).remote(
-                rec.cls_blob, rec.init_args, init_kwargs)
+                rec.cls_blob, rec.init_args, init_kwargs,
+                replica_id=replica_id)
         except Exception:
             if sub is not None:
                 self._release_reservation(sub["reservation_id"],
@@ -427,6 +428,30 @@ class ServeController:
                 }
                 for name, rec in self._deployments.items()
             }
+
+    def timelines(self) -> Dict[str, Any]:
+        """Engine step timelines of every replica, keyed deployment ->
+        replica_id (``ray_tpu timeline --serve`` merges them into the
+        cross-process Chrome trace). Bounded per-replica RPCs OUTSIDE
+        the controller lock; unreachable replicas report empty."""
+        with self._lock:
+            recs = {name: list(rec.replicas)
+                    for name, rec in self._deployments.items()}
+        out: Dict[str, Any] = {}
+        for name, replicas in recs.items():
+            dep = out.setdefault(name, {})
+            refs = [(r, r.handle.engine_timeline.remote())
+                    for r in replicas]
+            for replica, ref in refs:
+                try:
+                    dep[replica.replica_id] = ray_tpu.get(ref,
+                                                          timeout=10.0)
+                except Exception:
+                    log_every("serve.timelines", 30.0, logger,
+                              "timeline dump from replica %s failed",
+                              replica.replica_id, exc_info=True)
+                    dep[replica.replica_id] = {"rows": []}
+        return out
 
     def proxy_status(self) -> Dict[str, Any]:
         with self._lock:

@@ -74,6 +74,12 @@ class _Request:
     admitted: bool = False                 # left the pending queue
     status: str = "pending"                # terminal: completed |
     #   cancelled | deadline_exceeded | error
+    # ------------------------------------------------------ observability
+    trace: Optional[tuple] = None          # (trace_id, span_id) captured
+    #   at submit: the engine's loop thread attributes queue-wait /
+    #   prefill / decode spans back to the submitting request's trace
+    admitted_at: Optional[float] = None    # first prefill dispatch
+    preemptions: int = 0                   # times requeued by page pressure
 
     def raise_for_status(self) -> None:
         """Re-raise this request's terminal outcome as its typed error."""
@@ -109,7 +115,11 @@ class DecodeEngine:
                  pool_pages: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_max_pages: Optional[int] = None,
-                 mesh_shape=None, mesh=None):
+                 mesh_shape=None, mesh=None,
+                 step_timeline: Optional[int] = None,
+                 metrics_enabled: Optional[bool] = None,
+                 trace_spans: Optional[bool] = None,
+                 metrics_deployment: Optional[str] = None):
         import jax
 
         from ray_tpu.core.config import config as rt_config
@@ -351,6 +361,28 @@ class DecodeEngine:
             static_argnames=("k",), donate_argnums=(1,), **cache_out))
         self.steps = 0
         self.tokens_out = 0
+        # ---------------------------------------------- observability
+        # SLO metrics + trace spans are per-REQUEST (terminal outcomes,
+        # admission, per-wave prefills) and the step recorder is one
+        # deque append per step — nothing here touches the per-token
+        # path, so the decode loop's cost is unchanged at steady state
+        # (bench_decode.py --sections trace_overhead pins <2%).
+        from ray_tpu.serve.replica import replica_ident
+        from ray_tpu.serve.steplog import StepTimeline
+
+        self._obs_metrics = (rt_config.serve_metrics_enabled
+                             if metrics_enabled is None else metrics_enabled)
+        self._obs_spans = (rt_config.serve_trace_spans
+                           if trace_spans is None else trace_spans)
+        ident = replica_ident()
+        self._mtags = {"deployment": (metrics_deployment
+                                      or ident["deployment"] or "-")}
+        self._replica_id = ident["replica_id"]
+        self.steplog = StepTimeline(
+            rt_config.decode_step_timeline
+            if step_timeline is None else step_timeline)
+        self._compiled: set = set()  # program keys dispatched once
+        self._prefill_waves = 0      # prefill programs dispatched
 
     def _mesh_scoped(self, fn):
         """Mesh engines trace every program inside the decode axis-rules
@@ -484,7 +516,19 @@ class DecodeEngine:
         None = genuinely dry (caller preempts or backs off)."""
         if self._pages.free_count < n and self.prefix is not None:
             self.prefix.reclaim(n - self._pages.free_count)
-        return self._pages.alloc(n)
+        got = self._pages.alloc(n)
+        if got is None:
+            return None
+        try:
+            if self.steplog.enabled:
+                self.steplog.event("page-alloc", n=n,
+                                   free=self._pages.free_count)
+        except BaseException:
+            # Exception-safety for the lease: an event-recording
+            # failure must hand the pages back, not strand them.
+            self._pages.free(got)
+            raise
+        return got
 
     def _set_slot_pages(self, slot: int, pages: List[int]) -> None:
         self._block_tables[slot, :] = 0
@@ -544,6 +588,21 @@ class DecodeEngine:
         req.prefix_len = 0
         req.prefilled = 0
         self.preempted += 1
+        req.preemptions += 1
+        if self._obs_metrics:
+            from ray_tpu.serve import metrics as smetrics
+
+            smetrics.PREEMPTIONS.inc(1.0, self._mtags)
+        if self.steplog.enabled:
+            self.steplog.event("preempt", request=req.request_id,
+                               tokens=req.generated)
+        if self._obs_spans and req.trace is not None:
+            from ray_tpu.util import tracing
+
+            now = time.time()
+            tracing.record_span("preempt", now, now, ctx=req.trace,
+                                request=req.request_id,
+                                tokens=req.generated)
         self._requeue.insert(0, req)
         self._queued_tokens += len(req.tokens)
         with self._reqs_lock:
@@ -588,15 +647,36 @@ class DecodeEngine:
         if deadline_s is not None:
             if deadline_s <= 0:
                 self.deadline_exceeded += 1
+                if self._obs_metrics:
+                    from ray_tpu.serve import metrics as smetrics
+
+                    smetrics.REQUESTS.inc(1.0, {
+                        **self._mtags, "outcome": "deadline_exceeded"})
                 raise DeadlineExceededError(
                     f"request {req.request_id} arrived with an already-"
                     f"expired deadline ({deadline_s:.3f}s)")
             req.deadline = time.monotonic() + float(deadline_s)
+        if self._obs_spans:
+            from ray_tpu.util import tracing
+
+            req.trace = tracing.current()  # loop-thread spans attach here
         # Load shedding happens HERE, at enqueue — not after minutes in
         # queue. qsize() can transiently overshoot by concurrent
         # submitters, but the check bounds the queue within one wave.
         if self._pending.qsize() - self._queued_cancelled >= self.queue_max:
             self.shed += 1
+            if self._obs_metrics:
+                from ray_tpu.serve import metrics as smetrics
+
+                smetrics.REQUESTS.inc(1.0, {**self._mtags,
+                                            "outcome": "shed"})
+            if req.trace is not None:
+                from ray_tpu.util import tracing
+
+                now = time.time()
+                tracing.record_span("engine-shed", now, now,
+                                    ctx=req.trace,
+                                    request=req.request_id)
             raise OverloadedError(
                 f"decode queue at capacity ({self.queue_max} pending, "
                 f"{self.slots} slots)",
@@ -636,6 +716,95 @@ class DecodeEngine:
                 self._queued_cancelled += 1
         self._work.set()  # wake a parked loop so the drop is prompt
         return True
+
+    # ------------------------------------------------ observability hooks
+    #
+    # All per-request: admission (queue-wait), wave prefills, terminal
+    # outcomes. The per-token and per-step paths never touch the metrics
+    # registry or the task-event buffer.
+
+    def _mark_admitted(self, reqs: List["_Request"]) -> None:
+        """Queue wait ends: the wave is about to dispatch device work.
+        First admission only — a preemption requeue keeps its original
+        admission time (queue_wait measures admission latency, not
+        lifetime)."""
+        fresh = [r for r in reqs if r.admitted_at is None]
+        if not fresh:
+            return
+        now = time.monotonic()
+        for req in fresh:
+            req.admitted_at = now
+        if self._obs_metrics:
+            from ray_tpu.serve import metrics as smetrics
+
+            smetrics.QUEUE_WAIT.observe_many(
+                [now - r.submitted_at for r in fresh], self._mtags)
+        if self._obs_spans:
+            from ray_tpu.util import tracing
+
+            wall = time.time()
+            for req in fresh:
+                if req.trace is not None:
+                    tracing.record_span(
+                        "queue-wait", wall - (now - req.submitted_at),
+                        wall, ctx=req.trace, request=req.request_id)
+
+    def _wave_span(self, name: str, t0_wall: float,
+                   reqs: List["_Request"], **attrs: Any) -> None:
+        """One span per request of a batched device call (the wave is
+        one program; each request's trace gets its own slice of it)."""
+        if not self._obs_spans:
+            return
+        from ray_tpu.util import tracing
+
+        t1 = time.time()
+        for req in reqs:
+            if req.trace is not None:
+                tracing.record_span(name, t0_wall, t1, ctx=req.trace,
+                                    request=req.request_id, **attrs)
+
+    def _mark_compile(self, key: tuple) -> None:
+        """First dispatch of a program key = a jit compile on this
+        engine; later dispatches of the same key are cache hits."""
+        if key not in self._compiled:
+            self._compiled.add(key)
+            if self.steplog.enabled:
+                self.steplog.event("jit-compile", key="/".join(
+                    str(k) for k in key))
+
+    def _observe_terminal(self, req: "_Request", status: str) -> None:
+        """Terminal bookkeeping shared by _finish and _retire: outcome
+        counter, TTFT / inter-token histograms, and the request's
+        engine-side spans (decode slice + whole-request outcome)."""
+        if self._obs_metrics:
+            from ray_tpu.serve import metrics as smetrics
+
+            smetrics.REQUESTS.inc(1.0, {**self._mtags, "outcome": status})
+            if req.first_token_at is not None:
+                smetrics.TTFT.observe(
+                    req.first_token_at - req.submitted_at, self._mtags)
+                if status == "completed" and req.generated > 1:
+                    # Stream duration / token, once per request: robust
+                    # to chunked emission's bursty raw gaps, and never a
+                    # per-token registry hit.
+                    smetrics.INTER_TOKEN.observe(
+                        (req.finished_at - req.first_token_at)
+                        / (req.generated - 1), self._mtags)
+        if self._obs_spans and req.trace is not None:
+            from ray_tpu.util import tracing
+
+            off = time.time() - time.monotonic()  # mono -> wall
+            if (req.first_token_at is not None
+                    and req.finished_at > req.first_token_at):
+                tracing.record_span(
+                    "decode", req.first_token_at + off,
+                    req.finished_at + off, ctx=req.trace,
+                    request=req.request_id, tokens=req.generated)
+            tracing.record_span(
+                "engine-request", req.submitted_at + off,
+                req.finished_at + off, ctx=req.trace,
+                request=req.request_id, outcome=status,
+                tokens=req.generated, preemptions=req.preemptions)
 
     # -------------------------------------------------------- the loop
 
@@ -694,6 +863,7 @@ class DecodeEngine:
                     hits.append(req)
                 else:
                     misses.append(req)
+            self._mark_admitted(live)
             self._admit_full(misses)
             self._admit_suffix(hits)
 
@@ -707,6 +877,7 @@ class DecodeEngine:
         chunk = self.prefill_chunk_tokens
         full_group: List[_Request] = []
         suffix_group: List[_Request] = []
+        seated: List[_Request] = []
         for i, req in enumerate(live):
             m = (self.prefix.match(req.tokens)
                  if self.prefix is not None else None)
@@ -735,6 +906,7 @@ class DecodeEngine:
                 self.cache["length"] = \
                     self.cache["length"].at[slot].set(req.prefix_len)
                 self._prefilling[slot] = req
+                seated.append(req)
                 continue
             need = self._seq_pages(len(req.tokens)) - len(req.prefix_pages)
             pages = self._alloc_pages(need)
@@ -754,7 +926,9 @@ class DecodeEngine:
             slot = self._free.pop()
             req.slot = slot
             self._set_slot_pages(slot, req.prefix_pages + pages)
+            seated.append(req)
             (suffix_group if req.prefix_len else full_group).append(req)
+        self._mark_admitted(seated)
         self._admit_paged_full(full_group)
         self._admit_paged_suffix(suffix_group)
         return not self._requeue
@@ -788,12 +962,17 @@ class DecodeEngine:
                 rows[i] = rows[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
                 bt[i] = bt[len(group) - 1]
+            self._mark_compile(("paged_prefill", n, bucket))
+            self._prefill_waves += 1
+            t0 = time.time()
             logits, self.cache = self._paged_prefill(
                 self.params, self.cache, jnp.asarray(rows),
                 jnp.asarray(lengths), jnp.asarray(bt),
                 jnp.asarray(slot_ids), n=n, bucket=bucket)
-            self._post_admit(group, [r.slot for r in group],
-                             np.asarray(logits))
+            logits = np.asarray(logits)
+            self._wave_span("prefill", t0, group, n=len(group),
+                            bucket=bucket)
+            self._post_admit(group, [r.slot for r in group], logits)
 
     def _admit_paged_suffix(self, reqs: List[_Request]) -> None:
         """Prefix-hit paged admissions: the shared pages are already in
@@ -837,13 +1016,18 @@ class DecodeEngine:
                 plens[i] = plens[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
                 bt[i] = bt[len(group) - 1]
+            self._mark_compile(("paged_suffix", n, bucket, width))
+            self._prefill_waves += 1
+            t0 = time.time()
             logits, self.cache = self._paged_suffix(
                 self.params, self.cache, jnp.asarray(rows),
                 jnp.asarray(plens), jnp.asarray(lengths),
                 jnp.asarray(bt), jnp.asarray(slot_ids),
                 n=n, bucket=bucket, width=width)
-            self._post_admit(group, [r.slot for r in group],
-                             np.asarray(logits))
+            logits = np.asarray(logits)
+            self._wave_span("suffix-prefill", t0, group, n=len(group),
+                            bucket=bucket)
+            self._post_admit(group, [r.slot for r in group], logits)
 
     def _prefill_tick(self) -> None:
         """Chunked-prefill interleaving: advance the OLDEST mid-prefill
@@ -880,6 +1064,8 @@ class DecodeEngine:
         rows[0, :step_tok] = req.tokens[req.prefilled:
                                         req.prefilled + step_tok]
         bt = self._block_tables[slot:slot + 1, :width]
+        self._mark_compile(("paged_suffix", 1, bucket, width))
+        t0 = time.time()
         logits, self.cache = self._paged_suffix(
             self.params, self.cache, jnp.asarray(rows),
             jnp.asarray([req.prefilled], np.int32),
@@ -887,6 +1073,9 @@ class DecodeEngine:
             jnp.asarray(bt), jnp.asarray([slot], np.int32),
             n=1, bucket=bucket, width=width)
         self.prefill_chunks += 1
+        self._wave_span("prefill-chunk", t0, [req], tokens=step_tok,
+                        prefilled=req.prefilled + step_tok,
+                        prompt=len(req.tokens))
         req.prefilled += step_tok
         if req.prefilled >= len(req.tokens):
             self._prefilling.pop(slot)
@@ -900,6 +1089,7 @@ class DecodeEngine:
             self.cancelled += 1
         elif status == "deadline_exceeded":
             self.deadline_exceeded += 1
+        self._observe_terminal(req, status)
         with self._reqs_lock:
             self._requests.pop(req.request_id, None)
         req.done.set()
@@ -979,11 +1169,17 @@ class DecodeEngine:
             for i in range(len(group), n):  # idempotent pad rows
                 rows[i] = rows[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
+            self._mark_compile(("prefill", n, bucket))
+            self._prefill_waves += 1
+            t0 = time.time()
             logits, self.cache = self._prefill_many(
                 self.params, self.cache, jnp.asarray(rows),
                 jnp.asarray(lengths), jnp.asarray(slot_ids),
                 n=n, bucket=bucket)
-            self._post_admit(group, slots, np.asarray(logits))
+            logits = np.asarray(logits)
+            self._wave_span("prefill", t0, group, n=len(group),
+                            bucket=bucket)
+            self._post_admit(group, slots, logits)
 
     def _admit_suffix(self, reqs: List[_Request]) -> None:
         """Prefix-hit admissions: splice the matched pool entry into each
@@ -1020,17 +1216,23 @@ class DecodeEngine:
                 plens[i] = plens[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
                 entries[i] = entries[len(group) - 1]
+            self._mark_compile(("suffix", n, bucket))
+            self._prefill_waves += 1
+            t0 = time.time()
             logits, self.cache = self._prefill_suffix_many(
                 self.params, self.cache, self._pool["k"], self._pool["v"],
                 jnp.asarray(entries), jnp.asarray(slot_ids),
                 jnp.asarray(rows), jnp.asarray(plens),
                 jnp.asarray(lengths), n=n, bucket=bucket)
+            logits = np.asarray(logits)
+            self._wave_span("suffix-prefill", t0, group, n=len(group),
+                            bucket=bucket)
             for req in group:
                 # The splice program holding the entry is dispatched (and
                 # device order is program order), so the row may now be
                 # recycled without racing the read.
                 self.prefix.release(req.prefix_entry)
-            self._post_admit(group, slots, np.asarray(logits))
+            self._post_admit(group, slots, logits)
 
     def _post_admit(self, group: List[_Request], slots: List[int],
                     logits: np.ndarray) -> None:
@@ -1116,6 +1318,9 @@ class DecodeEngine:
             self._slot_pages[slot] = []
             self._block_tables[slot, :] = 0
             self._pages.free(pages)
+            if pages and self.steplog.enabled:
+                self.steplog.event("page-free", n=len(pages),
+                                   free=self._pages.free_count)
         self._free.append(slot)
         # Park the freed slot at length 0 so idle slots don't walk their
         # cursor toward the capacity edge while others decode.
@@ -1143,6 +1348,7 @@ class DecodeEngine:
             self.cancelled += 1
         elif status == "deadline_exceeded":
             self.deadline_exceeded += 1
+        self._observe_terminal(req, status)
         with self._reqs_lock:
             self._requests.pop(req.request_id, None)
         req.done.set()
@@ -1199,14 +1405,36 @@ class DecodeEngine:
     def step(self) -> int:
         """Admit pending prefills, run at most one interleaved prefill
         chunk, advance every active slot one token. Returns the number
-        of active slots stepped."""
+        of active slots stepped.
+
+        When the step recorder is on (``decode_step_timeline``), the
+        step's phases (admission prefills, interleaved prefill chunk,
+        decode) land as one ring row with batch occupancy — the "why
+        was this token slow" record. Recording costs a few clock reads
+        and one deque append per STEP; with the ring off this path is
+        byte-identical to the uninstrumented loop."""
         import jax.numpy as jnp
 
+        rec = self.steplog.enabled
+        phases: List[Dict[str, Any]] = []
+        t_step0 = time.time() if rec else 0.0
+        if rec:
+            w0 = self._prefill_waves
+            c0 = self.prefill_chunks
         self._reap()
         self._admit()
+        if rec and self._prefill_waves > w0:
+            phases.append({"phase": "admit", "t0": t_step0,
+                           "t1": time.time(),
+                           "waves": self._prefill_waves - w0})
         if self.paged:
+            t0 = time.time() if rec else 0.0
             self._prefill_tick()
+            if rec and self.prefill_chunks > c0:
+                phases.append({"phase": "prefill_chunk", "t0": t0,
+                               "t1": time.time()})
         if not self._active:
+            self._steplog_row(t_step0, phases)
             return 0
         chunk = self._pick_chunk()
         if self.paged:
@@ -1215,10 +1443,13 @@ class DecodeEngine:
             # youngest request (and so shrink the active set).
             self._ensure_decode_pages(chunk)
             if not self._active:
+                self._steplog_row(t_step0, phases)
                 return 0
             chunk = min(chunk, self._pick_chunk())
         stepped = len(self._active)
         if chunk > 1:
+            self._mark_compile(("decode_k", chunk))
+            t_d0 = time.time() if rec else 0.0
             if self.paged:
                 toks, self.cache = self._decode_k(
                     self.params, self.cache, jnp.asarray(self._tokens),
@@ -1228,6 +1459,10 @@ class DecodeEngine:
                     self.params, self.cache, jnp.asarray(self._tokens),
                     k=chunk)
             toks = np.asarray(toks)  # (chunk, slots)
+            if rec:
+                phases.append({"phase": "decode", "t0": t_d0,
+                               "t1": time.time(), "batch": stepped,
+                               "k": chunk})
             self.steps += chunk
             for slot in list(self._active):
                 req = self._active[slot]
@@ -1240,7 +1475,10 @@ class DecodeEngine:
                             and tok == req.eos_id):
                         self._finish(slot)
                         break
+            self._steplog_row(t_step0, phases)
             return stepped
+        self._mark_compile(("decode",))
+        t_d0 = time.time() if rec else 0.0
         if self.paged:
             logits, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(self._tokens),
@@ -1249,6 +1487,9 @@ class DecodeEngine:
             logits, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(self._tokens))
         logits = np.asarray(logits)
+        if rec:
+            phases.append({"phase": "decode", "t0": t_d0,
+                           "t1": time.time(), "batch": stepped, "k": 1})
         self.steps += 1
         for slot in list(self._active):
             req = self._active[slot]
@@ -1258,7 +1499,23 @@ class DecodeEngine:
             if req.generated >= req.max_new_tokens or (
                     req.eos_id is not None and tok == req.eos_id):
                 self._finish(slot)
+        self._steplog_row(t_step0, phases)
         return stepped
+
+    def _steplog_row(self, t0: float, phases: List[Dict[str, Any]]
+                     ) -> None:
+        """Close the step's timeline row; idle steps with no phases and
+        no pending events record nothing (an idle engine must not churn
+        useful rows out of the bounded ring)."""
+        if not self.steplog.enabled or not (phases
+                                            or self.steplog.pending_events):
+            return
+        self.steplog.record(
+            self.steps, t0, time.time(), phases,
+            active=len(self._active), prefilling=len(self._prefilling),
+            queued=max(0, self._pending.qsize() + len(self._requeue)
+                       - self._queued_cancelled),
+            pages_free=self._pages.free_count if self.paged else None)
 
     def serve_forever(self, idle_wait_s: float = 0.05) -> None:
         """Decode loop for a replica thread: steps while work exists,
@@ -1343,6 +1600,26 @@ class DecodeEngine:
             out["kv_fragmentation"] = self._fragmentation()
         if self.prefix is not None:
             out["prefix"] = self.prefix.stats()
+        if self.steplog.enabled:
+            out["step_timeline_rows"] = len(self.steplog._rows)
+            out["step_timeline_dropped"] = self.steplog.dropped
+        return out
+
+    def set_metrics_deployment(self, name: str) -> None:
+        """Re-label this engine's SLO metrics (benches separate their
+        warmup/compile phase from the measured phase this way; requests
+        observe under the label current at their TERMINAL step)."""
+        self._mtags = {"deployment": name}
+
+    def timeline(self) -> Dict[str, Any]:
+        """Step-timeline dump + engine identity: the payload behind the
+        replica's ``engine_timeline`` RPC and the ``ray_tpu timeline
+        --serve`` merge."""
+        out = self.steplog.dump()
+        out["deployment"] = self._mtags["deployment"]
+        out["replica_id"] = self._replica_id
+        out["paged"] = self.paged
+        out["slots"] = self.slots
         return out
 
     def _fragmentation(self) -> float:
@@ -1453,6 +1730,11 @@ class LlamaDecodeDeployment:
             out["prefix"] = s.get("prefix", {})
             out["prefixes"] = self.engine.prefix.hashes()
         return out
+
+    def timeline(self) -> Dict[str, Any]:
+        """Engine step-timeline dump (ReplicaActor.engine_timeline
+        forwards here; merged into the serve Chrome trace)."""
+        return self.engine.timeline()
 
     def _submit(self, request: Dict[str, Any], on_token=None) -> _Request:
         """Admission with the request's deadline attached: explicit

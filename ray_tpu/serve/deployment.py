@@ -361,9 +361,17 @@ class _Router:
     def submit(self, method: str, args: tuple, kwargs: dict,
                model_id: str = "", timeout_s: Optional[float] = None
                ) -> Future:
+        from ray_tpu.core.config import config as rt_config
+        from ray_tpu.util import tracing
+
         fut: Future = Future()
+        # The caller's span context is captured HERE: contextvars don't
+        # follow work onto pool threads, and the request's whole trace
+        # (router span -> attempt spans -> replica -> engine) must hang
+        # under the span that submitted it (e.g. the proxy's http span).
+        ctx = tracing.current() if rt_config.serve_trace_spans else None
         self._pool.submit(self._run_one, fut, method, args, kwargs,
-                          model_id, timeout_s)
+                          model_id, timeout_s, ctx)
         return fut
 
     @staticmethod
@@ -377,70 +385,97 @@ class _Router:
         return base * (2 ** attempt) * (0.5 + random.random())
 
     def _run_one(self, fut: Future, method, args, kwargs, model_id,
-                 timeout_s: Optional[float] = None) -> None:
+                 timeout_s: Optional[float] = None,
+                 trace_ctx: Optional[tuple] = None) -> None:
+        from contextlib import nullcontext
+
         from ray_tpu.core.config import config as rt_config
+        from ray_tpu.util import tracing
 
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
         budget = max(1, rt_config.handle_retry_budget)
+        spans = rt_config.serve_trace_spans
         try:
-            self.wait_ready()
-            prefix_hashes = _affinity_hashes(args)
-            last_err: Optional[BaseException] = None
-            for attempt in range(budget):
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    raise DeadlineExceededError(
-                        f"deadline expired before attempt {attempt + 1} "
-                        f"to {self.name!r}") from last_err
-                replica = self._pick(model_id, prefix_hashes)
-                if replica is None:
-                    # Advisory read: worst case a request that raced the
-                    # delete gets the "no replicas" message instead of
-                    # "was deleted" — both terminate it identically.
-                    # graftlint: disable=unguarded-field-access
-                    if self._deleted:
+            # One router span per request; each attempt gets a child
+            # span tagged with the attempt ordinal and replica, and the
+            # actor call made INSIDE it ships that span's context — so a
+            # retried request's replica-side work stays parented under
+            # the same request across attempts.
+            with tracing.resume(trace_ctx), \
+                    (tracing.trace(f"router:{self.name}", method=method)
+                     if spans else nullcontext()):
+                self.wait_ready()
+                prefix_hashes = _affinity_hashes(args)
+                last_err: Optional[BaseException] = None
+                for attempt in range(budget):
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise DeadlineExceededError(
+                            f"deadline expired before attempt "
+                            f"{attempt + 1} to {self.name!r}") from last_err
+                    replica = self._pick(model_id, prefix_hashes)
+                    if replica is None:
+                        # Advisory read: worst case a request that raced
+                        # the delete gets the "no replicas" message
+                        # instead of "was deleted" — both terminate it
+                        # identically.
+                        # graftlint: disable=unguarded-field-access
+                        if self._deleted:
+                            raise RuntimeError(
+                                f"deployment {self.name!r} was deleted")
                         raise RuntimeError(
-                            f"deployment {self.name!r} was deleted")
-                    raise RuntimeError(
-                        f"deployment {self.name!r} has no replicas")
-                try:
-                    # The deadline ships as a RELATIVE duration; the
-                    # replica re-anchors it to its own clock. get()'s
-                    # grace past it only covers transit — the replica
-                    # enforces the deadline itself.
-                    ref = replica["handle"].handle_request.remote(
-                        method, args, kwargs, model_id, remaining)
-                    fut.set_result(ray_tpu.get(
-                        ref, timeout=(None if remaining is None
-                                      else remaining + 10.0)))
-                    return
-                except GetTimeoutError as e:
-                    raise DeadlineExceededError(
-                        f"no reply from {self.name!r} within the request "
-                        f"deadline") from e
-                except (ActorDiedError, ActorUnavailableError) as e:
-                    # Replica died: forget it locally; the controller's
-                    # next snapshot heals the set. Retry elsewhere —
-                    # within the per-request budget, with backoff, and
-                    # never past the deadline.
-                    last_err = e
-                    with self._lock:
-                        self._replicas = [r for r in self._replicas
-                                          if r["id"] != replica["id"]]
-                    if attempt + 1 >= budget:
-                        break
-                    pause = self._backoff_s(attempt)
-                    if (deadline is not None
-                            and time.monotonic() + pause >= deadline):
-                        break  # the retry could not finish in time anyway
-                    time.sleep(pause)
-                finally:
-                    self._release(replica)
-            raise last_err
+                            f"deployment {self.name!r} has no replicas")
+                    try:
+                        # The deadline ships as a RELATIVE duration; the
+                        # replica re-anchors it to its own clock. get()'s
+                        # grace past it only covers transit — the replica
+                        # enforces the deadline itself.
+                        with (tracing.trace("attempt", attempt=attempt,
+                                            replica=replica["id"])
+                              if spans else nullcontext()):
+                            ref = replica["handle"].handle_request.remote(
+                                method, args, kwargs, model_id, remaining)
+                            fut.set_result(ray_tpu.get(
+                                ref, timeout=(None if remaining is None
+                                              else remaining + 10.0)))
+                        return
+                    except GetTimeoutError as e:
+                        raise DeadlineExceededError(
+                            f"no reply from {self.name!r} within the "
+                            f"request deadline") from e
+                    except (ActorDiedError, ActorUnavailableError) as e:
+                        # Replica died: forget it locally; the
+                        # controller's next snapshot heals the set.
+                        # Retry elsewhere — within the per-request
+                        # budget, with backoff, and never past the
+                        # deadline.
+                        last_err = e
+                        with self._lock:
+                            self._replicas = [r for r in self._replicas
+                                              if r["id"] != replica["id"]]
+                        if attempt + 1 >= budget:
+                            break
+                        pause = self._backoff_s(attempt)
+                        if (deadline is not None
+                                and time.monotonic() + pause >= deadline):
+                            break  # the retry could not finish in time
+                        self._count_retry()
+                        time.sleep(pause)
+                    finally:
+                        self._release(replica)
+                raise last_err
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
+
+    def _count_retry(self) -> None:
+        from ray_tpu.core.config import config as rt_config
+
+        if rt_config.serve_metrics_enabled:
+            from ray_tpu.serve import metrics as smetrics
+
+            smetrics.RETRIES.inc(1.0, {"deployment": self.name})
 
     def stream(self, method: str, args: tuple, kwargs: dict,
                model_id: str = "", chunk_items: int = 16,
@@ -454,11 +489,15 @@ class _Router:
         first item: once any token has been yielded the stream has
         observable state on the client, so a mid-stream retry would
         replay or corrupt it — the error propagates instead."""
+        from contextlib import nullcontext
+
         from ray_tpu.core.config import config as rt_config
+        from ray_tpu.util import tracing
 
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
         budget = max(1, rt_config.handle_retry_budget)
+        spans = rt_config.serve_trace_spans
         self.wait_ready()
         prefix_hashes = _affinity_hashes(args)
         last_err: Optional[BaseException] = None
@@ -477,9 +516,17 @@ class _Router:
             sid = None
             try:
                 try:
-                    sid = ray_tpu.get(handle.start_stream.remote(
-                        method, args, kwargs, model_id, remaining),
-                        timeout=70.0)
+                    # The attempt span wraps only start_stream: the
+                    # engine captures its trace context at submit (which
+                    # runs inside this actor call), so a pre-first-item
+                    # retry re-parents the replica-side work under the
+                    # new attempt while the stream stays one trace.
+                    with (tracing.trace("stream-attempt", attempt=attempt,
+                                        replica=replica["id"])
+                          if spans else nullcontext()):
+                        sid = ray_tpu.get(handle.start_stream.remote(
+                            method, args, kwargs, model_id, remaining),
+                            timeout=70.0)
                 except (ActorDiedError, ActorUnavailableError) as e:
                     last_err = e
                     with self._lock:
@@ -491,6 +538,7 @@ class _Router:
                     if (deadline is not None
                             and time.monotonic() + pause >= deadline):
                         raise
+                    self._count_retry()
                     time.sleep(pause)
                     continue
                 while True:

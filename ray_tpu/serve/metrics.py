@@ -1,0 +1,127 @@
+"""Serve-plane SLO instruments + the one summary the surfaces share.
+
+The SLOs TPU serving is judged by are latency DISTRIBUTIONS — TTFT and
+time-per-output-token — not point gauges ("Fine-Tuning and Serving
+Gemma on Cloud TPU", PAPERS.md). This module registers them as
+``util.metrics`` Counter/Histogram instruments labeled by deployment,
+recorded by the decode engine / router / proxy per REQUEST (never per
+token or per step — the decode loop must not pay a registry lock per
+step), flushed through the existing per-process metrics flusher to the
+cluster controller, and read back identically by:
+
+* the HTTP proxy's ``/metrics`` route (Prometheus exposition text),
+* ``serve.status()``'s per-deployment ``slo`` summaries,
+* the dashboard's serve panel,
+* ``bench_serve.py`` / ``bench_decode.py`` percentile rows.
+
+One registry, one aggregation path (``slo_summary``), one answer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.util.metrics import (Counter, Histogram, counter_totals,
+                                  histogram_summary, merge_histograms)
+
+# Latency grids sized for decode serving: TTFT spans admission-queue
+# waits (ms) through multi-second prefill backlogs; inter-token spans
+# sub-ms TPU steps through seconds of CPU-host steps.
+_TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+_TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5)
+_HTTP_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0, 30.0, 60.0)
+
+TTFT = Histogram(
+    "serve_ttft_s",
+    "Time to first token: engine submit -> first emitted token "
+    "(includes queue wait and prefill).",
+    boundaries=_TTFT_BUCKETS, tag_keys=("deployment",))
+
+INTER_TOKEN = Histogram(
+    "serve_inter_token_s",
+    "Per-output-token latency of one request's stream: (finish - first "
+    "token) / (tokens - 1), observed once per completed request "
+    "(robust to chunked emission's bursty raw gaps).",
+    boundaries=_TOKEN_BUCKETS, tag_keys=("deployment",))
+
+QUEUE_WAIT = Histogram(
+    "serve_queue_wait_s",
+    "Engine admission-queue wait: submit -> prefill dispatch.",
+    boundaries=_TTFT_BUCKETS, tag_keys=("deployment",))
+
+HTTP_LATENCY = Histogram(
+    "serve_http_request_s",
+    "HTTP proxy request latency (headers in -> response written), "
+    "labeled by resolved deployment.",
+    boundaries=_HTTP_BUCKETS, tag_keys=("deployment",))
+
+REQUESTS = Counter(
+    "serve_requests_total",
+    "Engine request outcomes: completed | cancelled | deadline_exceeded "
+    "| shed | error.",
+    tag_keys=("deployment", "outcome"))
+
+HTTP_REQUESTS = Counter(
+    "serve_http_requests_total",
+    "HTTP proxy responses by status code.",
+    tag_keys=("deployment", "code"))
+
+RETRIES = Counter(
+    "serve_router_retries_total",
+    "Router retries after replica death (attempts beyond the first).",
+    tag_keys=("deployment",))
+
+PREEMPTIONS = Counter(
+    "serve_preemptions_total",
+    "Engine recompute-preemptions under page pressure.",
+    tag_keys=("deployment",))
+
+# Outcomes worth a counter key even at zero; keeps dashboards stable.
+OUTCOMES = ("completed", "cancelled", "deadline_exceeded", "shed", "error")
+
+_HISTOGRAMS = {
+    "ttft_s": "serve_ttft_s",
+    "inter_token_s": "serve_inter_token_s",
+    "queue_wait_s": "serve_queue_wait_s",
+    "http_request_s": "serve_http_request_s",
+}
+
+
+def slo_summary(aggregated: Dict[str, List[Dict[str, Any]]]
+                ) -> Dict[str, Dict[str, Any]]:
+    """Per-deployment SLO view from the controller's aggregated metrics
+    (``list_metrics``): histogram summaries (count/mean/p50/p99) for
+    TTFT, inter-token, queue-wait and HTTP latency, plus outcome /
+    retry / preemption counter totals. The single source of truth
+    behind ``serve.status()``, the dashboard serve panel and the bench
+    percentile rows."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def rec(deployment: str) -> Dict[str, Any]:
+        return out.setdefault(deployment, {})
+
+    for field, name in _HISTOGRAMS.items():
+        for key, entry in merge_histograms(aggregated, name).items():
+            dep = dict(key).get("deployment", "-")
+            rec(dep)[field] = histogram_summary(entry)
+    for key, total in counter_totals(aggregated,
+                                     "serve_requests_total").items():
+        tags = dict(key)
+        dep = tags.get("deployment", "-")
+        rec(dep).setdefault("outcomes", {})[
+            tags.get("outcome", "?")] = int(total)
+    for name, field in (("serve_router_retries_total", "retries"),
+                        ("serve_preemptions_total", "preempted"),
+                        ("serve_http_requests_total", "http_responses")):
+        for key, total in counter_totals(aggregated, name).items():
+            tags = dict(key)
+            dep = tags.get("deployment", "-")
+            if name == "serve_http_requests_total":
+                rec(dep).setdefault(field, {})[
+                    tags.get("code", "?")] = int(total)
+            else:
+                rec(dep)[field] = rec(dep).get(field, 0) + int(total)
+    return out

@@ -128,9 +128,48 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
     class _ProxyHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"  # chunked transfer needs 1.1
 
+        def send_response(self, code, message=None):  # noqa: A003
+            self._status = code  # observed by the request metrics below
+            super().send_response(code, message)
+
         def do_POST(self):  # noqa: N802 (stdlib API)
+            from contextlib import nullcontext
+
+            from ray_tpu.core.config import config as rt_config
+            from ray_tpu.util import tracing
+
+            t0 = time.perf_counter()
+            self._status = 0
+            self._dep_name = ""
+            # Inbound propagation: a client that opened its own span
+            # ships it as X-Trace-Id/X-Parent-Span headers and this
+            # request's whole tree parents under it — the client
+            # process becomes the root of the cross-process trace.
+            hdr_t = self.headers.get("X-Trace-Id", "")
+            hdr_p = self.headers.get("X-Parent-Span", "")
+            inbound = (hdr_t, hdr_p) if hdr_t and hdr_p else None
             with in_flight:
-                self._handle()
+                # The request's ROOT span (or the child of the client's
+                # span): everything below it — router span, attempt
+                # spans, replica execution, engine queue-wait/prefill/
+                # decode — parents back here, so one HTTP request
+                # renders as one causally-linked tree across processes
+                # in `ray_tpu timeline --serve`.
+                if rt_config.serve_trace_spans:
+                    with tracing.resume(inbound), \
+                            tracing.trace(f"http:{self.path}",
+                                          method="POST"):
+                        self._handle()
+                else:
+                    self._handle()
+            if rt_config.serve_metrics_enabled:
+                from ray_tpu.serve import metrics as smetrics
+
+                tags = {"deployment": self._dep_name or "-"}
+                smetrics.HTTP_LATENCY.observe(
+                    time.perf_counter() - t0, tags)
+                smetrics.HTTP_REQUESTS.inc(
+                    1.0, {**tags, "code": str(self._status or 0)})
 
         def do_GET(self):  # noqa: N802
             # Health endpoint (reference: proxy.py /-/healthz).
@@ -140,8 +179,32 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif self.path.rstrip("/") in ("/metrics", "/-/metrics"):
+                self._serve_metrics()
             else:
                 self.send_error(404)
+
+        def _serve_metrics(self) -> None:
+            """Prometheus exposition text from the cluster controller's
+            aggregated registry (reference: the node agent's exporter).
+            Serving it from the INGRESS port means a Prometheus scraping
+            the proxies sees every deployment's TTFT / inter-token /
+            queue-wait histograms without reaching the control plane."""
+            from ray_tpu.core.runtime import get_core_worker
+
+            try:
+                text = get_core_worker().controller.call(
+                    "metrics_text", timeout=10.0)
+            except Exception as e:  # noqa: BLE001 — head unreachable
+                self._send_plain(503, f"metrics unavailable: {e}")
+                return
+            data = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
 
         def _request_timeout_s(self) -> Optional[float]:
             """The request's end-to-end budget: client header
@@ -200,6 +263,7 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
             # Route table first (supports custom route_prefix); fall back
             # to the first path segment as the app name.
             name = routes.resolve(self.path) or parts[0]
+            self._dep_name = name  # request-metric deployment label
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b"null"
             model_id = self.headers.get("serve_multiplexed_model_id", "")

@@ -19,6 +19,19 @@ logger = logging.getLogger(__name__)
 _current_model_id = threading.local()
 _current_deadline = threading.local()
 
+# Which deployment/replica THIS worker process hosts — set by
+# ReplicaActor.__init__ before the user class is constructed, so a
+# DecodeEngine built inside it labels its SLO metrics by deployment
+# without the engine ever knowing the serve plane exists. A process
+# hosts at most one replica (replicas are dedicated actors).
+_replica_ident: Dict[str, str] = {"deployment": "", "replica_id": ""}
+
+
+def replica_ident() -> Dict[str, str]:
+    """{'deployment', 'replica_id'} of the replica hosted by this
+    process (empty strings outside a replica worker)."""
+    return dict(_replica_ident)
+
 
 def get_multiplexed_model_id() -> str:
     """Inside a replica: the model id of the in-flight request (reference:
@@ -101,9 +114,15 @@ def loaded_model_ids(instance) -> List[str]:
 
 
 class ReplicaActor:
-    def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict):
+    def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict,
+                 replica_id: str = ""):
         from ray_tpu.core import serialization
 
+        if replica_id:
+            # Before the user class runs: its __init__ may build the
+            # engine that reads this identity for metric labels.
+            _replica_ident["replica_id"] = replica_id
+            _replica_ident["deployment"] = replica_id.rsplit("#", 1)[0]
         cls = serialization.loads_function(cls_blob)
         self._instance = cls(*args, **kwargs)
         self._sub_slice: Optional[Dict[str, Any]] = None
@@ -227,6 +246,21 @@ class ReplicaActor:
         fwd = getattr(self._instance, "set_topology", None)
         if callable(fwd):
             fwd(assignment)
+
+    def engine_timeline(self) -> Dict[str, Any]:
+        """The hosted instance's step-timeline dump (empty for non-engine
+        deployments): phase rows + page/compile events, merged by
+        ``ray_tpu timeline --serve`` into the cross-process trace."""
+        fn = getattr(self._instance, "timeline", None)
+        if callable(fn):
+            try:
+                return dict(fn())
+            except Exception:
+                from ray_tpu.util.ratelimit import log_every
+
+                log_every("replica.timeline", 30.0, logger,
+                          "instance timeline dump failed", exc_info=True)
+        return {"rows": []}
 
     def stats(self) -> Dict[str, Any]:
         models = loaded_model_ids(self._instance)

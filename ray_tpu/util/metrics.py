@@ -39,6 +39,13 @@ class _Registry:
 
     def record(self, name: str, kind: str, tags: Dict[str, str],
                value: float, buckets=None) -> None:
+        self.record_many(name, kind, tags, (value,), buckets)
+
+    def record_many(self, name: str, kind: str, tags: Dict[str, str],
+                    values, buckets=None) -> None:
+        """Bulk record under ONE lock acquisition — hot producers (the
+        decode engine flushing a step's worth of observations) pay a
+        single registry round-trip instead of one per value."""
         key = (name, tuple(sorted(tags.items())))
         with self._lock:
             entry = self._metrics.get(key)
@@ -51,15 +58,16 @@ class _Registry:
                     entry["sum"] = 0.0
                     entry["count"] = 0
                 self._metrics[key] = entry
-            if kind == "counter":
-                entry["value"] += value
-            elif kind == "gauge":
-                entry["value"] = value
-            else:
-                idx = bisect.bisect_left(entry["buckets"], value)
-                entry["counts"][idx] += 1
-                entry["sum"] += value
-                entry["count"] += 1
+            for value in values:
+                if kind == "counter":
+                    entry["value"] += value
+                elif kind == "gauge":
+                    entry["value"] = value
+                else:
+                    idx = bisect.bisect_left(entry["buckets"], value)
+                    entry["counts"][idx] += 1
+                    entry["sum"] += value
+                    entry["count"] += 1
             self._ensure_flusher()
 
     def _ensure_flusher(self) -> None:
@@ -70,23 +78,52 @@ class _Registry:
 
     def snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
-            return [dict(e) for e in self._metrics.values()]
+            out = []
+            for e in self._metrics.values():
+                d = dict(e)
+                if "counts" in d:
+                    # Deep-copy the mutable histogram state: the shallow
+                    # dict still aliases the live counts list, and the
+                    # flusher serializes this snapshot OUTSIDE the lock.
+                    d["counts"] = list(d["counts"])
+                    d["buckets"] = list(d["buckets"])
+                out.append(d)
+            return out
+
+    def flush_now(self) -> bool:
+        """Push one snapshot to the cluster controller synchronously
+        (tests and benches that cannot wait out the flush interval).
+        Returns False when no runtime is connected or the push failed."""
+        from ray_tpu.core import runtime
+
+        core = runtime._core_worker
+        if core is None:
+            return False
+        try:
+            core.controller.notify("push_metrics", self._source(core),
+                                   self.snapshot())
+            return True
+        except Exception:
+            return False
+
+    @staticmethod
+    def _source(core) -> Dict[str, Any]:
+        return {"node_id": core.node_id.binary(),
+                "worker_id": core.worker_id.binary(),
+                "pid": __import__("os").getpid()}
 
     def _flush_loop(self) -> None:
         from ray_tpu.core import runtime
+        from ray_tpu.core.config import config as rt_config
 
         while True:
-            time.sleep(5.0)
+            time.sleep(max(0.1, rt_config.metrics_flush_interval_s))
             core = runtime._core_worker
             if core is None:
                 continue
             try:
-                core.controller.notify(
-                    "push_metrics",
-                    {"node_id": core.node_id.binary(),
-                     "worker_id": core.worker_id.binary(),
-                     "pid": __import__("os").getpid()},
-                    self.snapshot())
+                core.controller.notify("push_metrics", self._source(core),
+                                       self.snapshot())
             except Exception:
                 from ray_tpu.util.ratelimit import log_every
 
@@ -150,10 +187,20 @@ class Histogram(_Metric):
         _Registry.get().record(self._name, "histogram", self._tags(tags),
                                value, self._boundaries)
 
+    def observe_many(self, values: Sequence[float],
+                     tags: Optional[Dict[str, str]] = None) -> None:
+        """Record a batch of observations under one registry lock."""
+        if values:
+            _Registry.get().record_many(self._name, "histogram",
+                                        self._tags(tags), values,
+                                        self._boundaries)
+
 
 def prometheus_text(aggregated: Dict[str, Any]) -> str:
     """Render the controller's aggregated metrics as Prometheus exposition
-    text (the shape the reference's node agent exposes)."""
+    text (the shape the reference's node agent exposes). Histograms emit
+    the full cumulative ``_bucket{le=...}`` ladder (+Inf last) so a real
+    Prometheus can compute quantiles with histogram_quantile()."""
     lines: List[str] = []
     for source, metrics in aggregated.items():
         for m in metrics:
@@ -161,8 +208,92 @@ def prometheus_text(aggregated: Dict[str, Any]) -> str:
             tags["source"] = source
             label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
             if m["kind"] == "histogram":
+                cum = 0
+                for edge, n in zip(m["buckets"], m["counts"]):
+                    cum += n
+                    blabel = (label + "," if label else "") + f'le="{edge}"'
+                    lines.append(f'{m["name"]}_bucket{{{blabel}}} {cum}')
+                blabel = (label + "," if label else "") + 'le="+Inf"'
+                lines.append(f'{m["name"]}_bucket{{{blabel}}} {m["count"]}')
                 lines.append(f'{m["name"]}_sum{{{label}}} {m["sum"]}')
                 lines.append(f'{m["name"]}_count{{{label}}} {m["count"]}')
             else:
                 lines.append(f'{m["name"]}{{{label}}} {m["value"]}')
     return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------ aggregation helpers
+#
+# Shared by serve.status()'s SLO summaries, the dashboard's serve panel
+# and the benches: ONE way to merge per-process histogram snapshots and
+# read quantiles out of them, so every surface reports the same number.
+
+
+def merge_histograms(aggregated: Dict[str, List[Dict[str, Any]]],
+                     name: str) -> Dict[tuple, Dict[str, Any]]:
+    """Merge same-name histogram entries across sources, keyed by their
+    tag items. Entries whose bucket boundaries disagree are skipped (the
+    metrics-name-collision lint makes that a build failure)."""
+    out: Dict[tuple, Dict[str, Any]] = {}
+    for metrics in aggregated.values():
+        for m in metrics:
+            if m.get("name") != name or m.get("kind") != "histogram":
+                continue
+            key = tuple(sorted(m.get("tags", {}).items()))
+            cur = out.get(key)
+            if cur is None:
+                out[key] = {"name": name, "kind": "histogram",
+                            "tags": dict(m.get("tags", {})),
+                            "buckets": list(m["buckets"]),
+                            "counts": list(m["counts"]),
+                            "sum": m["sum"], "count": m["count"]}
+            elif cur["buckets"] == list(m["buckets"]):
+                cur["counts"] = [a + b for a, b in zip(cur["counts"],
+                                                       m["counts"])]
+                cur["sum"] += m["sum"]
+                cur["count"] += m["count"]
+    return out
+
+
+def histogram_quantile(entry: Dict[str, Any], q: float) -> Optional[float]:
+    """Bucket-interpolated quantile of one (merged) histogram entry —
+    Prometheus histogram_quantile() semantics: linear within the bucket,
+    the top (+Inf) bucket clamps to its lower edge. None when empty.
+    Quantiles are bucket-QUANTIZED: precision is the bucket grid, which
+    is the documented trade for surviving process death and transport."""
+    total = entry.get("count", 0)
+    if not total:
+        return None
+    rank = q * total
+    cum = 0
+    prev_edge = 0.0
+    for edge, n in zip(entry["buckets"], entry["counts"]):
+        if cum + n >= rank and n > 0:
+            frac = (rank - cum) / n
+            return prev_edge + (edge - prev_edge) * max(0.0, min(1.0, frac))
+        cum += n
+        prev_edge = edge
+    return prev_edge  # landed in the +Inf bucket: clamp to the last edge
+
+
+def histogram_summary(entry: Dict[str, Any]) -> Dict[str, Any]:
+    """{count, mean, p50, p99} of one merged histogram entry."""
+    count = entry.get("count", 0)
+    return {
+        "count": count,
+        "mean": (entry["sum"] / count) if count else None,
+        "p50": histogram_quantile(entry, 0.5),
+        "p99": histogram_quantile(entry, 0.99),
+    }
+
+
+def counter_totals(aggregated: Dict[str, List[Dict[str, Any]]],
+                   name: str) -> Dict[tuple, float]:
+    """Sum same-name counter entries across sources, keyed by tag items."""
+    out: Dict[tuple, float] = {}
+    for metrics in aggregated.values():
+        for m in metrics:
+            if m.get("name") == name and m.get("kind") == "counter":
+                key = tuple(sorted(m.get("tags", {}).items()))
+                out[key] = out.get(key, 0.0) + m.get("value", 0.0)
+    return out

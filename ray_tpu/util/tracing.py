@@ -52,17 +52,76 @@ def context_for_spec() -> Optional[Dict[str, str]]:
 
 
 @contextmanager
-def activate(spec_ctx: Optional[Dict[str, str]]):
+def activate(spec_ctx: Optional[Dict[str, str]], name: Optional[str] = None):
     """Worker-side: enter the caller's trace (new child span) for the
-    duration of a task's execution."""
+    duration of a task's execution. With ``name``, the execution itself
+    is recorded as a SPAN parented under the caller's span — the link
+    that makes a cross-process trace causally connected (caller-side
+    attempt span -> this execution span -> spans the task opens)."""
     if not spec_ctx:
         yield
         return
-    token = _ctx.set((spec_ctx["trace_id"], _new_id()))
+    span_id = _new_id()
+    token = _ctx.set((spec_ctx["trace_id"], span_id))
+    start = time.time()
     try:
         yield
     finally:
         _ctx.reset(token)
+        if name is not None:
+            _record({
+                "task_id": span_id,
+                "desc": name,
+                "state": "SPAN",
+                "trace_id": spec_ctx["trace_id"],
+                "span_id": span_id,
+                "parent_span": spec_ctx.get("parent_span"),
+                "lease_ts": start,
+                "end_ts": time.time(),
+                "attrs": None,
+            })
+
+
+@contextmanager
+def resume(ctx: Optional[tuple]):
+    """Re-enter a previously captured :func:`current` tuple on another
+    thread (e.g. a router pool thread running work submitted under a
+    live span). Unlike :func:`activate` this CONTINUES the captured span
+    rather than opening a child."""
+    if ctx is None:
+        yield
+        return
+    token = _ctx.set(ctx)
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+
+
+def record_span(name: str, start_ts: float, end_ts: float,
+                ctx: Optional[tuple] = None, **attrs: Any) -> Optional[str]:
+    """Record a completed span with EXPLICIT wall-clock timestamps,
+    parented under ``ctx`` (a captured :func:`current` tuple; defaults
+    to the active context). The decode engine uses this to attribute
+    work it performed on its own loop thread — queue wait, prefill
+    chunks, decode — back to the request's trace after the fact.
+    Returns the new span id (None when there is no trace to attach to)."""
+    parent = ctx if ctx is not None else _ctx.get()
+    if parent is None:
+        return None
+    span_id = _new_id()
+    _record({
+        "task_id": span_id,
+        "desc": name,
+        "state": "SPAN",
+        "trace_id": parent[0],
+        "span_id": span_id,
+        "parent_span": parent[1],
+        "lease_ts": start_ts,
+        "end_ts": end_ts,
+        "attrs": attrs or None,
+    })
+    return span_id
 
 
 @contextmanager
