@@ -3,7 +3,7 @@
 # per-family gates and the stub-drift gate in tests/test_analysis_v3.py).
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
-	bench-paged bench-sharded bench-trace trace-demo
+	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -57,6 +57,12 @@ bench-sharded:
 # stripped engine; acceptance bar <2%) -> BENCH_SERVE.json.
 bench-trace:
 	python bench_decode.py --sections trace_overhead $(BENCH_ARGS)
+
+# Podracer substrate scaling rows (env-steps/s + learner updates/s at
+# 1/2/4 rollout actors, parameter-staleness p50/p99) -> BENCH_RL.json
+# distributed section; other sections' rows are preserved.
+bench-rl-dist:
+	python bench_rl.py --sections distributed
 
 # Tiny serve session through the real HTTP proxy -> Chrome trace JSON,
 # validated (loads as JSON, >=1 cross-process parent/child span,

@@ -88,22 +88,63 @@ def bench_to_reward(name, algo, target, max_iters, note=""):
     return row
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=15)
-    args = ap.parse_args()
+def bench_distributed(iters: int) -> list:
+    """Podracer substrate scaling rows (ISSUE 10): env-steps/s and
+    learner updates/s over 1 -> 4 rollout actors, plus the parameter-
+    staleness distribution each fleet size produces (read from the
+    plane's metrics histograms, not ad-hoc lists)."""
+    from ray_tpu.rl import DQNConfig
 
+    rows = []
+    for actors in (1, 2, 4):
+        algo = DQNConfig(env="CartPole-v1", seed=0).training(
+            rollout_length=32, learning_starts=256, batch_size=128,
+            train_batches_per_iter=8).distributed_rollouts(
+            actors, num_envs_per_actor=4).build()
+        try:
+            for _ in range(2):  # compile + fleet spin-up
+                algo.train()
+            t0 = time.monotonic()
+            steps = 0
+            updates0 = algo._learner_steps
+            m = {}
+            for _ in range(iters):
+                m = algo.train()
+                steps += m["env_steps_this_iter"]
+            wall = time.monotonic() - t0
+            stale = (m.get("rl") or {}).get("staleness") or {}
+            row = {
+                "algo": "DistributedDQN/CartPole-v1",
+                "section": "distributed",
+                "rollout_actors": actors,
+                "env_steps_per_sec": round(steps / wall, 1),
+                "learner_updates_per_sec": round(
+                    (algo._learner_steps - updates0) / wall, 1),
+                "staleness_p50": stale.get("p50"),
+                "staleness_p99": stale.get("p99"),
+                "iters": iters, "wall_s": round(wall, 1),
+                "note": "object-plane shards + pubsub weight fan-out; "
+                        "1-box CPU host (actors time-slice one core — "
+                        "the scaling story needs a multi-core rig)",
+            }
+        finally:
+            algo.stop()
+        print(json.dumps(row))
+        rows.append(row)
+    return rows
+
+
+def classic_rows(iters: int) -> list:
     from ray_tpu.rl import (APPOConfig, DQNConfig, MultiAgentPPOConfig,
                             PPOConfig, SACConfig)
 
-    ray_tpu.init(num_cpus=6)
     rows = [
         bench("PPO/CartPole-v1", PPOConfig(
             env="CartPole-v1", num_env_runners=2, seed=0).build(),
-            args.iters),
+            iters),
         bench("APPO/CartPole-v1", APPOConfig(
             env="CartPole-v1", num_env_runners=2, seed=0).build(),
-            args.iters,
+            iters,
             note="async clipped surrogate over the IMPALA pipeline; "
                  "samplers never wait for the learner"),
         # Replay ratio rebalanced for a THROUGHPUT row (VERDICT r3 Weak
@@ -115,17 +156,17 @@ def main() -> None:
         bench("DQN/CartPole-v1", DQNConfig(
             env="CartPole-v1", num_env_runners=2, seed=0).training(
             train_batches_per_iter=4).build(),
-            args.iters,
+            iters,
             note="replay ratio ~2 train samples/env step (throughput "
                  "config; learning default is 32 updates/iter)"),
         bench("SAC/Pendulum-v1", SACConfig(
             env="Pendulum-v1", num_env_runners=2, seed=0).build(),
-            args.iters,
+            iters,
             note="64 jitted updates/iter (learning config kept: SAC is "
                  "update-dominated by design)"),
         bench("MultiAgentPPO/GuideFollow", MultiAgentPPOConfig(
             num_env_runners=2, episodes_per_sample=16, seed=0).build(),
-            args.iters),
+            iters),
         # Learning-configuration rows: same algorithms at their LEARNING
         # defaults, run to a reward target (what the throughput rows
         # above deliberately trade away).
@@ -142,14 +183,45 @@ def main() -> None:
             note="auto-alpha squashed-Gaussian; Pendulum random ~ -1200,"
                  " solved ~ -150"),
     ]
-    ray_tpu.shutdown()
-    out = {
-        "metric": "rl_env_steps_per_sec",
-        "host": f"{os.cpu_count()}-core",
-        "rows": rows,
-    }
+    for row in rows:
+        row["section"] = "classic"
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=15)
+    ap.add_argument(
+        "--sections", default="classic,distributed",
+        help="comma-set of row groups to (re)measure: classic, "
+             "distributed. Only the selected groups' rows are replaced "
+             "in BENCH_RL.json; the rest are preserved (PR 6 idiom).")
+    args = ap.parse_args()
+    sections = {s.strip() for s in args.sections.split(",") if s.strip()}
+
+    ray_tpu.init(num_cpus=6)
+    rows = []
+    try:
+        if "classic" in sections:
+            rows += classic_rows(args.iters)
+        if "distributed" in sections:
+            rows += bench_distributed(args.iters)
+    finally:
+        ray_tpu.shutdown()
+
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_RL.json")
+    out = {"metric": "rl_env_steps_per_sec",
+           "host": f"{os.cpu_count()}-core", "rows": []}
+    if os.path.exists(path):
+        with open(path) as f:
+            out = json.load(f)
+        # Replace exactly the sections this run re-measured; rows
+        # predating the section tag are classic rows.
+        out["rows"] = [r for r in out.get("rows", [])
+                       if r.get("section", "classic") not in sections]
+    out["host"] = f"{os.cpu_count()}-core"
+    out["rows"] = out.get("rows", []) + rows
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}")

@@ -195,6 +195,11 @@ class Controller:
                 "psub_poll": self.pubsub.poll,
                 "psub_poll_many": self.pubsub.poll_many,
                 "psub_publish": self.pubsub.publish,
+                # Publishers that own a key drop it at teardown so the
+                # hub never pins their payload (the RL weight fan-out
+                # publishes object-plane refs: a leaked key is a leaked
+                # ObjectRef handle in the controller process).
+                "psub_drop": self.pubsub.drop,
                 "psub_snapshot": self.pubsub.snapshot,
                 "psub_keys": self.pubsub.keys,
                 "ping": lambda: "pong",

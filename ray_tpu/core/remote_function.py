@@ -44,7 +44,13 @@ def export_callable(fn) -> tuple:
     function object, and the KV write is synchronous before any task ships,
     so task specs carry only the key — workers fetch from KV on first use and
     cache by key. Returns (key, blob)."""
-    cached = getattr(fn, "__ray_tpu_export__", None)
+    # Read the cache from fn's OWN __dict__, never via getattr: classes
+    # inherit attributes through the MRO, so after exporting a base
+    # class, getattr on a SUBCLASS would return the base's cached
+    # (key, blob) and every remote spawn of the subclass would silently
+    # instantiate the base class on the worker.
+    own = getattr(fn, "__dict__", None)
+    cached = own.get("__ray_tpu_export__") if own is not None else None
     if cached is None:
         blob = serialization.dumps_function(fn)
         key = "fn:" + hashlib.sha256(blob).hexdigest()[:32]

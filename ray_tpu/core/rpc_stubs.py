@@ -160,6 +160,9 @@ class ControllerStub(_StubBase):
     def ping(self, *args, timeout=_UNSET, **kwargs):
         return self._call('ping', *args, timeout=timeout, **kwargs)
 
+    def psub_drop(self, channel, key, *, timeout=_UNSET):
+        return self._call('psub_drop', channel, key, timeout=timeout)
+
     def psub_keys(self, channel, *, timeout=_UNSET):
         return self._call('psub_keys', channel, timeout=timeout)
 

@@ -13,6 +13,14 @@ from ray_tpu.rl.offline import (  # noqa: F401
     rollouts_to_dataset,
     save_transitions,
 )
+from ray_tpu.rl.distributed import (  # noqa: F401
+    DistributedDQN,
+    DistributedIMPALA,
+    PolicyInference,
+    RolloutActor,
+    ShardQueue,
+    TrajectoryShard,
+)
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
 from ray_tpu.rl.replay import ReplayBuffer, SumTree  # noqa: F401

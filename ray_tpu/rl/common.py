@@ -31,6 +31,23 @@ class ConfigBuilderMixin:
             setattr(self, k, v)
         return self
 
+    def distributed_rollouts(self, num_rollout_actors: int,
+                             num_envs_per_actor: int = 4,
+                             mode: str = "local",
+                             shard_queue_size: int = 8):
+        """Opt into the Podracer actor/learner substrate
+        (``rl/distributed/``): ``num_rollout_actors`` RolloutActors ship
+        trajectory shards through the object plane to one in-process
+        pjit learner; weights fan out over pubsub. ``mode="inference"``
+        uses the sebulba split (actors query a shared batched
+        policy-inference service instead of holding local weights)."""
+        self.distributed = True
+        self.num_rollout_actors = num_rollout_actors
+        self.num_envs_per_runner = num_envs_per_actor
+        self.rollout_mode = mode
+        self.shard_queue_size = shard_queue_size
+        return self
+
 
 def probe_env_spec(env: str, env_config: Dict[str, Any],
                    frame_stack: int = 1,

@@ -1,0 +1,32 @@
+"""Podracer-style distributed RL substrate (PAPERS.md: "Podracer
+architectures for scalable Reinforcement Learning", RLAX).
+
+Actor/learner split over the runtime's existing planes: trajectory
+shards transit the OBJECT plane (descriptors only on the RPC plane),
+weights fan out versioned over core PUBSUB, policy inference optionally
+runs as a batched SERVE-style service (sebulba split), and the learner
+is one in-process pjit host over the virtual device mesh. See
+docs/RL.md for the architecture mapping and migration notes.
+"""
+
+from ray_tpu.rl.distributed.dqn import DistributedDQN  # noqa: F401
+from ray_tpu.rl.distributed.fanout import (  # noqa: F401
+    WEIGHTS_CHANNEL,
+    WeightFanout,
+    WeightReceiver,
+)
+from ray_tpu.rl.distributed.inference import PolicyInference  # noqa: F401
+from ray_tpu.rl.distributed.learner import (  # noqa: F401
+    LearnerState,
+    RolloutPlane,
+    new_plane_key,
+    plane_stats,
+)
+from ray_tpu.rl.distributed.onpolicy import DistributedIMPALA  # noqa: F401
+from ray_tpu.rl.distributed.rollout import RolloutActor  # noqa: F401
+from ray_tpu.rl.distributed.shard import (  # noqa: F401
+    DESCRIPTOR_BYTE_BUDGET,
+    ShardQueue,
+    ShardQueueClosed,
+    TrajectoryShard,
+)

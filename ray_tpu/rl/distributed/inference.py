@@ -1,0 +1,141 @@
+"""Batched policy inference: the sebulba actor/inference split.
+
+In Podracer's sebulba configuration the environments and the policy
+forward live in DIFFERENT processes: env-stepping actors send
+observation batches to an inference service that coalesces requests
+from the whole fleet into one forward pass on the accelerator. Here
+the service is one actor (created with ``max_concurrency > 1`` so
+requests from many rollout actors are in flight together) using the
+serve plane's batching idiom (``serve/batching.py`` ``@batch`` — the
+same accumulate-until-size-or-deadline queue the decode replicas use
+for admission), with row-count padding to a few static shapes so the
+jitted forward never recompiles per coalesced batch.
+
+Weights arrive over the SAME versioned pubsub fan-out the rollout
+actors use in local mode; replies carry the serving ``weights_version``
+so shard staleness accounting works identically in both modes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.util.metrics import Counter, Histogram
+
+RL_INFER_REQS = Counter(
+    "rl_inference_requests",
+    "policy inference requests received (one per rollout-actor step)",
+    ("plane",))
+RL_INFER_BATCH = Histogram(
+    "rl_inference_batch_size",
+    "requests coalesced per policy forward",
+    boundaries=(1, 2, 4, 8, 16, 32),
+    tag_keys=("plane",))
+
+# Coalesced row counts pad up to one of these, so the jitted forward
+# sees a handful of static shapes (serve's pad_to_buckets idiom).
+_ROW_BUCKETS = (8, 16, 32, 64, 128, 256)
+
+
+class PolicyInference:
+    """One shared policy-forward service per rollout fleet."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 plane_key: str, policy_mode: str = "categorical",
+                 hidden: Tuple[int, ...] = (64, 64)):
+        import jax
+
+        from ray_tpu.rl.distributed.fanout import WeightReceiver
+        from ray_tpu.rl.models import (build_policy,
+                                       make_egreedy_sample_fn,
+                                       make_sample_fn)
+
+        self._jax = jax
+        self._plane_key = plane_key
+        self._policy_mode = policy_mode
+        self._epsilon = 1.0
+        _init, forward = build_policy(tuple(obs_shape), int(num_actions),
+                                      tuple(hidden))
+        if policy_mode == "epsilon_greedy":
+            self._sample_fn = jax.jit(make_egreedy_sample_fn(forward))
+        else:
+            self._sample_fn = jax.jit(make_sample_fn(forward))
+        self._params = None
+        self._receiver = WeightReceiver(plane_key)
+        # Guards the serving stats ONLY (batches flush from whichever
+        # caller or timer thread filled them — max_concurrency > 1 on
+        # this actor). The weight sync deliberately runs outside any
+        # lock: it is an RPC + object-plane pull (lock-held-blocking),
+        # and concurrent pulls converge on the same newest version.
+        self._lock = threading.Lock()
+        self._forward_calls = 0
+        self._requests = 0
+        self._max_batch = 0
+
+    def _sync_weights(self) -> None:
+        got = (self._receiver.wait_initial() if self._params is None
+               else self._receiver.poll(0.0))
+        if got is not None:
+            _version, params, extras = got
+            self._params = self._jax.device_put(params)
+            if "epsilon" in extras:
+                self._epsilon = float(extras["epsilon"])
+
+    @property
+    def weights_version(self) -> int:
+        return self._receiver.weights_version
+
+    @batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    def infer(self, requests: List[Tuple[np.ndarray, int]]):
+        """Batched entry point: each request is (obs_batch, seed); the
+        decorator hands this method the coalesced list. One forward
+        serves them all; replies are split back per request."""
+        self._sync_weights()
+        jax = self._jax
+        sizes = [len(r[0]) for r in requests]
+        obs = np.concatenate([np.asarray(r[0]) for r in requests], axis=0)
+        rows = len(obs)
+        target = next((b for b in _ROW_BUCKETS if b >= rows), rows)
+        if target > rows:
+            obs = np.concatenate(
+                [obs, np.repeat(obs[-1:], target - rows, axis=0)], axis=0)
+        # The service owns the rng stream: folding each request's seed
+        # in keeps actors decorrelated without shipping jax keys.
+        key = jax.random.key(np.uint32(self._forward_calls))
+        for _obs, seed in requests:
+            key = jax.random.fold_in(key, np.uint32(seed))
+        if self._policy_mode == "epsilon_greedy":
+            action, logp, value = self._sample_fn(
+                self._params, obs, key, self._epsilon)
+        else:
+            action, logp, value = self._sample_fn(self._params, obs, key)
+        action = np.asarray(action)[:rows]
+        logp = np.asarray(logp)[:rows]
+        value = np.asarray(value)[:rows]
+        with self._lock:
+            self._forward_calls += 1
+            self._requests += len(requests)
+            self._max_batch = max(self._max_batch, len(requests))
+        RL_INFER_REQS.inc(len(requests), {"plane": self._plane_key})
+        RL_INFER_BATCH.observe(len(requests), {"plane": self._plane_key})
+        out = []
+        version = self._receiver.weights_version
+        start = 0
+        for n in sizes:
+            out.append((action[start:start + n], logp[start:start + n],
+                        value[start:start + n], version))
+            start += n
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "forward_calls": self._forward_calls,
+                "requests": self._requests,
+                "max_batch": self._max_batch,
+                "weights_version": self._receiver.weights_version,
+            }

@@ -54,8 +54,21 @@ class DQNConfig(ConfigBuilderMixin):
     epsilon_decay_steps: int = 10_000    # env steps to anneal over
     hidden: tuple = (64, 64)
     seed: int = 0
+    # Podracer actor/learner substrate (rl/distributed/): same config
+    # surface, different engine — see ConfigBuilderMixin.
+    # distributed_rollouts and docs/RL.md.
+    distributed: bool = False
+    num_rollout_actors: int = 4
+    rollout_mode: str = "local"     # "inference" = sebulba split
+    shard_queue_size: int = 8
+    learner_mesh: bool = True       # pjit updates over the data mesh
+    min_shards_per_iter: int = 0    # 0 = one per rollout actor
 
-    def build(self) -> "DQN":
+    def build(self):
+        if self.distributed:
+            from ray_tpu.rl.distributed.dqn import DistributedDQN
+
+            return DistributedDQN(self)
         return DQN(self)
 
     def env_runners(self, num_env_runners: int,
@@ -78,6 +91,46 @@ def rollout_to_transitions(ro: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     from ray_tpu.rl.common import rollout_to_transitions as shared
 
     return shared(ro, done_key="dones", action_dtype=np.int32)
+
+
+def make_dqn_update(forward, optimizer, gamma: float, double_q: bool):
+    """The jittable (Double-)DQN TD update, shared by the single-process
+    learner below and the distributed learner
+    (``rl/distributed/dqn.py``) so the two cannot drift."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, target_params, batch):
+        q_all, _ = forward(params, batch["obs"])
+        q = jnp.take_along_axis(
+            q_all, batch["actions"][:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+        q_next_target, _ = forward(target_params, batch["next_obs"])
+        if double_q:
+            # Double DQN: online net picks the argmax, target net rates.
+            q_next_online, _ = forward(params, batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+            next_q = jnp.take_along_axis(
+                q_next_target, best[:, None], axis=-1)[:, 0]
+        else:
+            next_q = jnp.max(q_next_target, axis=-1)
+        target = batch["rewards"] + gamma * (
+            1.0 - batch["dones"]) * jax.lax.stop_gradient(next_q)
+        td = q - target
+        # Huber loss, importance-weighted for prioritized replay.
+        loss = jnp.mean(batch["weights"] * optax.huber_loss(q, target))
+        return loss, {"td_abs": jnp.abs(td),
+                      "q_mean": jnp.mean(q)}
+
+    def update(params, target_params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return update
 
 
 class DQN(Checkpointable):
@@ -115,44 +168,9 @@ class DQN(Checkpointable):
     # ------------------------------------------------------------- learner
 
     def _make_update(self):
-        import jax
-        import jax.numpy as jnp
-        import optax
-
         cfg = self.config
-        forward = self._forward
-
-        def loss_fn(params, target_params, batch):
-            q_all, _ = forward(params, batch["obs"])
-            q = jnp.take_along_axis(
-                q_all, batch["actions"][:, None].astype(jnp.int32),
-                axis=-1)[:, 0]
-            q_next_target, _ = forward(target_params, batch["next_obs"])
-            if cfg.double_q:
-                # Double DQN: online net picks the argmax, target net rates.
-                q_next_online, _ = forward(params, batch["next_obs"])
-                best = jnp.argmax(q_next_online, axis=-1)
-                next_q = jnp.take_along_axis(
-                    q_next_target, best[:, None], axis=-1)[:, 0]
-            else:
-                next_q = jnp.max(q_next_target, axis=-1)
-            target = batch["rewards"] + cfg.gamma * (
-                1.0 - batch["dones"]) * jax.lax.stop_gradient(next_q)
-            td = q - target
-            # Huber loss, importance-weighted for prioritized replay.
-            loss = jnp.mean(batch["weights"] * optax.huber_loss(q, target))
-            return loss, {"td_abs": jnp.abs(td),
-                          "q_mean": jnp.mean(q)}
-
-        def update(params, target_params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, target_params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state,
-                                                       params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, aux
-
-        return update
+        return make_dqn_update(self._forward, self.optimizer, cfg.gamma,
+                               cfg.double_q)
 
     # ------------------------------------------------------------- driver
 

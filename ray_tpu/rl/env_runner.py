@@ -76,6 +76,7 @@ class EnvRunner:
         self._completed: list = []
         self._params = None
         self._weights_version = -1
+        self._receiver = None  # pubsub weight sync (enable_weight_sync)
 
         from ray_tpu.rl.models import (
             build_policy,
@@ -160,13 +161,48 @@ class EnvRunner:
     def weights_version(self) -> int:
         return self._weights_version
 
+    def enable_weight_sync(self, key: str, channel: str = None) -> None:
+        """Switch weight intake to the pubsub fan-out path: every
+        ``sample()`` begins with a cheap freshness poll against the
+        cluster hub and pulls the object-plane ref only when the learner
+        published a NEWER version (the Podracer edge — the learner
+        publishes once, not once per runner). The first sample blocks
+        until an initial version exists."""
+        from ray_tpu.rl.distributed.fanout import (WEIGHTS_CHANNEL,
+                                                   WeightReceiver)
+
+        self._receiver = WeightReceiver(key, channel or WEIGHTS_CHANNEL)
+
+    def _sync_weights(self) -> None:
+        if self._receiver is None:
+            return
+        if self._params is None:
+            got = self._receiver.wait_initial()
+        else:
+            got = self._receiver.poll(0.0)
+        if got is not None:
+            version, params, extras = got
+            self.set_weights(params, version)
+            if "epsilon" in extras:
+                self._epsilon = float(extras["epsilon"])
+
+    def _policy_step(self, obs, key):
+        """One policy forward for a (N, ...) observation batch ->
+        (action, logp, value). The hook rollout actors override in
+        inference mode (sebulba split: the policy runs in a batched
+        inference service, not in this process)."""
+        assert self._params is not None, "set_weights first"
+        if self._policy_mode == "epsilon_greedy":
+            return self._sample_fn(self._params, obs, key, self._epsilon)
+        return self._sample_fn(self._params, obs, key)
+
     def sample(self) -> Dict[str, np.ndarray]:
         """Collect one fixed-length rollout (T, N, ...) with bootstrap
         values and an autoreset-aware ``valids`` mask; fixed shapes keep
         the learner's XLA program static."""
         import jax
 
-        assert self._params is not None, "set_weights first"
+        self._sync_weights()
         T, N = self.rollout_length, self.num_envs
         obs_dtype = self.obs.dtype
         obs_buf = np.zeros((T, N) + self.obs.shape[1:], obs_dtype)
@@ -183,12 +219,7 @@ class EnvRunner:
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            if self._policy_mode == "epsilon_greedy":
-                action, logp, value = self._sample_fn(
-                    self._params, self.obs, sub, self._epsilon)
-            else:
-                action, logp, value = self._sample_fn(
-                    self._params, self.obs, sub)
+            action, logp, value = self._policy_step(self.obs, sub)
             action = np.asarray(action)
             obs_buf[t] = self.obs
             act_buf[t] = action
@@ -230,12 +261,7 @@ class EnvRunner:
             self._prev_done = done
 
         # Bootstrap value for the final observation.
-        if self._policy_mode == "epsilon_greedy":
-            _, _, last_value = self._sample_fn(
-                self._params, self.obs, self._key, self._epsilon)
-        else:
-            _, _, last_value = self._sample_fn(
-                self._params, self.obs, self._key)
+        _, _, last_value = self._policy_step(self.obs, self._key)
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,

@@ -72,6 +72,53 @@ def vtrace(behavior_logp, target_logp, rewards, values, dones, last_value,
     return vs, pg_adv * valids
 
 
+def make_impala_update(forward, optimizer, cfg):
+    """The jittable V-trace actor-critic update, shared by the classic
+    learner below and the distributed learner
+    (``rl/distributed/onpolicy.py``) so the two cannot drift."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        T, N = batch["rewards"].shape
+        obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+        logits, values_flat = forward(params, obs)
+        logits = logits.reshape(T, N, -1)
+        values = values_flat.reshape(T, N)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        vs, pg_adv = vtrace(
+            batch["logp"], target_logp, batch["rewards"],
+            jax.lax.stop_gradient(values), batch["dones"],
+            batch["last_value"], batch["valids"], cfg.gamma,
+            cfg.rho_clip, cfg.c_clip)
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        valid_count = jnp.maximum(batch["valids"].sum(), 1.0)
+        pi_loss = -jnp.sum(target_logp * pg_adv) / valid_count
+        vf_loss = jnp.sum(
+            batch["valids"] * (values - vs) ** 2) / valid_count
+        entropy = -jnp.sum(
+            batch["valids"][..., None]
+            * jax.nn.softmax(logits) * logp_all) / valid_count
+        total = (pi_loss + cfg.vf_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    def update(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = loss
+        return params, opt_state, aux
+
+    return update
+
+
 @dataclass
 class IMPALAConfig(ConfigBuilderMixin):
     env: str = "CartPole-v1"
@@ -89,8 +136,23 @@ class IMPALAConfig(ConfigBuilderMixin):
     hidden: tuple = (64, 64)
     seed: int = 0
     broadcast_interval: int = 1  # learner updates between weight pushes
+    # Podracer actor/learner substrate (rl/distributed/): see
+    # ConfigBuilderMixin.distributed_rollouts and docs/RL.md.
+    distributed: bool = False
+    num_rollout_actors: int = 4
+    rollout_mode: str = "local"     # "inference" = sebulba split
+    shard_queue_size: int = 8
+    # Default off for on-policy: the V-trace scan runs along the time
+    # axis, and sharding T across the mesh turns the scan into a chain
+    # of cross-device dependencies.
+    learner_mesh: bool = False
+    max_shard_staleness: int = 0    # 0 = keep everything; else drop
 
-    def build(self) -> "IMPALA":
+    def build(self):
+        if self.distributed and type(self) is IMPALAConfig:
+            from ray_tpu.rl.distributed.onpolicy import DistributedIMPALA
+
+            return DistributedIMPALA(self)
         return IMPALA(self)
 
 
@@ -119,6 +181,16 @@ class IMPALA(Checkpointable):
         self._update = jax.jit(self._make_update())
 
         self.runners = make_env_runners(config)
+        # Weight sync rides the versioned pubsub fan-out: the learner
+        # publishes once per broadcast, runners pull on their next
+        # sample (Podracer edge; see rl/distributed/fanout.py).
+        from ray_tpu.rl.distributed.learner import new_plane_key
+
+        from ray_tpu.rl.distributed.fanout import WeightFanout
+
+        self._fanout = WeightFanout(new_plane_key("impala"))
+        ray_tpu.get([r.enable_weight_sync.remote(self._fanout.key)
+                     for r in self.runners])
         self._push_weights()
         # Continuous sampling: one outstanding rollout per runner, refilled
         # as the learner consumes (the async pipeline; no iteration barrier).
@@ -127,58 +199,22 @@ class IMPALA(Checkpointable):
             for i, runner in enumerate(self.runners)}
 
     def _make_update(self):
-        import jax
-        import jax.numpy as jnp
-        import optax
-
-        cfg = self.config
-        forward = self._forward
-
-        def loss_fn(params, batch):
-            T, N = batch["rewards"].shape
-            obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
-            logits, values_flat = forward(params, obs)
-            logits = logits.reshape(T, N, -1)
-            values = values_flat.reshape(T, N)
-            logp_all = jax.nn.log_softmax(logits)
-            target_logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
-            vs, pg_adv = vtrace(
-                batch["logp"], target_logp, batch["rewards"],
-                jax.lax.stop_gradient(values), batch["dones"],
-                batch["last_value"], batch["valids"], cfg.gamma,
-                cfg.rho_clip, cfg.c_clip)
-            vs = jax.lax.stop_gradient(vs)
-            pg_adv = jax.lax.stop_gradient(pg_adv)
-            valid_count = jnp.maximum(batch["valids"].sum(), 1.0)
-            pi_loss = -jnp.sum(target_logp * pg_adv) / valid_count
-            vf_loss = jnp.sum(
-                batch["valids"] * (values - vs) ** 2) / valid_count
-            entropy = -jnp.sum(
-                batch["valids"][..., None]
-                * jax.nn.softmax(logits) * logp_all) / valid_count
-            total = (pi_loss + cfg.vf_coeff * vf_loss
-                     - cfg.entropy_coeff * entropy)
-            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state,
-                                                       params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        return update
+        return make_impala_update(self._forward, self.optimizer,
+                                  self.config)
 
     def _push_weights(self) -> None:
+        """Publish ONCE to the versioned pubsub fan-out; every runner
+        pulls the object-plane ref at its next sample() freshness poll.
+        (The old path RPC'd ``set_weights.remote`` per runner — O(n)
+        learner-side calls per sync and a re-broadcast of the same
+        params ref n times.) The version clock is the learner's update
+        count + 1, so a runner's measured lag at consume time is
+        ``self._updates - (version - 1)`` in update units — the
+        staleness V-trace corrects for."""
         import jax
 
-        ref = ray_tpu.put(jax.device_get(self.params))
-        for runner in self.runners:
-            runner.set_weights.remote(ref, self._updates)
+        self._fanout.publish(jax.device_get(self.params),
+                             version=self._updates + 1)
 
     def train(self, min_rollouts: int = 4) -> Dict[str, Any]:
         """Consume >= min_rollouts as they arrive (no barrier), update per
@@ -212,7 +248,10 @@ class IMPALA(Checkpointable):
                 self.params, self.opt_state, aux = self._update(
                     self.params, self.opt_state, batch)
                 self._updates += 1
-                lag_sum += self._updates - rollout["weights_version"] - 1
+                # Fan-out versions are stamped updates+1 at publish, so
+                # the runner's lag in update units at consume is:
+                lag_sum += max(
+                    0, self._updates - rollout["weights_version"])
                 consumed += 1
                 valid_steps = int(rollout["valids"].sum())
                 self._total_env_steps += valid_steps
@@ -242,3 +281,4 @@ class IMPALA(Checkpointable):
 
     def stop(self) -> None:
         stop_runners(self.runners)
+        self._fanout.close()

@@ -71,6 +71,37 @@ def test_actor_init_error(ray_start_regular):
         ray_tpu.get(actor.boom.remote())
 
 
+def test_subclass_actor_exports_subclass(ray_start_regular):
+    """Regression (PR 10): spawning a BASE actor class must not poison a
+    later SUBCLASS spawn. export_callable cached the pickled (key, blob)
+    as a class attribute and read it back with getattr — which walks the
+    MRO, so the subclass inherited the base's cached export and the
+    worker silently instantiated the BASE class with the subclass's
+    arguments (how RolloutActor spawns turned into EnvRunner.__init__
+    "multiple values for 'num_envs'" whenever classic RL tests ran
+    first)."""
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+        def who(self):
+            return type(self).__name__
+
+    class Sub(Base):
+        def __init__(self, name, x=2):
+            super().__init__(x=x)
+            self.name = name
+
+        def tag(self):
+            return (self.name, self.x, self.who())
+
+    base = ray_tpu.remote(Base).remote()
+    assert ray_tpu.get(base.who.remote()) == "Base"
+    # Pre-fix this spawned a Base on the worker and died in __init__.
+    sub = ray_tpu.remote(Sub).remote("s", x=5)
+    assert ray_tpu.get(sub.tag.remote()) == ("s", 5, "Sub")
+
+
 def test_actor_death_detected(ray_start_regular):
     actor = Failing.remote()
     with pytest.raises(ray_tpu.TaskError):

@@ -87,26 +87,32 @@ def test_dqn_single_iteration(ray_start_regular):
         algo.stop()
 
 
-@pytest.mark.skip(
-    reason="environment-bound (triaged PR 3): the seeded training "
-           "trajectory plateaus at episode_return ~35-50 on this image's "
-           "jax 0.4.37 CPU numerics/RNG stream — probed to 80 iterations "
-           "(2x the test budget), best=52 vs the 100 threshold, so this "
-           "is not a budget problem; the run-to-reward bar needs retuning "
-           "against this jax version before it is signal again")
 @pytest.mark.timeout_s(420)
 def test_dqn_learns_cartpole(ray_start_regular):
-    """Run-to-reward: DQN with double-Q + prioritized replay improves
-    clearly on CartPole within a small budget (seeded)."""
-    algo = DQNConfig().environment("CartPole-v1").env_runners(
-        2, num_envs_per_runner=4).training(
+    """Run-to-reward, UN-SKIPPED in PR 10: the PR 3 triage was right
+    that the 2-runner plateau (best=52 over 80 iterations) was not a
+    budget problem — it was replay-stream correlation. On the Podracer
+    substrate (4 RolloutActors x 4 envs feeding prioritized replay
+    through the object plane, one pjit learner, pubsub weight fan-out)
+    the SAME hyperparameters and seed clear the bar: probed best=151
+    at iteration 33, ~19 s wall on the 1-core CI box.
+
+    This is also the off-policy half of the ISSUE 10 acceptance e2e:
+    >= 4 RolloutActors + pjit learner to the reward bar, with the
+    object-plane descriptor contract, per-actor version monotonicity,
+    and leak-free shutdown asserted on the REAL learning run."""
+    from ray_tpu.rl.distributed import DESCRIPTOR_BYTE_BUDGET
+
+    algo = DQNConfig().environment("CartPole-v1").distributed_rollouts(
+        4, num_envs_per_actor=4).training(
         rollout_length=64, lr=1e-3, batch_size=128,
         learning_starts=500, train_batches_per_iter=48,
         target_update_interval=100, epsilon_decay_steps=6000,
         prioritized_replay=True, seed=2).build()
     try:
         best, first = 0.0, None
-        for i in range(40):
+        metrics = {}
+        for _ in range(60):
             metrics = algo.train()
             ret = metrics.get("episode_return_mean")
             if ret is not None:
@@ -117,8 +123,21 @@ def test_dqn_learns_cartpole(ray_start_regular):
                 break
         assert first is not None
         assert best >= 100.0, f"DQN failed to learn: first={first}, best={best}"
+        # Acceptance contracts, asserted on the learning run itself:
+        assert algo.plane.monotonic_violations == 0
+        # Fan-out version clock: initial publish + one per iteration.
+        assert metrics["weights_version"] == \
+            metrics["training_iteration"] + 1
+        rl = metrics["rl"]
+        assert rl["env_steps"] > 0 and "queue_depth" in rl
+        assert rl["shard_desc_bytes"]["p99"] <= DESCRIPTOR_BYTE_BUDGET
+        assert rl["shard_desc_bytes"]["count"] >= rl["shards"]
+        assert rl["staleness"]["count"] == rl["shards"]
+        assert rl["learner_update_s"]["count"] == metrics["learner_steps"]
     finally:
         algo.stop()
+    assert algo.last_leak_report["queue_depth"] == 0
+    assert algo.last_leak_report["intake_alive"] is False
 
 
 @pytest.mark.timeout_s(420)
@@ -280,12 +299,14 @@ def _scripted_pendulum_dataset(n_episodes: int, noise: float, seed: int):
     })
 
 
-@pytest.mark.skip(
-    reason="environment-bound (triaged PR 3): offline CQL evaluates to "
-           "~-1135 on this image's jax 0.4.37 CPU numerics vs the -900 "
-           "run-to-reward bar (same class as test_dqn_learns_cartpole: "
-           "seeded trajectory diverged with the image's jax version); "
-           "needs retuning before it is signal again")
+# Re-probed in PR 10 (the DQN un-skip pass): CQL now PASSES the -900
+# bar on this image — first eval -1544, adaptive budget recovers to
+# -792 on the 3rd extension — but takes ~144 s wall on the 1-core box,
+# which does not fit the tier-1 870 s envelope (suite baseline ~770 s).
+# Slow-marked instead of skipped: it runs (and passes) outside tier-1.
+# Unlike DQN, CQL is OFFLINE — parallel rollouts cannot speed it up;
+# the wall time is 1600+ jitted updates on one core.
+@pytest.mark.slow
 @pytest.mark.timeout_s(500)
 def test_cql_learns_pendulum_offline(ray_start_regular):
     """Run-to-reward OFFLINE: train CQL purely from a logged near-expert
