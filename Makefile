@@ -3,7 +3,8 @@
 # per-family gates and the stub-drift gate in tests/test_analysis_v3.py).
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
-	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist
+	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist \
+	bench-obs
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -57,6 +58,12 @@ bench-sharded:
 # stripped engine; acceptance bar <2%) -> BENCH_SERVE.json.
 bench-trace:
 	python bench_decode.py --sections trace_overhead $(BENCH_ARGS)
+
+# Core-plane instrumentation overhead (ISSUE 11): RPC microbench hot
+# path + decode step loop, core_metrics_enabled on vs off (bar <2%)
+# -> BENCH_SERVE.json.
+bench-obs:
+	python bench_obs.py $(BENCH_ARGS)
 
 # Podracer substrate scaling rows (env-steps/s + learner updates/s at
 # 1/2/4 rollout actors, parameter-staleness p50/p99) -> BENCH_RL.json

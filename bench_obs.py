@@ -1,0 +1,170 @@
+"""Core-plane observability overhead benchmark (ISSUE 11 acceptance).
+
+Two rows, both instrumented-vs-uninstrumented with the <2% acceptance
+bar of the PR 9 trace bench:
+
+* ``obs_rpc_overhead_pct`` — the RPC microbench hot path (inline ping
+  round-trips through the reactor write path) with
+  ``core_metrics_enabled`` on vs off. The write path's instruments are
+  plain attribute increments under locks it already holds, plus two
+  clock reads per reactor flush; this row proves that stays noise.
+* ``obs_decode_step_overhead_pct`` — the steady decode step loop (the
+  PR 9 trace-overhead scenario) with the core-plane instruments armed
+  vs stripped, PR 9 observability at defaults both ways.
+
+Rows merge into BENCH_SERVE.json preserving every other row (PR 6
+idiom). Run via ``make bench-obs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import time
+
+
+def rpc_overhead_row(quick: bool, platform: str = ""):
+    from ray_tpu.core.config import config
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    calls = 2000 if quick else 6000
+    repeats = 4 if quick else 7
+
+    srv = RpcServer({"ping": lambda: "pong"}, name="bench-obs",
+                    inline_methods={"ping"})
+    cli = RpcClient(srv.addr)
+    old = config.core_metrics_enabled
+    try:
+        for _ in range(500):  # warm the path
+            cli.call("ping")
+
+        def segment(enabled: bool) -> float:
+            config.core_metrics_enabled = enabled
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                cli.call("ping")
+            return (time.perf_counter() - t0) / calls
+
+        # Interleave on/off segments over ONE connection: clock drift
+        # and scheduler noise on a 1-core host dwarf the delta being
+        # measured, so the comparison must be local in time.
+        on, off = [], []
+        for _ in range(repeats):
+            off.append(segment(False))
+            on.append(segment(True))
+    finally:
+        config.core_metrics_enabled = old
+        cli.close()
+        srv.stop()
+    t_off = statistics.median(off)
+    t_on = statistics.median(on)
+    overhead = (t_on - t_off) / t_off * 100.0
+    return [{
+        "metric": "obs_rpc_overhead_pct",
+        "value": round(overhead, 2), "unit": "%",
+        "note": (f"inline RPC round-trip {t_on * 1e6:.1f}us instrumented "
+                 f"vs {t_off * 1e6:.1f}us stripped (median of {repeats} x "
+                 f"{calls}-call segments; write-path counters + dial "
+                 f"counters + reactor flush timing armed); bar <2%; "
+                 f"{platform}"),
+    }]
+
+
+def decode_overhead_row(params, cfg, quick: bool, platform: str = ""):
+    from ray_tpu.core.config import config
+    from ray_tpu.serve.decode import DecodeEngine
+
+    import numpy as np
+
+    slots = 4
+    steps = 100 if quick else 200
+    repeats = 4 if quick else 6
+    capacity = 4096
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, 16).tolist()
+               for _ in range(slots)]
+
+    def measure(enabled: bool) -> float:
+        old = config.core_metrics_enabled
+        config.core_metrics_enabled = enabled
+        try:
+            # PR 9 observability at DEFAULTS both ways: this row
+            # isolates the core-plane delta on top of the traced loop.
+            eng = DecodeEngine(params, cfg, slots=slots, capacity=capacity,
+                               prefix_pool_entries=0)
+            reqs = [eng.submit(p, max_new_tokens=capacity - 64)
+                    for p in prompts]
+            for _ in range(20):
+                eng.step()
+            samples = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    eng.step()
+                samples.append((time.perf_counter() - t0) / steps)
+            for r in reqs:
+                eng.cancel(r.request_id)
+            eng.step()
+            eng.shutdown()
+            return statistics.median(samples)
+        finally:
+            config.core_metrics_enabled = old
+
+    t_off = measure(False)
+    t_on = measure(True)
+    overhead = (t_on - t_off) / t_off * 100.0
+    return [{
+        "metric": "obs_decode_step_overhead_pct",
+        "value": round(overhead, 2), "unit": "%",
+        "note": (f"decode step loop {t_on * 1e6:.0f}us core-instrumented "
+                 f"vs {t_off * 1e6:.0f}us stripped per step (median of "
+                 f"{repeats} x {steps}-step segments, {slots} active "
+                 f"slots, PR 9 tracing defaults both ways); bar <2%; "
+                 f"{platform}"),
+    }]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.quick or args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    from ray_tpu.models import llama
+
+    preset = args.model or ("debug" if args.quick else "160m")
+    cfg = llama.PRESETS[preset]
+    params = llama.init_params(cfg, jax.random.key(0))
+    platform = jax.devices()[0].platform
+    plat_note = f"{preset} model, {platform} backend"
+
+    rows = rpc_overhead_row(args.quick, plat_note)
+    rows += decode_overhead_row(params, cfg, args.quick, plat_note)
+
+    out_path = "BENCH_SERVE.json"
+    doc = {"artifact": "BENCH_SERVE", "rows": []}
+    if os.path.exists(out_path) and not args.quick:
+        with open(out_path) as f:
+            doc = json.load(f)
+        emitted = {r["metric"] for r in rows}
+        doc["rows"] = [r for r in doc.get("rows", [])
+                       if r["metric"] not in emitted]
+    if args.quick:
+        out_path = "/tmp/bench_obs_quick.json"
+    doc["rows"] = doc.get("rows", []) + rows
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
-"""metrics-name-collision: one metric name, one definition.
+"""Metrics family (#10): name collisions and label cardinality.
 
-The metrics registry keys entries by (name, tags); two call sites
+**metrics-name-collision** — one metric name, one definition. The
+metrics registry keys entries by (name, tags); two call sites
 registering the SAME name as different KINDS (Counter vs Histogram) or
 with different histogram BUCKET grids silently produce entries that can
 never be merged — the controller aggregation, ``slo_summary`` and the
@@ -14,6 +15,18 @@ Collected package-wide: constructor calls of ``Counter`` / ``Gauge`` /
 confused — whose first argument is a literal string. The definition
 signature is (kind, boundaries-literal); the first site wins and every
 later disagreeing site is flagged.
+
+**metrics-label-cardinality** — label VALUES must be bounded. A tag
+like ``{"request": request_id}`` creates one registry series per
+request: the series never merge (each key is unique), the per-process
+snapshot grows until the ``metrics_max_series`` cap starts dropping
+BOUNDED series, and every snapshot push carries the garbage. Flagged
+at record call sites (``.inc/.set/.observe/.observe_many(...,
+tags={...})`` and ``set_default_tags({...})``): any label-value
+expression containing an id-shaped terminal name (``*_id``, ``oid``,
+``uuid``, …) or an id-producing call (``.hex()``, ``uuid4()``). Label
+values that are genuinely bounded ids (node ids: series die with the
+node) carry a pragma with the justification.
 """
 
 from __future__ import annotations
@@ -74,6 +87,82 @@ def _boundaries_literal(call: ast.Call) -> Optional[str]:
     if len(call.args) >= 3:
         return ast.dump(call.args[2])
     return None
+
+
+def _is_id_shaped(expr: ast.AST) -> Optional[str]:
+    """The sub-expression that makes a label value unbounded, rendered
+    for the message — or None when the value looks bounded."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in rules.METRICS_ID_CALLS:
+                return f"{name}() call"
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            term = node.id if isinstance(node, ast.Name) else node.attr
+            if (term in rules.METRICS_ID_NAMES
+                    or term.endswith(rules.METRICS_ID_SUFFIX)):
+                return f"identifier {term!r}"
+    return None
+
+
+def _tags_dict(call: ast.Call, method: str) -> Optional[ast.Dict]:
+    """The tags dict literal of a metric-record call, if present."""
+    for kw in call.keywords:
+        if kw.arg == "tags" and isinstance(kw.value, ast.Dict):
+            return kw.value
+    idx = 0 if method == "set_default_tags" else 1
+    if len(call.args) > idx and isinstance(call.args[idx], ast.Dict):
+        return call.args[idx]
+    return None
+
+
+def _check_cardinality(project: Project, emit_files=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in sorted(project.files, key=lambda s: s.relpath):
+        if emit_files is not None and f.relpath not in emit_files:
+            continue
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            is_scope = isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))
+            if is_scope:
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in rules.METRICS_RECORD_METHODS):
+                return
+            tags = _tags_dict(node, node.func.attr)
+            if tags is None:
+                return
+            for key, value in zip(tags.keys, tags.values):
+                if not (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    continue  # **splat merges checked at their own site
+                if isinstance(value, ast.Constant):
+                    continue
+                why = _is_id_shaped(value)
+                if why is None:
+                    continue
+                findings.append(Finding(
+                    rule=rules.METRICS_CARDINALITY, path=f.relpath,
+                    line=node.lineno, symbol=qualname_of(stack),
+                    message=(f"label {key.value!r} takes an id-shaped "
+                             f"value ({why}): one registry series per "
+                             f"id never merges and floods every "
+                             f"snapshot push — use a bounded label "
+                             f"(role/outcome/deployment) or pragma "
+                             f"with the bound's justification")))
+
+        visit(f.tree)
+    return findings
 
 
 def check_project(project: Project, emit_files=None) -> List[Finding]:
@@ -137,4 +226,5 @@ def check_project(project: Project, emit_files=None) -> List[Finding]:
             findings.append(Finding(
                 rule=rules.METRICS_COLLISION, path=site["relpath"],
                 line=site["line"], symbol=site["symbol"], message=msg))
+    findings.extend(_check_cardinality(project, emit_files))
     return findings

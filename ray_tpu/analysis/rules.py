@@ -28,6 +28,7 @@ SHARDING_UNPINNED = "sharding-unpinned-mesh-call"
 SHARDING_UNSCOPED = "sharding-unscoped-trace"
 RPC_STUB_DRIFT = "rpc-stub-drift"
 METRICS_COLLISION = "metrics-name-collision"
+METRICS_CARDINALITY = "metrics-label-cardinality"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -40,7 +41,7 @@ ALL_RULES = (
     SHARDING_CONTRACTION, SHARDING_ANCHOR,
     SHARDING_UNPINNED, SHARDING_UNSCOPED,
     RPC_STUB_DRIFT,
-    METRICS_COLLISION,
+    METRICS_COLLISION, METRICS_CARDINALITY,
 )
 
 # The ten checker families, for ``--jobs`` scheduling and per-family
@@ -56,7 +57,7 @@ FAMILIES = {
     "sharding-safety": (SHARDING_CONTRACTION, SHARDING_ANCHOR,
                         SHARDING_UNPINNED, SHARDING_UNSCOPED),
     "rpc-stubs": (RPC_STUB_DRIFT,),
-    "metrics": (METRICS_COLLISION,),
+    "metrics": (METRICS_COLLISION, METRICS_CARDINALITY),
 }
 
 # ------------------------------------------------- blocking-API tables
@@ -328,3 +329,21 @@ RPC_DYNAMIC_ENDPOINTS: frozenset = frozenset({
     # monitors, and the dashboard's generic proxy
     "ping",
 })
+
+# ------------------------------------- metrics label cardinality (#10)
+
+# Metric-record method names whose tags dict is inspected for unbounded
+# label values (tags= kwarg, the post-value positional, or the sole
+# argument of set_default_tags).
+METRICS_RECORD_METHODS = frozenset({"inc", "set", "observe",
+                                    "observe_many", "set_default_tags"})
+# Terminal identifier names that denote a per-request/object/task id —
+# unbounded label cardinality (one registry series per request never
+# merges and eventually evicts bounded series from the snapshot cap).
+# Matched against the LAST attribute/name segment of any sub-expression
+# of a label value; names merely ENDING in "_id" also match.
+METRICS_ID_NAMES = frozenset({"oid", "uuid", "request", "req_id"})
+METRICS_ID_SUFFIX = "_id"
+# Calls whose result is id-shaped regardless of receiver (oid.hex(),
+# uuid.uuid4()): flagged as label values.
+METRICS_ID_CALLS = frozenset({"hex", "uuid4", "uuid1"})

@@ -287,6 +287,26 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "snapshots to the cluster controller. Snapshots are CUMULATIVE, "
         "so a missed push (controller restart) never double-counts — the "
         "next successful push supersedes it."),
+    "core_metrics_enabled": (bool, True,
+        "Core-plane instrumentation (core/coremetrics.py): RPC write-path "
+        "and dial counters, object put/get/transfer instruments, pubsub "
+        "deliver latency + subscriber lag, controller scheduling/heartbeat "
+        "instruments. Hot paths pay plain attribute increments only; the "
+        "registry is touched at snapshot time by collectors. Off = the "
+        "pre-instrumentation fast path (bench_obs.py measures the delta)."),
+    "metrics_max_series": (int, 2000,
+        "Per-process cap on metric series included in one registry "
+        "snapshot push. Past it, overflow series are dropped from the "
+        "push (insertion order keeps established series flowing) and a "
+        "metrics_series_dropped gauge reports the overflow — a runaway "
+        "label-cardinality producer degrades visibly instead of growing "
+        "every heartbeat-cadence RPC without bound."),
+    "controller_metrics_http_port": (int, -1,
+        "Port for the controller-side Prometheus /metrics HTTP endpoint "
+        "(whole-cluster exposition text, series labeled by node/role/pid). "
+        "-1 disables; 0 binds an ephemeral port (Controller."
+        "metrics_http_addr reports it). The dashboard serves the same "
+        "text at its own /metrics route."),
 }
 
 

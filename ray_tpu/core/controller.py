@@ -131,6 +131,16 @@ class Controller:
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self._pgs: Dict[PlacementGroupID, PlacementGroupRecord] = {}
         self._metrics: Dict[str, List[Dict[str, Any]]] = {}
+        self._metrics_ts: Dict[str, float] = {}
+        # Control-plane instrumentation: plain counters bumped on the
+        # handler paths (heartbeat is INLINE on the reactor — it must
+        # never touch the registry lock), published by the snapshot-time
+        # collector below.
+        self._m_heartbeats = 0
+        self._m_node_deaths = 0
+        from ray_tpu.util.metrics import CounterDeltas
+
+        self._m_deltas = CounterDeltas()
         self._task_events: List[Dict[str, Any]] = []
         # Unmet-demand signal for the autoscaler (reference:
         # GcsAutoscalerStateManager's pending resource requests): deduped
@@ -223,10 +233,70 @@ class Controller:
         self._health_thread = threading.Thread(
             target=self._health_loop, name="controller-health", daemon=True)
         self._health_thread.start()
+        from ray_tpu.util import metrics as um
+
+        um.add_collector(self._collect_metrics)
+        # Optional controller-side Prometheus endpoint: the whole
+        # cluster's aggregated metrics as exposition text, scrapeable
+        # without the dashboard (config.controller_metrics_http_port).
+        self.metrics_http_addr: Optional[Addr] = None
+        self._metrics_http = None
+        if config.controller_metrics_http_port >= 0:
+            self._start_metrics_http(host,
+                                     config.controller_metrics_http_port)
         # Discovery file for the state CLI (`python -m ray_tpu status`).
         from ray_tpu.scripts import write_discovery
 
         write_discovery(self.address)
+
+    def _collect_metrics(self) -> None:
+        from ray_tpu.core import coremetrics as cm
+
+        if not config.core_metrics_enabled:
+            return
+        with self._lock:
+            pending = len(self._pending_demand)
+        cm.CTRL_PENDING_DEMAND.set(float(pending))
+        self._m_deltas.inc_to(cm.CTRL_HEARTBEATS, "hb", self._m_heartbeats)
+        self._m_deltas.inc_to(cm.CTRL_NODE_DEATHS, "deaths",
+                              self._m_node_deaths)
+
+    def _start_metrics_http(self, host: str, port: int) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        controller = self
+
+        class _MetricsHandler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path.split("?")[0] != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    payload = controller.metrics_text().encode()
+                except Exception as e:  # noqa: BLE001
+                    payload = f"# metrics unavailable: {e!r}\n".encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # silence
+                pass
+
+        try:
+            self._metrics_http = ThreadingHTTPServer((host, port),
+                                                     _MetricsHandler)
+        except OSError as e:
+            logger.warning("controller /metrics endpoint failed to bind "
+                           "%s:%s: %s", host, port, e)
+            return
+        self.metrics_http_addr = self._metrics_http.server_address
+        threading.Thread(target=self._metrics_http.serve_forever,
+                         name="controller-metrics-http",
+                         daemon=True).start()
 
     # ------------------------------------------------------- persistence
 
@@ -420,6 +490,7 @@ class Controller:
         periodic refresh. Beats still count for liveness either way;
         ``seq=None`` (unversioned caller) always applies."""
         with self._lock:
+            self._m_heartbeats += 1  # registry-free: runs on the reactor
             rec = self._nodes.get(NodeID(node_id_bytes))
             if rec is None:
                 return {"known": False}
@@ -526,7 +597,17 @@ class Controller:
         # view with its sub-slice reservations (the replicas holding
         # them died with the hosts; serve's reconcile re-reserves).
         self._topology.node_dead(node_id.hex())
+        # Metric series from the dead node's processes stop meaning
+        # anything (their counters died with them): drop them so the
+        # cluster view reflects live producers only. A restarted node
+        # registers a fresh id and pushes fresh cumulative snapshots —
+        # never a double count.
+        prefix = node_id.hex()[:8] + "/"
         with self._lock:
+            self._m_node_deaths += 1
+            for key in [k for k in self._metrics if k.startswith(prefix)]:
+                del self._metrics[key]
+                self._metrics_ts.pop(key, None)
             affected = [rec.actor_id for rec in self._actors.values()
                         if rec.node_id == node_id and rec.state == ALIVE]
         for actor_id in affected:
@@ -683,6 +764,7 @@ class Controller:
             opts = rec.opts
             spec = dict(rec.spec)
             incarnation = rec.incarnation
+        t_sched = time.perf_counter()
         try:
             deadline = time.monotonic() + config.worker_lease_timeout_s
             excluded: List[bytes] = []
@@ -759,6 +841,13 @@ class Controller:
                         NodeStub(self._clients.get(
                             tuple(node_addr))).kill_worker(
                                 lease["worker_id"], True)
+                    elif config.core_metrics_enabled:
+                        from ray_tpu.core import coremetrics as cm
+
+                        # Lease-grant latency pick -> ALIVE (scheduler
+                        # thread, not the reactor).
+                        cm.CTRL_SCHEDULE_S.observe(
+                            time.perf_counter() - t_sched)
                     return
                 # __init__ raised: permanent failure, no restart (parity with
                 # the reference: creation-task errors kill the actor).
@@ -1100,10 +1189,17 @@ class Controller:
 
     def push_metrics(self, source: Dict[str, Any],
                      snapshot: List[Dict[str, Any]]) -> None:
+        """Latest CUMULATIVE snapshot per source process, keyed
+        "<node8>/<role>/pid<N>" (node prefix lets node death drop the
+        series; role lets Prometheus queries split control/data plane).
+        Replacement — never accumulation — is what makes restarts and
+        missed pushes safe."""
         key = (f"{NodeID(source['node_id']).hex()[:8]}/"
+               f"{source.get('role', 'worker')}/"
                f"pid{source.get('pid', 0)}")
         with self._lock:
             self._metrics[key] = snapshot
+            self._metrics_ts[key] = time.monotonic()
 
     def list_metrics(self) -> Dict[str, List[Dict[str, Any]]]:
         with self._lock:
@@ -1131,6 +1227,13 @@ class Controller:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self._metrics_http is not None:
+            try:
+                self._metrics_http.shutdown()
+                self._metrics_http.server_close()
+            except Exception:  # graftlint: disable=swallowed-exception
+                # Teardown-only: the daemon thread dies with the process.
+                pass
         try:
             self.save_state()
         except Exception:

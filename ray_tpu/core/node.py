@@ -373,6 +373,14 @@ class Node:
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, name="node-heartbeat", daemon=True)
         self._heartbeat_thread.start()
+        # Cluster metrics pipeline: push this process's registry to the
+        # controller on the heartbeat cadence when no core-worker
+        # flusher owns the push (standalone `ray_tpu start` supervisors;
+        # see core/metrics_agent.py for the single-pusher arbitration).
+        from ray_tpu.core.metrics_agent import MetricsAgent
+
+        self.metrics_agent = MetricsAgent(self._controller,
+                                          self.node_id.binary())
         self._reaper_thread = threading.Thread(
             target=self._reaper_loop, name="node-reaper", daemon=True)
         self._reaper_thread.start()
@@ -1037,9 +1045,21 @@ class Node:
                 # strictly later point, so the controller can drop reordered
                 # (stale) beats (ray_syncer.h:88 versioned NodeState).
                 seq += 1
+                t_hb = time.perf_counter()
                 reply = self._controller.call(
                     "heartbeat", self.node_id.binary(), payload, queue_len,
                     seq, timeout=5.0)
+                if config.core_metrics_enabled:
+                    from ray_tpu.core import coremetrics as cm
+
+                    # Node-id label: the intended per-node grain — series
+                    # are bounded by live membership (the controller drops
+                    # a dead node's series with the node), not request
+                    # volume.
+                    # graftlint: disable=metrics-label-cardinality
+                    cm.NODE_HEARTBEAT_RTT.observe(
+                        time.perf_counter() - t_hb,
+                        {"node": self.node_id.hex()[:8]})
                 if payload is not None:
                     # Only a DELIVERED full beat counts as sent: a failed
                     # RPC must retry the payload next beat, or the
@@ -1295,6 +1315,7 @@ class Node:
 
     def stop(self) -> None:
         self._stopped.set()
+        self.metrics_agent.stop()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         if self.log_monitor is not None:

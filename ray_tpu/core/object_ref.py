@@ -48,9 +48,24 @@ class _RefTracker:
         self._pending_decs = collections.deque()
         self._send_failures: Dict[Addr, int] = {}
         self._wake = threading.Event()
+        # Live-handle gauge published at snapshot time: monotonic growth
+        # of this number is the ref-leak signature `ray_tpu doctor`
+        # attributes back to the owning process.
+        from ray_tpu.util import metrics as um
+
+        um.add_collector(self._collect_metrics)
         self._thread = threading.Thread(
             target=self._flush_loop, name="ref-tracker", daemon=True)
         self._thread.start()
+
+    def _collect_metrics(self) -> None:
+        from ray_tpu.core.config import config
+        from ray_tpu.core.coremetrics import OBJ_LIVE_REFS
+
+        if config.core_metrics_enabled:
+            with self._lock:
+                n = len(self._counts)
+            OBJ_LIVE_REFS.set(float(n))
 
     @classmethod
     def get(cls) -> "_RefTracker":
@@ -202,6 +217,7 @@ class _RefTracker:
                 # session (which starved the flush thread and every
                 # queued dec behind it).
                 self._send_failures.pop(owner, None)
+                self._count_abandon()
             except Exception:
                 # Transient failure: merge the deltas back for retry; a
                 # dropped +1/-1 would silently corrupt the owner's count.
@@ -214,6 +230,16 @@ class _RefTracker:
                         d = self._dirty.setdefault(owner, {})
                         for oid, delta in deltas.items():
                             d[oid] = d.get(oid, 0) + delta
+                else:
+                    self._count_abandon()
+
+    @staticmethod
+    def _count_abandon() -> None:
+        from ray_tpu.core.config import config
+        from ray_tpu.core.coremetrics import OBJ_FLUSH_ABANDONED
+
+        if config.core_metrics_enabled:
+            OBJ_FLUSH_ABANDONED.inc()
 
 
 def _tracking_enabled() -> bool:

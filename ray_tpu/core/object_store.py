@@ -44,6 +44,15 @@ class MemoryStore:
     def __init__(self):
         self._entries: Dict[ObjectID, _Entry] = {}
         self._lock = threading.Lock()
+        # Inline serialized bytes held by this store (shm-resident
+        # values are accounted by the node store). Maintained at the
+        # put/free/drop sites so the metrics collector reads a plain
+        # int instead of walking every entry.
+        self._data_bytes = 0
+
+    def data_bytes(self) -> int:
+        with self._lock:
+            return self._data_bytes
 
     def _entry(self, oid: ObjectID) -> _Entry:
         with self._lock:
@@ -66,6 +75,8 @@ class MemoryStore:
                 entry = _Entry()
                 self._entries[oid] = entry
             entry.owned = True
+            if entry.data is not None:
+                self._data_bytes -= len(entry.data)
             entry.data = None
             entry.shm_ref = None
             if entry.shm_view is not None:
@@ -116,7 +127,11 @@ class MemoryStore:
 
     def put_serialized(self, oid: ObjectID, data: bytes) -> None:
         entry = self._entry(oid)
-        entry.data = data
+        with self._lock:
+            if entry.data is not None:
+                self._data_bytes -= len(entry.data)
+            entry.data = data
+            self._data_bytes += len(data)
         entry.event.set()
 
     def put_shm(self, oid: ObjectID, shm_ref) -> None:
@@ -162,6 +177,8 @@ class MemoryStore:
             entry = self._entries.get(oid)
             if entry is None:
                 return
+            if entry.data is not None:
+                self._data_bytes -= len(entry.data)
             entry.data = None
             entry.shm_ref = None
             if entry.shm_view is not None:
@@ -189,12 +206,16 @@ class MemoryStore:
             if entry.shm_pin is not None:
                 entry.shm_pin.release()
                 entry.shm_pin = None
+            if entry.data is not None:
+                self._data_bytes -= len(entry.data)
             entry.nested = None
             del self._entries[oid]
 
     def delete(self, oid: ObjectID) -> None:
         with self._lock:
-            self._entries.pop(oid, None)
+            entry = self._entries.pop(oid, None)
+            if entry is not None and entry.data is not None:
+                self._data_bytes -= len(entry.data)
 
     def set_nested(self, oid: ObjectID, refs) -> None:
         if refs:

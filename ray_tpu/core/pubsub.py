@@ -30,6 +30,28 @@ class Pubsub:
         self._cond = threading.Condition()
         # (channel, key) -> (version, value). Versions are per-(channel,key).
         self._state: Dict[Tuple[str, str], Tuple[int, Any]] = {}
+        # (channel, key) -> monotonic publish time of the CURRENT version
+        # (publish -> deliver latency; guarded by _cond).
+        self._pub_ts: Dict[Tuple[str, str], float] = {}
+
+    @staticmethod
+    def _instrumented() -> bool:
+        from ray_tpu.core.config import config
+
+        return config.core_metrics_enabled
+
+    def _observe_delivery(self, channel: str, cur: Tuple[int, Any],
+                          last_version: int, pub_ts: Optional[float],
+                          parked_since: float) -> None:
+        """Record subscriber lag (versions skipped by this poll) and, for
+        a poller that was PARKED when the publish landed, the publish ->
+        delivery latency. Runs on RPC pool threads, never the reactor."""
+        from ray_tpu.core import coremetrics as cm
+
+        tags = {"channel": channel}
+        cm.PSUB_SUB_LAG.observe(float(cur[0] - last_version), tags)
+        if pub_ts is not None and pub_ts >= parked_since:
+            cm.PSUB_DELIVER_S.observe(time.monotonic() - pub_ts, tags)
 
     def publish(self, channel: str, key: str, value: Any,
                 min_version: int = 0) -> int:
@@ -37,31 +59,46 @@ class Pubsub:
         clocks monotonic across a HUB restart (head FT): a fresh hub would
         restart at 1, below what long-pollers already saw, stranding them —
         the publisher passes the floor it knows it reached before."""
+        instrumented = self._instrumented()
         with self._cond:
             version = max(self._state.get((channel, key), (0, None))[0] + 1,
                           min_version)
             self._state[(channel, key)] = (version, value)
+            if instrumented:
+                self._pub_ts[(channel, key)] = time.monotonic()
             self._cond.notify_all()
-            return version
+        if instrumented:
+            from ray_tpu.core import coremetrics as cm
+
+            cm.PSUB_PUBLISHES.inc(1.0, {"channel": channel})
+        return version
 
     def drop(self, channel: str, key: str) -> None:
         with self._cond:
             self._state.pop((channel, key), None)
+            self._pub_ts.pop((channel, key), None)
 
     def poll(self, channel: str, key: str, last_version: int = 0,
              timeout: float = 30.0) -> Optional[Tuple[int, Any]]:
         """Long-poll: block until (channel, key) has a version newer than
         ``last_version``; returns (version, value) or None on timeout."""
-        deadline = time.monotonic() + timeout
+        t_parked = time.monotonic()
+        deadline = t_parked + timeout
+        instrumented = self._instrumented()
         with self._cond:
             while True:
                 cur = self._state.get((channel, key))
                 if cur is not None and cur[0] > last_version:
-                    return cur
+                    pub_ts = self._pub_ts.get((channel, key))
+                    break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
                 self._cond.wait(min(remaining, 1.0))
+        if instrumented:
+            self._observe_delivery(channel, cur, last_version, pub_ts,
+                                   t_parked)
+        return cur
 
     def poll_many(self, watches: Dict[str, Tuple[str, str, int]],
                   timeout: float = 30.0):
@@ -69,20 +106,29 @@ class Pubsub:
         a caller-chosen tag -> (channel, key, last_version). Returns
         {tag: (version, value)} for every watch that has news, or None on
         timeout. One condition wait covers all watches."""
-        deadline = time.monotonic() + timeout
+        t_parked = time.monotonic()
+        deadline = t_parked + timeout
+        instrumented = self._instrumented()
         with self._cond:
             while True:
                 updates = {}
+                meta = []
                 for tag, (channel, key, last) in watches.items():
                     cur = self._state.get((channel, key))
                     if cur is not None and cur[0] > last:
                         updates[tag] = cur
+                        meta.append((channel, cur, last,
+                                     self._pub_ts.get((channel, key))))
                 if updates:
-                    return updates
+                    break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
                 self._cond.wait(min(remaining, 1.0))
+        if instrumented:
+            for channel, cur, last, pub_ts in meta:
+                self._observe_delivery(channel, cur, last, pub_ts, t_parked)
+        return updates
 
     def snapshot(self, channel: str) -> Dict[str, Tuple[int, Any]]:
         with self._cond:
@@ -132,6 +178,14 @@ class Subscriber:
         """Spawn a daemon thread invoking ``callback(version, value)`` on
         every update until ``stop_event`` is set."""
 
+        def _dropped():
+            from ray_tpu.core.config import config
+
+            if config.core_metrics_enabled:
+                from ray_tpu.core.coremetrics import PSUB_DROPPED_NOTIFIES
+
+                PSUB_DROPPED_NOTIFIES.inc(1.0, {"channel": channel})
+
         def _loop():
             version = last_version
             while not stop_event.is_set():
@@ -139,6 +193,7 @@ class Subscriber:
                     result = self._client.call("psub_poll", channel, key,
                                                version, 10.0, timeout=25.0)
                 except Exception:
+                    _dropped()
                     if stop_event.wait(1.0):
                         return
                     continue
@@ -153,6 +208,7 @@ class Subscriber:
                     # routing/membership bug in the making.
                     from ray_tpu.util.ratelimit import log_every
 
+                    _dropped()
                     log_every(f"pubsub.watch.{channel}", 10.0, logger,
                               "watch callback for %r failed", channel,
                               exc_info=True)

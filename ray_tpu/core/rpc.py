@@ -47,6 +47,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu.core.config import config
+from ray_tpu.core import coremetrics as cm
+from ray_tpu.util import metrics as um
 
 Addr = Tuple[str, int]
 
@@ -323,16 +325,71 @@ class RpcServer:
         # Connections with queued data deferred by chaos pacing
         # (reactor-private; see _flush).
         self._paced: List[RpcServer._Conn] = []
+        # Write-path observability: plain counters on the conn state
+        # (updated under st.lock, which the write path already holds)
+        # and reactor-private fold-in totals — the reactor and handler
+        # threads NEVER touch the metrics registry. _collect_metrics
+        # publishes at snapshot time (weakly registered: the collector
+        # dies with the server).
+        self._conn_states: Dict[RpcServer._Conn, None] = {}
+        self._m_closed_frames = 0
+        self._m_closed_bytes = 0
+        self._m_closed_bp = 0
+        self._m_conn_drops = 0
+        self._m_flush_samples: deque = deque(maxlen=512)
+        self._m_deltas = um.CounterDeltas()
+        um.add_collector(self._collect_metrics)
         self._reactor_thread = threading.Thread(
             target=self._reactor, name=f"{name}-reactor", daemon=True)
         self._reactor_thread.start()
+
+    def _collect_metrics(self) -> None:
+        """Snapshot-time publisher for the write-path counters (runs on
+        the metrics flusher/agent thread, never the reactor)."""
+        if not config.core_metrics_enabled or self._stopped.is_set():
+            return
+        with self._conns_lock:
+            live = list(self._conn_states)
+        frames, nbytes, bp = (self._m_closed_frames, self._m_closed_bytes,
+                              self._m_closed_bp)
+        q_bytes = 0
+        q_conns = 0
+        for st in live:
+            # st.lock per conn: an unlocked read can land between the
+            # reactor's sendmsg and its out_bytes decrement and report
+            # phantom queue bytes. This is a snapshot-cadence path; the
+            # reactor holds each lock only for one non-blocking flush.
+            with st.lock:
+                frames += st.m_frames
+                nbytes += st.m_bytes
+                bp += st.m_bp
+                out_bytes = st.out_bytes
+            if out_bytes > 0:
+                q_bytes += out_bytes
+                q_conns += 1
+        tags = {"server": self._name}
+        cm.RPC_OUT_QUEUE_BYTES.set(float(q_bytes), tags)
+        cm.RPC_OUT_QUEUE_CONNS.set(float(q_conns), tags)
+        self._m_deltas.inc_to(cm.RPC_TX_FRAMES, "frames", frames, tags)
+        self._m_deltas.inc_to(cm.RPC_TX_BYTES, "bytes", nbytes, tags)
+        self._m_deltas.inc_to(cm.RPC_BACKPRESSURE_DROPS, "bp", bp, tags)
+        self._m_deltas.inc_to(cm.RPC_CONN_DROPS, "drops",
+                              self._m_conn_drops, tags)
+        samples = []
+        while True:
+            try:
+                samples.append(self._m_flush_samples.popleft())
+            except IndexError:
+                break
+        if samples:
+            cm.RPC_FLUSH_S.observe_many(samples, tags)
 
     def register(self, method: str, fn: Callable) -> None:
         self._handlers[method] = fn
 
     class _Conn:
         __slots__ = ("sock", "buf", "out", "out_bytes", "lock", "writing",
-                     "dead", "next_send_t")
+                     "dead", "next_send_t", "m_frames", "m_bytes", "m_bp")
 
         def __init__(self, sock):
             self.sock = sock
@@ -343,6 +400,9 @@ class RpcServer:
             self.writing = False            # EVENT_WRITE armed (reactor-only)
             self.dead = False
             self.next_send_t = 0.0          # chaos pacing gate
+            self.m_frames = 0               # metrics (under lock; folded
+            self.m_bytes = 0                # into the server's closed
+            self.m_bp = 0                   # totals by _drop)
 
     # ----------------------------------------------------------- accept/read
 
@@ -359,6 +419,7 @@ class RpcServer:
             st = RpcServer._Conn(conn)
             with self._conns_lock:
                 self._conns.append(conn)
+                self._conn_states[st] = None
             try:
                 self._selector.register(conn, selectors.EVENT_READ, st)
             except KeyError:
@@ -398,6 +459,14 @@ class RpcServer:
         with self._conns_lock:
             if st.sock in self._conns:
                 self._conns.remove(st.sock)
+            if st in self._conn_states:
+                # Fold the dead conn's counters into the server totals so
+                # the collector's cumulative view never goes backwards.
+                del self._conn_states[st]
+                self._m_closed_frames += st.m_frames
+                self._m_closed_bytes += st.m_bytes
+                self._m_closed_bp += st.m_bp
+                self._m_conn_drops += 1
 
     # ------------------------------------------------------------- wake/ops
 
@@ -557,11 +626,14 @@ class RpcServer:
                 # the cap. A partial frame may already be on the wire, so
                 # the stream is torn either way — drop the conn.
                 st.dead = True
+                st.m_bp += 1
                 status = "error"
             else:
                 st.out.append(memoryview(_LEN.pack(total)))
                 st.out.extend(bufs)
                 st.out_bytes += _LEN.size + total
+                st.m_frames += 1
+                st.m_bytes += _LEN.size + total
                 if delay > 0:
                     st.next_send_t = max(st.next_send_t,
                                          time.monotonic() + delay)
@@ -617,8 +689,14 @@ class RpcServer:
 
     def _flush(self, st: "_Conn") -> None:
         """Reactor-side flush + interest-set bookkeeping."""
+        timed = config.core_metrics_enabled
+        t0 = time.perf_counter() if timed else 0.0
         with st.lock:
             status = self._flush_locked(st)
+        if timed:
+            # Bounded ring, drained by the snapshot-time collector; cost
+            # on the reactor is two clock reads and a deque append.
+            self._m_flush_samples.append(time.perf_counter() - t0)
         if status == "error":
             self._drop(st)
         elif status == "drained":
@@ -674,9 +752,11 @@ class RpcServer:
 class RpcClient:
     """Client multiplexing concurrent calls over one TCP connection."""
 
-    def __init__(self, addr: Addr, connect_timeout: Optional[float] = None):
+    def __init__(self, addr: Addr, connect_timeout: Optional[float] = None,
+                 role: str = "peer"):
         self.addr = tuple(addr)
-        self._sock = _connect(self.addr, connect_timeout)
+        self._role = role  # dial-metrics label: controller | peer
+        self._sock = _connect(self.addr, connect_timeout, role)
         self._send_lock = threading.Lock()
         self._next_id = 0
         self._id_lock = threading.Lock()
@@ -740,7 +820,7 @@ class RpcClient:
             # against _evict/close so eviction can never shut a half-built
             # fresh socket (see _evict's docstring).
             # graftlint: disable=lock-held-blocking
-            self._sock = _connect(self.addr, None)
+            self._sock = _connect(self.addr, None, self._role)
             self._pool_evicted = False
             self._closed = False
             self._reader = threading.Thread(target=self._read_loop,
@@ -878,14 +958,23 @@ class _PendingCall:
         return self._msg["result"]
 
 
-def _connect(addr: Addr, timeout: Optional[float]) -> socket.socket:
+def _connect(addr: Addr, timeout: Optional[float],
+             role: str = "peer") -> socket.socket:
     retries = config.rpc_connect_retries
+    instrumented = config.core_metrics_enabled
     deadline = None if timeout is None else time.monotonic() + timeout
     last_err: Optional[Exception] = None
     for _ in range(max(1, retries)):
         try:
             sock = socket.create_connection(addr, timeout=5.0)
         except OSError as e:
+            # Every failed attempt counts: a dead address under active
+            # redial shows up as a failure STORM in the cluster view,
+            # which is exactly the reconnect-storm signature ray_tpu
+            # doctor detects. Label is the peer ROLE (bounded), never
+            # the address (ephemeral ports = unbounded cardinality).
+            if instrumented:
+                cm.RPC_DIAL_FAILURES.inc(1.0, {"role": role})
             last_err = e
             if deadline is not None and time.monotonic() > deadline:
                 break
@@ -894,6 +983,8 @@ def _connect(addr: Addr, timeout: Optional[float]) -> socket.socket:
         try:
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if instrumented:
+                cm.RPC_DIALS.inc(1.0, {"role": role})
             return sock
         except OSError as e:
             # Post-connect setup failing must not orphan the connected
@@ -918,9 +1009,11 @@ class ReconnectingClient:
     handlers are idempotent by design (re-register, kv_put, heartbeat,
     create_placement_group 2PC)."""
 
-    def __init__(self, addr: Addr, retry_window_s: float = 10.0):
+    def __init__(self, addr: Addr, retry_window_s: float = 10.0,
+                 role: str = "controller"):
         self.addr = tuple(addr)
         self._window = retry_window_s
+        self._role = role
         self._client: Optional[RpcClient] = None
         self._lock = threading.Lock()
         self._closed = False
@@ -937,7 +1030,7 @@ class ReconnectingClient:
         # every concurrent call/notify/close on this handle behind one
         # stuck re-dial (graftlint: lock-held-blocking). Concurrent
         # re-dials are possible and cheap; first one in wins.
-        fresh = RpcClient(self.addr)
+        fresh = RpcClient(self.addr, role=self._role)
         with self._lock:
             if self._closed:
                 winner = None
@@ -973,6 +1066,8 @@ class ReconnectingClient:
                 # graftlint: disable=unguarded-field-access
                 if self._closed or time.monotonic() > deadline:
                     raise
+                if config.core_metrics_enabled:
+                    cm.RPC_RECONNECT_RETRIES.inc(1.0, {"role": self._role})
                 time.sleep(0.2)
 
     def notify(self, method: str, *args, **kwargs) -> None:

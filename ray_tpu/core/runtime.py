@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import serialization
 from ray_tpu.core.config import config
+from ray_tpu.util import tracing
 from ray_tpu.util.ratelimit import log_every
 
 logger = logging.getLogger(__name__)
@@ -218,9 +219,22 @@ class CoreWorker:
         # controller by the sweeper thread, bounded by event_buffer_max.
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
+        from ray_tpu.util import metrics as um
+
+        um.add_collector(self._collect_core_metrics)
         self._sweeper = threading.Thread(
             target=self._sweep_loop, name="ref-sweeper", daemon=True)
         self._sweeper.start()
+
+    def _collect_core_metrics(self) -> None:
+        """Snapshot-time store gauges (weakly registered — dies with the
+        core worker)."""
+        if not config.core_metrics_enabled:
+            return
+        from ray_tpu.core import coremetrics as cmx
+
+        cmx.OBJ_STORE_ENTRIES.set(float(self.store.size()))
+        cmx.OBJ_STORE_BYTES.set(float(self.store.data_bytes()))
 
     # -------------------------------------------------- shared-memory store
 
@@ -407,6 +421,10 @@ class CoreWorker:
             if rtotal != total:
                 raise ObjectLostError(
                     f"object {cache_oid.hex()} size changed mid-pull")
+            if config.core_metrics_enabled:
+                from ray_tpu.core.coremetrics import OBJ_TRANSFER_BYTES
+
+                OBJ_TRANSFER_BYTES.inc(float(len(data)))
             buf[offset:offset + len(data)] = data
 
         try:
@@ -436,6 +454,10 @@ class CoreWorker:
                 f"object {cache_oid.hex()} evicted from remote store "
                 f"mid-pull at offset 0")
         total, data = got
+        if config.core_metrics_enabled:
+            from ray_tpu.core.coremetrics import OBJ_TRANSFER_BYTES
+
+            OBJ_TRANSFER_BYTES.inc(float(len(data)))
         if total <= len(data):
             return bytes(data)
         buf = bytearray(total)
@@ -447,20 +469,35 @@ class CoreWorker:
     # ------------------------------------------------------------ put/get
 
     def put(self, value: Any) -> ObjectRef:
+        t0 = time.perf_counter()
+        t0_wall = time.time()
         oid = ObjectID.from_random()
         self.store.mark_owned(oid)
         with serialization.capture_refs() as nested:
             total, write = serialization.build_frame(value)
         self.store.set_nested(oid, nested)  # pin refs inside the frame
+        ref = None
         if total > config.inline_object_max_bytes:
             locator = self._try_put_frame(oid, total, write)
             if locator is not None:
                 self.store.put_shm_ref(oid, locator)
-                return ObjectRef(oid, self.addr)
-        out = bytearray(total)
-        write(out)
-        self.store.put_serialized(oid, bytes(out))
-        return ObjectRef(oid, self.addr)
+                ref = ObjectRef(oid, self.addr)
+        if ref is None:
+            out = bytearray(total)
+            write(out)
+            self.store.put_serialized(oid, bytes(out))
+            ref = ObjectRef(oid, self.addr)
+        if config.core_metrics_enabled:
+            from ray_tpu.core import coremetrics as cm
+
+            cm.OBJ_PUT_BYTES.inc(float(total))
+            cm.OBJ_PUT_S.observe(time.perf_counter() - t0)
+            # Object-plane hop in the request's trace (no-op without an
+            # active span): `ray_tpu timeline` shows a serve/RL request's
+            # puts alongside its RPC and engine spans.
+            tracing.record_span("object:put", t0_wall, time.time(),
+                                bytes=total, oid=oid.hex()[:8])
+        return ref
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -495,8 +532,24 @@ class CoreWorker:
             return self._chunk_pool_inst
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
+        if not config.core_metrics_enabled:
+            frame = self._get_frame(ref, timeout)
+            value = serialization.deserialize(frame)
+            if isinstance(value, TaskError):
+                raise value
+            return value
+        t0 = time.perf_counter()
+        t0_wall = time.time()
+        local = (ref.owner_addr in (None, self.addr)
+                 or self.store.is_ready(ref.id))
         frame = self._get_frame(ref, timeout)
         value = serialization.deserialize(frame)
+        from ray_tpu.core import coremetrics as cmx
+
+        path = "local" if local else "remote"
+        cmx.OBJ_GET_S.observe(time.perf_counter() - t0, {"path": path})
+        tracing.record_span("object:get", t0_wall, time.time(),
+                            path=path, oid=ref.hex()[:8])
         if isinstance(value, TaskError):
             raise value
         return value

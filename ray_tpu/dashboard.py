@@ -190,6 +190,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(json.dumps(self.history.snapshot()).encode())
             elif path == "/api/serve":
                 self._send(json.dumps(self._serve_slo()).encode())
+            elif path == "/api/core":
+                self._send(json.dumps(self._core_summary()).encode())
             elif path == "/metrics":
                 self._send(self.client.call("metrics_text").encode(),
                            "text/plain")
@@ -391,6 +393,73 @@ class _Handler(BaseHTTPRequestHandler):
 
         return slo_summary(self.client.call("list_metrics", timeout=5.0))
 
+    def _core_summary(self) -> Dict:
+        """Core-plane cluster view — the SAME ``coremetrics.core_summary``
+        read that backs ``ray_tpu metrics``, so the panel and the CLI can
+        never disagree (the serve-panel/slo_summary contract, applied to
+        the runtime underneath)."""
+        from ray_tpu.core.coremetrics import core_summary
+
+        return core_summary(self.client.call("list_metrics", timeout=5.0))
+
+    def _render_core_panel(self) -> str:
+        """Core-plane panel: RPC write path, object plane, pubsub and
+        control-plane health at a glance."""
+        try:
+            core = self._core_summary()
+        except Exception:
+            return ""
+        rpc, obj = core.get("rpc", {}), core.get("objects", {})
+        psub, ctl = core.get("pubsub", {}), core.get("control", {})
+        if not (rpc.get("tx_frames") or obj.get("put_bytes")
+                or ctl.get("heartbeats")):
+            return ""
+        rows = [{
+            "plane": "rpc",
+            "throughput": f"{rpc.get('tx_frames', 0):,.0f} frames / "
+                          f"{rpc.get('tx_bytes', 0) / 1e6:.1f} MB",
+            "queued": f"{rpc.get('queue_bytes', 0) / 1e6:.1f} MB on "
+                      f"{rpc.get('queued_conns', 0):.0f} conns",
+            "degraded": _esc(", ".join(filter(None, [
+                f"backpressure_drops={rpc['backpressure_drops']:.0f}"
+                if rpc.get("backpressure_drops") else "",
+                f"dial_failures={sum(rpc.get('dial_failures', {}).values()):.0f}"
+                if rpc.get("dial_failures") else "",
+                f"reconnects={rpc['reconnect_retries']:.0f}"
+                if rpc.get("reconnect_retries") else ""]))),
+        }, {
+            "plane": "objects",
+            "throughput": f"put {obj.get('put_bytes', 0) / 1e6:.1f} MB / "
+                          f"xfer {obj.get('transfer_bytes', 0) / 1e6:.1f} MB",
+            "queued": f"{obj.get('live_refs', 0):.0f} live refs, "
+                      f"{obj.get('store_bytes', 0) / 1e6:.1f} MB inline",
+            "degraded": _esc(
+                f"flush_abandoned={obj['flush_abandoned']:.0f}"
+                if obj.get("flush_abandoned") else ""),
+        }, {
+            "plane": "pubsub",
+            "throughput": f"{sum(psub.get('publishes', {}).values()):,.0f} "
+                          f"publishes",
+            "queued": "",
+            "degraded": _esc(
+                f"dropped_notifies={psub['dropped_notifies']:.0f}"
+                if psub.get("dropped_notifies") else ""),
+        }, {
+            "plane": "control",
+            "throughput": f"{ctl.get('heartbeats', 0):,.0f} heartbeats",
+            "queued": f"pending_demand={ctl.get('pending_demand', 0):.0f}",
+            "degraded": _esc(", ".join(filter(None, [
+                f"node_deaths={ctl['node_deaths']:.0f}"
+                if ctl.get("node_deaths") else "",
+                f"pending_releases={ctl['pending_subslice_releases']:.0f}"
+                if ctl.get("pending_subslice_releases") else ""]))),
+        }]
+        return ("<h2>core planes</h2>"
+                + _table(rows, ["plane", "throughput", "queued",
+                                "degraded"])
+                + "<p><a href='/api/core'>/api/core</a> · "
+                  "`ray_tpu doctor` explains degradations</p>")
+
     @staticmethod
     def _fmt_ms(summary: Optional[Dict], field: str) -> str:
         if not summary:
@@ -495,6 +564,7 @@ class _Handler(BaseHTTPRequestHandler):
         html += "<h2>object store</h2>" + _table(
             mem, ["node_id", "store", "spilled", "workers", "oom_kills"])
         html += self._render_serve_panel()
+        html += self._render_core_panel()
         # Recent tasks with drill-down links.
         events = self.client.call("list_task_events", 20)
         trows = [{

@@ -1,0 +1,254 @@
+"""``ray_tpu doctor`` — cluster failure-signature diagnosis.
+
+The core-plane metrics pipeline (core/coremetrics.py) makes the
+runtime's pathologies numbers; this module makes them SENTENCES. It
+takes two cluster metric snapshots a few seconds apart (rates and
+growth need a window — cumulative counters alone can't distinguish "a
+storm right now" from "a storm last Tuesday"), plus the node table for
+attribution, and pattern-matches the failure signatures that
+historically became hangs:
+
+* **rpc-backpressure** — a peer stopped reading and its outbound queue
+  hit ``rpc_outbound_cap_bytes`` (drops observed), or queues are
+  sitting near the cap (saturation in progress).
+* **reconnect-storm** — some process is burning dial attempts against
+  an address that never answers (dead replica/owner still being
+  courted).
+* **pubsub-lag** — subscribers are skipping versions faster than they
+  poll; consumers can't keep up with publishes on a channel.
+* **ref-leak** — a process's live ObjectRef handle count grew
+  monotonically across the window; with owner attribution (node/pid)
+  from the source key and node table.
+* **heartbeat-rtt-outlier** — one node's control-plane RTT is far off
+  the fleet median (overloaded host or sick link; next stop:
+  ``ray_tpu stacks`` / ``ray_tpu profile`` on that node).
+
+``diagnose`` is a pure function over snapshots so tests inject each
+fault into the REAL components and assert the doctor names it; the CLI
+(``python -m ray_tpu doctor``) wires it to a live controller.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.util.metrics import (counter_totals, delta_aggregated,
+                                  gauge_totals, histogram_quantile,
+                                  merge_histograms)
+
+# Tunable detection thresholds (tests tighten/loosen per injection).
+DEFAULT_THRESHOLDS = {
+    "backpressure_queue_bytes": 32 * 1024 * 1024,
+    "dial_failures": 8,            # failed connects over the window
+    "psub_lag_versions": 10.0,     # versions skipped per poll
+    "psub_lag_count": 3,           # polls that skipped that much
+    "ref_growth": 100,             # live handles gained over the window
+    "rtt_outlier_floor_s": 0.25,   # never flag RTTs below this
+    "rtt_outlier_factor": 5.0,     # x fleet median p99
+}
+
+
+def _per_source(aggregated, name: str, kind: str) -> Dict[str, float]:
+    """Sum one metric per SOURCE key (all tag series folded)."""
+    out: Dict[str, float] = {}
+    for source, metrics in aggregated.items():
+        for m in metrics:
+            if m.get("name") == name and m.get("kind") == kind:
+                out[source] = out.get(source, 0.0) + m.get("value", 0.0)
+    return out
+
+
+def _attribution(source: str, nodes: Optional[List[Dict[str, Any]]]
+                 ) -> str:
+    """Human-readable owner of a source key, via the node table."""
+    parts = source.split("/")
+    if len(parts) != 3:
+        return source
+    node8, role, pid = parts
+    where = f"{role} {pid}"
+    for n in (nodes or []):
+        if str(n.get("node_id", "")).startswith(node8):
+            addr = n.get("addr")
+            return (f"{where} on node {node8} "
+                    f"({addr[0]}:{addr[1]})" if addr else
+                    f"{where} on node {node8}")
+    return f"{where} on node {node8}"
+
+
+def diagnose(before: Dict[str, List[Dict[str, Any]]],
+             after: Dict[str, List[Dict[str, Any]]],
+             interval_s: float,
+             nodes: Optional[List[Dict[str, Any]]] = None,
+             thresholds: Optional[Dict[str, Any]] = None
+             ) -> List[Dict[str, Any]]:
+    """Pattern-match failure signatures between two cluster snapshots.
+
+    Returns findings ordered most-severe first; empty = healthy."""
+    th = dict(DEFAULT_THRESHOLDS)
+    th.update(thresholds or {})
+    delta = delta_aggregated(before, after)
+    findings: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------ rpc-backpressure
+    for source, drops in _per_source(delta, "rpc_backpressure_drops_total",
+                                     "counter").items():
+        if drops > 0:
+            findings.append({
+                "signature": "rpc-backpressure", "severity": "critical",
+                "source": source,
+                "summary": (f"{_attribution(source, nodes)} dropped "
+                            f"{int(drops)} connection(s) whose outbound "
+                            f"queue hit rpc_outbound_cap_bytes in "
+                            f"{interval_s:.0f}s — a peer stopped reading "
+                            f"its replies (stalled or wedged process)"),
+                "evidence": {"backpressure_drops": drops},
+                "remedy": ("find the stalled peer (it stopped consuming "
+                           "replies): `ray_tpu stacks` for wedged "
+                           "threads; check rpc_outbound_queue_bytes per "
+                           "source in `ray_tpu metrics`"),
+            })
+    for source, qbytes in _per_source(after, "rpc_outbound_queue_bytes",
+                                      "gauge").items():
+        if qbytes >= th["backpressure_queue_bytes"]:
+            findings.append({
+                "signature": "rpc-backpressure", "severity": "warning",
+                "source": source,
+                "summary": (f"{_attribution(source, nodes)} has "
+                            f"{qbytes / 1e6:.0f} MB queued for a peer "
+                            f"that is not reading — backpressure drop "
+                            f"imminent at the outbound cap"),
+                "evidence": {"queue_bytes": qbytes},
+                "remedy": "identify the slow consumer before the cap "
+                          "tears the stream",
+            })
+
+    # ------------------------------------------------- reconnect-storm
+    for source, fails in _per_source(delta, "rpc_dial_failures_total",
+                                     "counter").items():
+        if fails >= th["dial_failures"]:
+            roles = {dict(k).get("role", "-"): v for k, v in counter_totals(
+                {source: delta[source]}, "rpc_dial_failures_total").items()}
+            findings.append({
+                "signature": "reconnect-storm", "severity": "critical",
+                "source": source,
+                "summary": (f"{_attribution(source, nodes)} burned "
+                            f"{int(fails)} failed dial attempts in "
+                            f"{interval_s:.0f}s (roles: {roles}) — it is "
+                            f"redialing an address that never answers "
+                            f"(dead peer still referenced)"),
+                "evidence": {"dial_failures": fails, "by_role": roles},
+                "remedy": ("a dead owner/replica/controller address is "
+                           "still in use; check which peers died "
+                           "(`ray_tpu list nodes`, serve status) and "
+                           "whether their clients were invalidated"),
+            })
+
+    # ----------------------------------------------------- pubsub-lag
+    for key, entry in merge_histograms(delta, "psub_sub_lag").items():
+        channel = dict(key).get("channel", "-")
+        # counts[i+1] holds observations in (buckets[i], buckets[i+1]];
+        # pairing counts[1:] with the edges counts lags STRICTLY above
+        # each edge, and the final element is the +Inf overflow bucket.
+        hi = sum(n for edge, n in zip(entry["buckets"], entry["counts"][1:])
+                 if edge >= th["psub_lag_versions"])
+        p99 = histogram_quantile(entry, 0.99)
+        if (hi >= th["psub_lag_count"] and p99 is not None
+                and p99 >= th["psub_lag_versions"]):
+            findings.append({
+                "signature": "pubsub-lag", "severity": "warning",
+                "source": f"channel:{channel}",
+                "summary": (f"pubsub channel {channel!r}: subscribers "
+                            f"skipped >= {th['psub_lag_versions']:.0f} "
+                            f"versions on {int(hi)} polls in "
+                            f"{interval_s:.0f}s (p99 lag ~{p99:.0f}) — "
+                            f"consumers poll slower than publishers "
+                            f"publish"),
+                "evidence": {"lagged_polls": hi, "p99_lag": p99},
+                "remedy": ("latest-value semantics means state is "
+                           "current but intermediate versions are "
+                           "skipped; if consumers NEED every version, "
+                           "slow the publisher or speed the watcher "
+                           "callbacks (psub_dropped_notifies_total "
+                           "shows failing callbacks)"),
+            })
+
+    # -------------------------------------------------------- ref-leak
+    live_before = _per_source(before, "obj_live_refs", "gauge")
+    for source, now_val in _per_source(after, "obj_live_refs",
+                                       "gauge").items():
+        growth = now_val - live_before.get(source, 0.0)
+        if growth >= th["ref_growth"]:
+            findings.append({
+                "signature": "ref-leak", "severity": "warning",
+                "source": source,
+                "summary": (f"{_attribution(source, nodes)} gained "
+                            f"{int(growth)} live ObjectRef handles in "
+                            f"{interval_s:.0f}s (now {int(now_val)}) — "
+                            f"monotonic growth here pins objects "
+                            f"cluster-wide (leak suspect)"),
+                "evidence": {"live_refs": now_val, "growth": growth},
+                "remedy": ("that process is accumulating refs without "
+                           "dropping them; `ray_tpu profile <worker> "
+                           "--heap` on it, and check obj_store_bytes "
+                           "for the bytes it pins"),
+            })
+
+    # ------------------------------------------- heartbeat-rtt-outlier
+    per_node: Dict[str, float] = {}
+    for key, entry in merge_histograms(delta, "node_heartbeat_rtt_s").items():
+        if entry.get("count", 0) >= 2:
+            node = dict(key).get("node", "-")
+            p99 = histogram_quantile(entry, 0.99)
+            if p99 is not None:
+                per_node[node] = p99
+    if len(per_node) >= 2:
+        ordered = sorted(per_node.values())
+        median = ordered[len(ordered) // 2]
+        for node, p99 in per_node.items():
+            if (p99 >= th["rtt_outlier_floor_s"]
+                    and p99 >= th["rtt_outlier_factor"] * max(median, 1e-9)):
+                findings.append({
+                    "signature": "heartbeat-rtt-outlier",
+                    "severity": "warning", "source": f"node:{node}",
+                    "summary": (f"node {node}: heartbeat RTT p99 "
+                                f"~{p99 * 1e3:.0f}ms vs fleet median "
+                                f"~{median * 1e3:.0f}ms — overloaded "
+                                f"host or sick link to the controller"),
+                    "evidence": {"p99_s": p99, "fleet_median_s": median},
+                    "remedy": ("inspect that node: `ray_tpu stacks`, "
+                               "CPU/memory via the dashboard, and the "
+                               "controller's queue (one slow node must "
+                               "not set the fleet's lease latency)"),
+                })
+
+    order = {"critical": 0, "warning": 1}
+    findings.sort(key=lambda f: (order.get(f["severity"], 9),
+                                 f["signature"], f["source"]))
+    return findings
+
+
+def collect(client, interval_s: float = 2.0
+            ) -> Tuple[Dict, Dict, List[Dict[str, Any]], float]:
+    """Two cluster snapshots ``interval_s`` apart + the node table, off a
+    controller RPC client (the CLI's data acquisition)."""
+    before = client.call("list_metrics", timeout=10.0)
+    time.sleep(interval_s)
+    after = client.call("list_metrics", timeout=10.0)
+    nodes = client.call("list_nodes", timeout=10.0)
+    return before, after, nodes, interval_s
+
+
+def render(findings: List[Dict[str, Any]]) -> str:
+    if not findings:
+        return ("no failure signatures detected (checked: "
+                "rpc-backpressure, reconnect-storm, pubsub-lag, "
+                "ref-leak, heartbeat-rtt-outlier)")
+    lines = [f"{len(findings)} finding(s):", ""]
+    for i, f in enumerate(findings, 1):
+        lines.append(f"[{i}] {f['severity'].upper()} {f['signature']} "
+                     f"({f['source']})")
+        lines.append(f"    {f['summary']}")
+        lines.append(f"    remedy: {f['remedy']}")
+        lines.append("")
+    return "\n".join(lines).rstrip()

@@ -77,6 +77,11 @@ class WeightFanout:
             raise ValueError(
                 f"weights_version must be strictly monotonic: "
                 f"{version} <= {self._version}")
+        import time as _time
+
+        from ray_tpu.util import tracing
+
+        t0 = _time.time()
         ref = ray_tpu.put(host_params)
         self._version = self._version + 1 if version is None else version
         value = {"version": self._version, "ref": ref,
@@ -85,6 +90,11 @@ class WeightFanout:
         # controller restart (same idiom as serve's snapshot publish).
         self._hub_version = ControllerStub(_controller_client()).psub_publish(
             self._channel, self._key, value, self._hub_version + 1)
+        # Object-plane hop in the trace (no-op without an active span):
+        # `ray_tpu timeline` shows the weight put + hub publish as one
+        # psub:publish slice under the learner's sync span.
+        tracing.record_span("psub:publish", t0, _time.time(),
+                            channel=self._channel, version=self._version)
         self._latest_ref = ref
         return self._version
 

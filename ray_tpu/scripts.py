@@ -151,6 +151,74 @@ def cmd_list(args) -> None:
         raise SystemExit(f"unknown kind {kind!r}")
 
 
+def _fmt_summary(s: Optional[Dict[str, Any]]) -> str:
+    if not s or not s.get("count"):
+        return "-"
+    def ms(v):
+        return f"{v * 1e3:.2f}ms" if v is not None else "-"
+    return (f"n={s['count']} mean={ms(s.get('mean'))} "
+            f"p50={ms(s.get('p50'))} p99={ms(s.get('p99'))}")
+
+
+def cmd_metrics(args) -> None:
+    """Cluster metrics with quantile summaries (reference: the Grafana
+    panels over ``ray list metrics``): the core-plane view via the same
+    ``core_summary`` read path the dashboard core panel uses, plus a
+    merged table of every histogram in the cluster. ``--raw`` prints
+    Prometheus exposition text instead (same as ``list metrics``)."""
+    from ray_tpu.core.coremetrics import core_summary
+    from ray_tpu.util.metrics import (histogram_summary, merge_histograms)
+
+    client = _client(args)
+    if args.raw:
+        print(client.call("metrics_text"), end="")
+        return
+    agg = client.call("list_metrics")
+    summary = core_summary(agg)
+    print(f"sources: {len(agg)} "
+          f"({', '.join(sorted(agg)[:8])}{'…' if len(agg) > 8 else ''})")
+    for plane in ("rpc", "objects", "pubsub", "control"):
+        print(f"\n[{plane}]")
+        for field, value in summary[plane].items():
+            if isinstance(value, dict) and {"count", "p50"} <= set(value):
+                print(f"  {field:28s} {_fmt_summary(value)}")
+            elif isinstance(value, dict):
+                for label, inner in sorted(value.items()):
+                    text = (_fmt_summary(inner)
+                            if isinstance(inner, dict) else f"{inner:g}")
+                    print(f"  {field:28s} {label}: {text}")
+            else:
+                print(f"  {field:28s} {value:g}")
+    names = sorted({m["name"] for ms in agg.values() for m in ms
+                    if m.get("kind") == "histogram"
+                    and (not args.name or args.name in m["name"])})
+    if names:
+        print("\n[histograms, merged across sources]")
+        for name in names:
+            for key, entry in sorted(merge_histograms(agg, name).items()):
+                tags = ",".join(f"{k}={v}" for k, v in key)
+                label = f"{name}{{{tags}}}" if tags else name
+                print(f"  {label:44s} "
+                      f"{_fmt_summary(histogram_summary(entry))}")
+
+
+def cmd_doctor(args) -> int:
+    """Diagnose cluster failure signatures from two metric snapshots a
+    window apart (see ray_tpu/doctor.py for the signature catalog)."""
+    from ray_tpu import doctor
+
+    client = _client(args)
+    before, after, nodes, interval = doctor.collect(client, args.interval)
+    findings = doctor.diagnose(before, after, interval, nodes=nodes)
+    if args.json:
+        print(json.dumps(findings, indent=2, default=str))
+    else:
+        print(doctor.render(findings))
+    if findings and args.fail_on_findings:
+        return 2
+    return 0
+
+
 def build_chrome_trace(events: List[Dict[str, Any]],
                        serve_timelines: Optional[Dict[str, Any]] = None
                        ) -> List[Dict[str, Any]]:
@@ -552,6 +620,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_list.add_argument("kind", choices=["nodes", "actors", "jobs", "tasks",
                                          "metrics"])
     p_list.add_argument("--limit", type=int, default=1000)
+    p_metrics = sub.add_parser("metrics")
+    p_metrics.add_argument("--raw", action="store_true",
+                           help="Prometheus exposition text instead of "
+                                "quantile summaries")
+    p_metrics.add_argument("--name", default=None,
+                           help="substring filter for the histogram table")
+    p_doc = sub.add_parser("doctor")
+    p_doc.add_argument("--interval", type=float, default=2.0,
+                       help="seconds between the two metric snapshots "
+                            "(rates/growth need a window)")
+    p_doc.add_argument("--json", action="store_true")
+    p_doc.add_argument("--fail-on-findings", action="store_true",
+                       help="exit 2 when any signature is detected")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
@@ -611,6 +692,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if args.command == "status":
         cmd_status(args)
+    elif args.command == "metrics":
+        cmd_metrics(args)
+    elif args.command == "doctor":
+        return cmd_doctor(args)
     elif args.command == "list":
         cmd_list(args)
     elif args.command == "timeline":

@@ -86,6 +86,9 @@ class ServeController:
         self._pending_releases: List[str] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        from ray_tpu.util import metrics as um
+
+        um.add_collector(self._collect_metrics)
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True)
         self._reconciler.start()
@@ -298,6 +301,15 @@ class ServeController:
                       "releasing sub-slice %s of replica %s failed; "
                       "queued for retry", reservation_id, owner,
                       exc_info=True)
+
+    def _collect_metrics(self) -> None:
+        """Snapshot-time gauge: pending sub-slice release depth (failed
+        release RPCs are stranded chips until the retry succeeds)."""
+        from ray_tpu.serve import metrics as smetrics
+
+        with self._lock:
+            depth = len(self._pending_releases)
+        smetrics.PENDING_RELEASES.set(float(depth))
 
     def _retry_pending_releases(self) -> None:
         """Reconcile-tick retry of release RPCs that failed (head

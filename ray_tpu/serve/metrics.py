@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-from ray_tpu.util.metrics import (Counter, Histogram, counter_totals,
+from ray_tpu.util.metrics import (Counter, Gauge, Histogram, counter_totals,
                                   histogram_summary, merge_histograms)
 
 # Latency grids sized for decode serving: TTFT spans admission-queue
@@ -78,6 +78,12 @@ PREEMPTIONS = Counter(
     "serve_preemptions_total",
     "Engine recompute-preemptions under page pressure.",
     tag_keys=("deployment",))
+
+PENDING_RELEASES = Gauge(
+    "serve_pending_subslice_releases",
+    "Sub-slice release RPCs awaiting retry after a head blip "
+    "(ServeController._pending_releases depth — growth means chips are "
+    "stranded until the reconcile loop gets through).")
 
 # Outcomes worth a counter key even at zero; keeps dashboards stable.
 OUTCOMES = ("completed", "cancelled", "deadline_exceeded", "shed", "error")

@@ -29,6 +29,17 @@ class _Registry:
         self._lock = threading.Lock()
         self._metrics: Dict[Tuple[str, tuple], Dict[str, Any]] = {}
         self._flusher: Optional[threading.Thread] = None
+        # Snapshot-time collectors (see add_collector): hot paths keep
+        # plain attribute counters at zero registry cost; these callables
+        # publish them (gauges / counter deltas / batched histogram
+        # observations) only when a snapshot is actually taken.
+        self._collectors: List[Any] = []
+        self._collectors_lock = threading.Lock()
+        # One process, one pusher: the core-worker flusher owns the push
+        # when a runtime is connected; a node's MetricsAgent claims it
+        # otherwise. Two pushers shipping the same (cumulative) registry
+        # under different source keys would double every counter.
+        self._pusher: Optional[str] = None
 
     @classmethod
     def get(cls) -> "_Registry":
@@ -76,10 +87,89 @@ class _Registry:
                 target=self._flush_loop, name="metrics-flush", daemon=True)
             self._flusher.start()
 
-    def snapshot(self) -> List[Dict[str, Any]]:
+    def add_collector(self, fn) -> None:
+        """Register a snapshot-time collector. Bound methods are held
+        weakly so registering never pins its owner (an RpcServer that is
+        simply dropped must still be collectable); dead entries are
+        pruned at the next snapshot."""
+        import weakref
+
+        ref = (weakref.WeakMethod(fn)
+               if getattr(fn, "__self__", None) is not None else fn)
+        with self._collectors_lock:
+            self._collectors.append(ref)
+
+    def _run_collectors(self) -> None:
+        import weakref
+
+        with self._collectors_lock:
+            refs = list(self._collectors)
+        dead = []
+        for ref in refs:
+            fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
+            if fn is None:
+                dead.append(ref)
+                continue
+            try:
+                fn()
+            except Exception:
+                from ray_tpu.util.ratelimit import log_every
+
+                # A broken collector must never take down the flusher;
+                # systematically failing ones still leave a trail.
+                log_every("metrics.collector", 60.0,
+                          logging.getLogger(__name__),
+                          "metrics collector failed", exc_info=True)
+        if dead:
+            with self._collectors_lock:
+                for ref in dead:
+                    if ref in self._collectors:
+                        self._collectors.remove(ref)
+
+    def claim_pusher(self, owner: str) -> bool:
+        """First caller per process wins; the core-worker flusher passes
+        the reserved name 'core' which always wins (it has the richest
+        source identity). A stale 'core' claim is reclaimable once the
+        runtime disconnects (driver shutdown in a long-lived node
+        process). Returns True when ``owner`` should push."""
+        with self._collectors_lock:
+            if owner == "core":
+                self._pusher = "core"
+                return True
+            if self._pusher == "core":
+                from ray_tpu.core import runtime
+
+                if runtime._core_worker is not None:
+                    return False
+                self._pusher = owner
+                return True
+            if self._pusher in (None, owner):
+                self._pusher = owner
+                return True
+            return False
+
+    def release_pusher(self, owner: str) -> None:
+        with self._collectors_lock:
+            if self._pusher == owner:
+                self._pusher = None
+
+    def snapshot(self, run_collectors: bool = True) -> List[Dict[str, Any]]:
+        if run_collectors:
+            self._run_collectors()
+        from ray_tpu.core.config import config as rt_config
+
+        limit = rt_config.metrics_max_series
         with self._lock:
             out = []
+            dropped = 0
             for e in self._metrics.values():
+                if limit and len(out) >= limit:
+                    # Bounded push: a runaway-cardinality producer must
+                    # not grow every snapshot RPC without limit. Insertion
+                    # order is stable, so established series keep
+                    # flowing and the overflow is visible below.
+                    dropped += 1
+                    continue
                 d = dict(e)
                 if "counts" in d:
                     # Deep-copy the mutable histogram state: the shallow
@@ -88,6 +178,9 @@ class _Registry:
                     d["counts"] = list(d["counts"])
                     d["buckets"] = list(d["buckets"])
                 out.append(d)
+            if dropped:
+                out.append({"name": "metrics_series_dropped", "kind": "gauge",
+                            "tags": {}, "value": float(dropped)})
             return out
 
     def flush_now(self) -> bool:
@@ -99,6 +192,7 @@ class _Registry:
         core = runtime._core_worker
         if core is None:
             return False
+        self.claim_pusher("core")
         try:
             core.controller.notify("push_metrics", self._source(core),
                                    self.snapshot())
@@ -110,6 +204,7 @@ class _Registry:
     def _source(core) -> Dict[str, Any]:
         return {"node_id": core.node_id.binary(),
                 "worker_id": core.worker_id.binary(),
+                "role": getattr(core, "mode", "worker"),
                 "pid": __import__("os").getpid()}
 
     def _flush_loop(self) -> None:
@@ -121,6 +216,7 @@ class _Registry:
             core = runtime._core_worker
             if core is None:
                 continue
+            self.claim_pusher("core")
             try:
                 core.controller.notify("push_metrics", self._source(core),
                                        self.snapshot())
@@ -133,6 +229,36 @@ class _Registry:
                           logging.getLogger(__name__),
                           "metrics push to controller failed",
                           exc_info=True)
+
+
+def add_collector(fn) -> None:
+    """Register a snapshot-time collector on this process's registry.
+
+    The idiom for hot paths: keep plain attribute counters where the
+    locks you already hold make them cheap, and publish them (gauge
+    sets, counter deltas via :class:`CounterDeltas`, batched histogram
+    observations) only when a snapshot is taken — the RPC reactor and
+    the decode loop never touch the registry lock."""
+    _Registry.get().add_collector(fn)
+
+
+class CounterDeltas:
+    """Publish monotonic plain-int totals as registry counters.
+
+    ``inc_to(counter, key, total, tags)`` increments ``counter`` by the
+    growth since the last call for ``key``; a total that went BACKWARDS
+    (owner restarted / conn churned) re-bases without emitting, so a
+    restart never double-counts. Collector-thread only — no locking."""
+
+    def __init__(self):
+        self._last: Dict[Any, float] = {}
+
+    def inc_to(self, counter: "Counter", key: Any, total: float,
+               tags: Optional[Dict[str, str]] = None) -> None:
+        prev = self._last.get(key, 0.0)
+        if total > prev:
+            counter.inc(total - prev, tags)
+        self._last[key] = total
 
 
 class _Metric:
@@ -203,9 +329,16 @@ def prometheus_text(aggregated: Dict[str, Any]) -> str:
     Prometheus can compute quantiles with histogram_quantile()."""
     lines: List[str] = []
     for source, metrics in aggregated.items():
+        # Cluster source keys are "<node8>/<role>/pid<N>" (controller
+        # push_metrics): expose the parts as first-class labels so a
+        # Prometheus query can aggregate by node or role directly.
+        parts = source.split("/")
+        src_tags = {"source": source}
+        if len(parts) == 3 and parts[2].startswith("pid"):
+            src_tags.update(node=parts[0], role=parts[1], pid=parts[2][3:])
         for m in metrics:
             tags = dict(m.get("tags", {}))
-            tags["source"] = source
+            tags.update(src_tags)
             label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
             if m["kind"] == "histogram":
                 cum = 0
@@ -296,4 +429,51 @@ def counter_totals(aggregated: Dict[str, List[Dict[str, Any]]],
             if m.get("name") == name and m.get("kind") == "counter":
                 key = tuple(sorted(m.get("tags", {}).items()))
                 out[key] = out.get(key, 0.0) + m.get("value", 0.0)
+    return out
+
+
+def gauge_totals(aggregated: Dict[str, List[Dict[str, Any]]],
+                 name: str) -> Dict[tuple, float]:
+    """Sum same-name gauge entries across sources, keyed by tag items
+    (each source reports its own level; the cluster view is the sum —
+    e.g. per-process outbound queue bytes -> cluster queued bytes)."""
+    out: Dict[tuple, float] = {}
+    for metrics in aggregated.values():
+        for m in metrics:
+            if m.get("name") == name and m.get("kind") == "gauge":
+                key = tuple(sorted(m.get("tags", {}).items()))
+                out[key] = out.get(key, 0.0) + m.get("value", 0.0)
+    return out
+
+
+def delta_aggregated(before: Dict[str, List[Dict[str, Any]]],
+                     after: Dict[str, List[Dict[str, Any]]]
+                     ) -> Dict[str, List[Dict[str, Any]]]:
+    """Per-source deltas between two cluster snapshots (the doctor's
+    two-sample view): counters and histogram counts become the growth
+    over the window (clamped at >= 0 — a restarted producer re-bases
+    instead of going negative), gauges keep their AFTER level. Sources
+    present only in ``after`` count from zero."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for source, metrics in after.items():
+        prev = {(m.get("name"), tuple(sorted(m.get("tags", {}).items()))): m
+                for m in before.get(source, [])}
+        rows = []
+        for m in metrics:
+            key = (m.get("name"), tuple(sorted(m.get("tags", {}).items())))
+            p = prev.get(key)
+            d = dict(m)
+            if m.get("kind") == "counter":
+                d["value"] = max(0.0, m.get("value", 0.0)
+                                 - (p.get("value", 0.0) if p else 0.0))
+            elif m.get("kind") == "histogram":
+                d["counts"] = list(m["counts"])
+                d["buckets"] = list(m["buckets"])
+                if p and list(p.get("buckets", [])) == d["buckets"]:
+                    d["counts"] = [max(0, a - b) for a, b in
+                                   zip(d["counts"], p["counts"])]
+                    d["count"] = max(0, m["count"] - p["count"])
+                    d["sum"] = max(0.0, m["sum"] - p["sum"])
+            rows.append(d)
+        out[source] = rows
     return out

@@ -35,10 +35,14 @@ def log_every(key: str, period_s: float, logger: logging.Logger,
     now = time.monotonic()
     with _lock:
         last, suppressed = _state.get(key, (0.0, 0))
-        if now - last < period_s:
+        emit = now - last >= period_s
+        if emit:
+            _state[key] = (now, 0)
+        else:
             _state[key] = (last, suppressed + 1)
-            return False
-        _state[key] = (now, 0)
+    if not emit:
+        _count_suppressed(key)  # outside _lock: registry has its own
+        return False
     suffix = f" ({suppressed} similar suppressed)" if suppressed else ""
     try:
         logger.log(level, msg + suffix, *args, exc_info=exc_info)
@@ -47,6 +51,34 @@ def log_every(key: str, period_s: float, logger: logging.Logger,
         # closes handlers mid-write).
         return False
     return True
+
+
+def _count_suppressed(key: str) -> None:
+    """Every suppressed occurrence increments ``log_suppressed_total``
+    labeled by its site key — a suppressed error FLOOD is invisible in
+    the log by design, so it must be visible in the metrics pipeline
+    instead (the counter growing while the log is quiet is the tell).
+    Site keys are literal strings at the log_every call sites, so the
+    label stays bounded."""
+    try:
+        from ray_tpu.util.metrics import Counter
+
+        global _SUPPRESSED
+        if _SUPPRESSED is None:
+            _SUPPRESSED = Counter(
+                "log_suppressed_total",
+                "log_every records suppressed by rate limiting, by site.",
+                tag_keys=("site",))
+        _SUPPRESSED.inc(1.0, {"site": key})
+    # The one place that CANNOT log its failure: log_every is the
+    # logging path, and recursing into it from its own metrics hook
+    # (or at interpreter teardown) must never take down the caller.
+    # graftlint: disable=swallowed-exception
+    except Exception:
+        pass
+
+
+_SUPPRESSED = None
 
 
 def reset() -> None:

@@ -613,7 +613,7 @@ def test_reconnecting_client_close_not_blocked_by_dial(monkeypatch):
     release_dial = threading.Event()
     real_connect = rpc_mod._connect
 
-    def slow_connect(addr, timeout):
+    def slow_connect(addr, timeout, role="peer"):
         dial_started.set()
         release_dial.wait(10.0)
         raise rpc_mod.RpcError(f"no peer at {addr}")

@@ -1,0 +1,553 @@
+"""Core-plane observability (ISSUE 11): RPC/object/pubsub/controller
+instrumentation, the per-node MetricsAgent and cluster merge semantics
+(two nodes merge, restart never double-counts, node death drops
+series, controller restart leaves the agent alive), the controller's
+Prometheus endpoint, `ray_tpu metrics` / `ray_tpu doctor` CLIs with
+injected fault signatures, object-plane spans in the Chrome trace, the
+log_suppressed_total ratelimit counter, and the
+metrics-label-cardinality lint rule."""
+
+import json
+import logging
+import socket
+import textwrap
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu.util.metrics import (_Registry, delta_aggregated,
+                                  merge_histograms, prometheus_text)
+
+
+def _snapshot_agg(source="n1/node/pid1"):
+    """This process's registry as a one-source cluster aggregation."""
+    return {source: _Registry.get().snapshot()}
+
+
+def _counter_total(agg, name):
+    from ray_tpu.util.metrics import counter_totals
+
+    return sum(counter_totals(agg, name).values())
+
+
+# ----------------------------------------------- plane instrumentation
+
+
+def test_rpc_server_write_path_counters():
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    srv = RpcServer({"echo": lambda x: x}, name="obs-t",
+                    inline_methods={"echo"})
+    try:
+        cli = RpcClient(srv.addr)
+        for i in range(20):
+            assert cli.call("echo", i) == i
+        snap = _Registry.get().snapshot()
+        mine = {m["name"]: m for m in snap
+                if m.get("tags", {}).get("server") == "obs-t"}
+        assert mine["rpc_tx_frames_total"]["value"] >= 20
+        assert mine["rpc_tx_bytes_total"]["value"] > 0
+        assert mine["rpc_outbound_queue_bytes"]["value"] == 0.0
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_rpc_dial_counters_and_roles():
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    before = _counter_total(_snapshot_agg(), "rpc_dials_total")
+    srv = RpcServer({"ping": lambda: "pong"}, name="obs-d")
+    cli = RpcClient(srv.addr, role="peer")
+    cli.close()
+    srv.stop()
+    after_agg = _snapshot_agg()
+    assert _counter_total(after_agg, "rpc_dials_total") >= before + 1
+
+
+def test_metrics_disabled_skips_core_series(monkeypatch):
+    from ray_tpu.core.config import config
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    monkeypatch.setattr(config, "core_metrics_enabled", False)
+    srv = RpcServer({"ping": lambda: "pong"}, name="obs-off")
+    try:
+        cli = RpcClient(srv.addr)
+        cli.call("ping")
+        snap = _Registry.get().snapshot()
+        assert not any(m.get("tags", {}).get("server") == "obs-off"
+                       for m in snap)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_pubsub_lag_and_delivery_instruments():
+    from ray_tpu.core.pubsub import Pubsub
+
+    hub = Pubsub()
+    chan = f"obs-{uuid.uuid4().hex[:6]}"
+    for i in range(30):
+        hub.publish(chan, "k", i)
+    # A subscriber that never polled sees version 30 from 0: lag 30.
+    for _ in range(3):
+        assert hub.poll(chan, "k", 0, timeout=1.0)[0] == 30
+
+    # Delivery latency: poller parks first, publish wakes it.
+    got = []
+
+    def parked():
+        got.append(hub.poll(chan, "k", 30, timeout=5.0))
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.1)
+    hub.publish(chan, "k", "late")
+    t.join(timeout=5.0)
+    assert got and got[0][0] == 31
+
+    agg = _snapshot_agg()
+    lag = merge_histograms(agg, "psub_sub_lag")[(("channel", chan),)]
+    assert lag["count"] >= 3
+    assert lag["counts"][-1] + sum(
+        n for e, n in zip(lag["buckets"], lag["counts"]) if e >= 25) >= 3
+    deliver = merge_histograms(agg, "psub_deliver_s")[(("channel", chan),)]
+    assert deliver["count"] >= 1
+    assert _counter_total(agg, "psub_publishes_total") >= 31
+
+
+def test_log_suppressed_counter():
+    from ray_tpu.util import ratelimit
+
+    site = f"obs.site.{uuid.uuid4().hex[:6]}"
+    logger = logging.getLogger(__name__)
+    ratelimit.reset()
+    assert ratelimit.log_every(site, 60.0, logger, "first")
+    for _ in range(4):
+        assert not ratelimit.log_every(site, 60.0, logger, "flood")
+    totals = {tuple(sorted(m["tags"].items())): m["value"]
+              for m in _Registry.get().snapshot()
+              if m["name"] == "log_suppressed_total"}
+    assert totals[(("site", site),)] == 4.0
+
+
+def test_snapshot_bounded_by_max_series(monkeypatch):
+    from ray_tpu.core.config import config
+
+    monkeypatch.setattr(config, "metrics_max_series", 5)
+    snap = _Registry.get().snapshot()
+    assert len(snap) <= 6  # 5 series + the overflow gauge
+    dropped = [m for m in snap if m["name"] == "metrics_series_dropped"]
+    assert dropped and dropped[0]["value"] > 0
+
+
+def test_prometheus_text_splits_cluster_source_labels():
+    text = prometheus_text({"ab12cd34/node/pid77": [
+        {"name": "x_total", "kind": "counter", "tags": {}, "value": 3.0}]})
+    assert 'node="ab12cd34"' in text
+    assert 'role="node"' in text
+    assert 'pid="77"' in text
+    assert 'source="ab12cd34/node/pid77"' in text
+
+
+# -------------------------------------------- cluster merge semantics
+
+
+def _hist_entry(name, counts, tags=None, buckets=(0.1, 1.0)):
+    counts = list(counts)
+    return {"name": name, "kind": "histogram", "tags": dict(tags or {}),
+            "buckets": list(buckets), "counts": counts,
+            "sum": float(sum(counts)), "count": int(sum(counts))}
+
+
+@pytest.fixture
+def controller():
+    from ray_tpu.core.controller import Controller
+
+    c = Controller()
+    yield c
+    c.stop()
+
+
+def _push(c, node_bytes, role, pid, snapshot):
+    c.push_metrics({"node_id": node_bytes, "role": role, "pid": pid},
+                   snapshot)
+
+
+def test_two_nodes_same_histogram_merge(controller):
+    name = f"cm_{uuid.uuid4().hex[:6]}_s"
+    _push(controller, b"A" * 16, "node", 1, [_hist_entry(name, [2, 1, 0])])
+    _push(controller, b"B" * 16, "node", 2, [_hist_entry(name, [0, 3, 1])])
+    agg = controller.list_metrics()
+    merged = merge_histograms(agg, name)[()]
+    assert merged["counts"] == [2, 4, 1]
+    assert merged["count"] == 7
+
+
+def test_same_source_repush_never_double_counts(controller):
+    name = f"cm_{uuid.uuid4().hex[:6]}_total"
+    counter = {"name": name, "kind": "counter", "tags": {}, "value": 50.0}
+    _push(controller, b"A" * 16, "worker", 9, [counter])
+    _push(controller, b"A" * 16, "worker", 9,
+          [dict(counter, value=70.0)])  # cumulative re-push (restart-safe)
+    assert _counter_total(controller.list_metrics(), name) == 70.0
+
+
+def test_node_death_drops_its_series(controller):
+    from ray_tpu.core.ids import NodeID
+
+    nid = NodeID.from_random()
+    controller.register_node(nid.binary(), ("127.0.0.1", 1),
+                             {"CPU": 1.0}, {})
+    _push(controller, nid.binary(), "node", 3,
+          [{"name": "cm_dead_total", "kind": "counter", "tags": {},
+            "value": 5.0}])
+    other = NodeID.from_random()
+    _push(controller, other.binary(), "node", 4,
+          [{"name": "cm_dead_total", "kind": "counter", "tags": {},
+            "value": 2.0}])
+    assert _counter_total(controller.list_metrics(), "cm_dead_total") == 7.0
+    controller.unregister_node(nid.binary())
+    agg = controller.list_metrics()
+    assert _counter_total(agg, "cm_dead_total") == 2.0
+    assert not any(k.startswith(nid.hex()[:8]) for k in agg)
+
+
+def test_metrics_agent_survives_controller_restart():
+    """The node-side pusher mirrors the PR 9 flusher contract: a head
+    restart costs retries, never the agent thread, and cumulative
+    re-pushes land in the NEW controller without double-counting."""
+    from ray_tpu.core.controller import Controller
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.metrics_agent import MetricsAgent
+    from ray_tpu.core.rpc import ReconnectingClient
+
+    nid = NodeID.from_random()
+    c1 = Controller()
+    host, port = c1.address
+    client = ReconnectingClient((host, port), retry_window_s=2.0)
+    agent = MetricsAgent(client, nid.binary(), period_s=0.05)
+    try:
+        key = f"{nid.hex()[:8]}/node/pid"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(k.startswith(key) for k in c1.list_metrics()):
+                break
+            time.sleep(0.05)
+        assert any(k.startswith(key) for k in c1.list_metrics())
+        c1.stop()
+        time.sleep(0.3)  # agent pushes fail against the dead head
+        assert agent._thread.is_alive()
+        c2 = Controller(port=port)  # head restarts on the same address
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if any(k.startswith(key) for k in c2.list_metrics()):
+                    break
+                time.sleep(0.05)
+            assert any(k.startswith(key) for k in c2.list_metrics())
+            assert agent._thread.is_alive()
+        finally:
+            c2.stop()
+    finally:
+        agent.stop()
+        client.close()
+
+
+def test_single_pusher_arbitration():
+    reg = _Registry.get()
+    old = reg._pusher
+    try:
+        reg._pusher = None
+        assert reg.claim_pusher("agent-1")
+        assert not reg.claim_pusher("agent-2")
+        assert reg.claim_pusher("agent-1")  # idempotent re-claim
+        assert reg.claim_pusher("core")     # the flusher always wins
+        # With no live runtime, a stale 'core' claim is reclaimable.
+        assert reg.claim_pusher("agent-2")
+        reg.release_pusher("agent-2")
+        assert reg.claim_pusher("agent-1")
+    finally:
+        reg._pusher = old
+
+
+def test_controller_prometheus_http_endpoint(monkeypatch):
+    from ray_tpu.core.config import config
+    from ray_tpu.core.controller import Controller
+
+    monkeypatch.setattr(config, "controller_metrics_http_port", 0)
+    c = Controller()
+    try:
+        _push(c, b"H" * 16, "node", 8,
+              [{"name": "cm_http_total", "kind": "counter", "tags": {},
+                "value": 4.0}])
+        assert c.metrics_http_addr is not None
+        host, port = c.metrics_http_addr
+        text = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10.0).read().decode()
+        assert 'cm_http_total' in text
+        assert 'role="node"' in text
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/nope",
+                                   timeout=10.0)
+    finally:
+        c.stop()
+
+
+# ------------------------------------------------- doctor signatures
+
+
+def test_doctor_detects_injected_backpressure():
+    """Signature 1: a stalled peer fills its outbound queue past the cap."""
+    from ray_tpu import doctor
+    from ray_tpu.core.rpc import RpcClient, RpcServer, RpcError
+
+    before = _snapshot_agg()
+    srv = RpcServer({"blob": lambda n: b"x" * n}, name="obs-bp",
+                    inline_methods={"blob"},
+                    outbound_cap_bytes=1 << 20)
+    try:
+        cli = RpcClient(srv.addr)
+        # A 2 MiB reply against a 1 MiB cap trips backpressure at
+        # enqueue; the conn is torn, so the call fails.
+        with pytest.raises((RpcError, TimeoutError)):
+            cli.call("blob", 2 << 20, timeout=5.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if _counter_total(_snapshot_agg(),
+                              "rpc_backpressure_drops_total") > \
+                    _counter_total(before,
+                                   "rpc_backpressure_drops_total"):
+                break
+            time.sleep(0.05)
+        cli.close()
+    finally:
+        srv.stop()
+    findings = doctor.diagnose(before, _snapshot_agg(), 1.0)
+    bp = [f for f in findings if f["signature"] == "rpc-backpressure"]
+    assert bp and bp[0]["severity"] == "critical"
+    assert "stopped reading" in bp[0]["summary"]
+
+
+def test_doctor_detects_injected_reconnect_storm(monkeypatch):
+    """Signature 2: redialing an address that never answers."""
+    from ray_tpu import doctor
+    from ray_tpu.core.config import config
+    from ray_tpu.core.rpc import RpcClient, RpcConnectError
+
+    # A port that is closed NOW (bind+close; nothing listens after).
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = s.getsockname()
+    s.close()
+    monkeypatch.setattr(config, "rpc_connect_retries", 10)
+    before = _snapshot_agg()
+    with pytest.raises(RpcConnectError):
+        RpcClient(dead)
+    findings = doctor.diagnose(before, _snapshot_agg(), 1.0)
+    storm = [f for f in findings if f["signature"] == "reconnect-storm"]
+    assert storm and storm[0]["severity"] == "critical"
+    assert "never answers" in storm[0]["summary"]
+
+
+def test_doctor_detects_injected_pubsub_lag():
+    """Signature 3: subscribers skipping versions faster than they poll."""
+    from ray_tpu import doctor
+    from ray_tpu.core.pubsub import Pubsub
+
+    before = _snapshot_agg()
+    hub = Pubsub()
+    chan = f"lag-{uuid.uuid4().hex[:6]}"
+    for i in range(40):
+        hub.publish(chan, "w", i)
+    for _ in range(4):  # four polls that each skipped 40 versions
+        hub.poll(chan, "w", 0, timeout=1.0)
+    findings = doctor.diagnose(before, _snapshot_agg(), 1.0)
+    lag = [f for f in findings if f["signature"] == "pubsub-lag"
+           and chan in f["source"]]
+    assert lag and "consumers poll slower" in lag[0]["summary"]
+
+
+def test_doctor_detects_injected_ref_growth():
+    """Signature 4: monotonic live-ref growth with owner attribution."""
+    from ray_tpu import doctor
+    from ray_tpu.core.object_ref import _RefTracker
+
+    tracker = _RefTracker.get()
+    owner = ("127.0.0.1", 65431)
+    before = _snapshot_agg()
+    oids = [f"leak-{uuid.uuid4().hex}-{i}".encode() for i in range(250)]
+    for oid in oids:
+        tracker.inc(owner, oid)
+    nodes = [{"node_id": "n1aaaaaa" + "0" * 56,
+              "addr": ("127.0.0.1", 4321), "alive": True}]
+    findings = doctor.diagnose(before, _snapshot_agg(), 1.0, nodes=nodes,
+                               thresholds={"ref_growth": 200})
+    leak = [f for f in findings if f["signature"] == "ref-leak"]
+    assert leak and "leak suspect" in leak[0]["summary"]
+    # Owner attribution: source key resolved through the node table.
+    assert "on node n1 (127.0.0.1:4321)" in leak[0]["summary"]
+    for oid in oids:  # release so later growth checks start clean
+        tracker.dec(owner, oid)
+    tracker._drain_decs()
+
+
+def test_doctor_detects_heartbeat_rtt_outlier():
+    """Signature 5: one node's control-plane RTT far off the fleet
+    median (metrics-level injection: four nodes, one sick)."""
+    from ray_tpu import doctor
+
+    buckets = (0.0005, 0.001, 0.005, 0.01, 0.1, 0.5, 1.0)
+
+    def rtt(node, fast, slow):
+        counts = [0, fast, 0, 0, 0, slow, 0, 0]
+        return {"name": "node_heartbeat_rtt_s", "kind": "histogram",
+                "tags": {"node": node}, "buckets": list(buckets),
+                "counts": counts, "sum": 0.001 * fast + 1.0 * slow,
+                "count": fast + slow}
+
+    before = {f"n{i}/node/pid{i}": [rtt(f"n{i}", 0, 0)] for i in range(4)}
+    after = {f"n{i}/node/pid{i}": [rtt(f"n{i}", 10, 0)] for i in range(3)}
+    after["n3/node/pid3"] = [rtt("n3", 0, 10)]
+    findings = doctor.diagnose(before, after, 2.0)
+    out = [f for f in findings if f["signature"] == "heartbeat-rtt-outlier"]
+    assert out and out[0]["source"] == "node:n3"
+    assert "fleet median" in out[0]["summary"]
+
+
+def test_doctor_healthy_cluster_is_quiet():
+    from ray_tpu import doctor
+
+    snap = _snapshot_agg()
+    assert doctor.diagnose(snap, snap, 2.0) == []
+    assert "no failure signatures" in doctor.render([])
+
+
+# ------------------------------------------ object plane + CLI (live)
+
+
+def test_object_plane_instruments_and_spans(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.core.runtime import get_core_worker
+    from ray_tpu.util import tracing
+
+    core = get_core_worker()
+    before = _snapshot_agg()
+    with tracing.trace("obs-root"):
+        ref = ray_tpu.put(np.zeros(256 * 1024, dtype=np.uint8))
+        got = ray_tpu.get(ref)
+    assert got.nbytes == 256 * 1024
+    after = _snapshot_agg()
+    delta = delta_aggregated(before, after)
+    assert _counter_total(delta, "obj_put_bytes_total") >= 256 * 1024
+    put_h = merge_histograms(delta, "obj_put_s")
+    assert sum(e["count"] for e in put_h.values()) >= 1
+    get_h = merge_histograms(delta, "obj_get_s")
+    assert sum(e["count"] for e in get_h.values()) >= 1
+    # Store gauges come from the core-worker collector.
+    names = {m["name"] for m in after["n1/node/pid1"]}
+    assert {"obj_store_entries", "obj_store_bytes",
+            "obj_live_refs"} <= names
+
+    # The spans land in the task-event buffer -> timeline.
+    core._flush_task_events()
+    events = core.controller.call("list_task_events", 10000)
+    descs = {e.get("desc") for e in events if e.get("state") == "SPAN"}
+    assert "object:put" in descs
+    assert "object:get" in descs
+    from ray_tpu.scripts import build_chrome_trace
+
+    trace = build_chrome_trace(events)
+    span_names = {t["name"] for t in trace if t.get("cat") == "span"}
+    assert {"object:put", "object:get"} <= span_names
+    del ref, got
+
+
+def test_metrics_and_doctor_cli(ray_start_regular, capsys):
+    from ray_tpu.core.runtime import get_core_worker
+    from ray_tpu.scripts import main
+    from ray_tpu.util.metrics import _Registry
+
+    core = get_core_worker()
+    assert _Registry.get().flush_now()
+    host, port = core.controller_addr
+    addr = f"{host}:{port}"
+    assert main(["--address", addr, "metrics"]) == 0
+    out = capsys.readouterr().out
+    assert "[rpc]" in out and "[objects]" in out and "[control]" in out
+    assert "tx_frames" in out
+    assert main(["--address", addr, "metrics", "--raw"]) == 0
+    assert "rpc_tx_frames_total" in capsys.readouterr().out
+    assert main(["--address", addr, "doctor", "--interval", "0.2"]) == 0
+    out = capsys.readouterr().out
+    assert ("no failure signatures" in out) or ("finding(s)" in out)
+    assert main(["--address", addr, "doctor", "--interval", "0.1",
+                 "--json"]) == 0
+    json.loads(capsys.readouterr().out)
+
+
+# --------------------------------------- metrics-label-cardinality lint
+
+
+def _lint_project(**modules):
+    from ray_tpu.analysis.core import Project, SourceFile
+
+    files = []
+    for name, src in modules.items():
+        rel = f"ray_tpu/{name}.py"
+        files.append(SourceFile(f"/fixture/{rel}", rel,
+                                textwrap.dedent(src)))
+    return Project("/fixture", files)
+
+
+def _run_metrics_lint(project):
+    from ray_tpu.analysis import metrics_lint
+
+    by_rel = {f.relpath: f for f in project.files}
+    return [f for f in metrics_lint.check_project(project)
+            if not by_rel[f.path].suppressed(f.rule, f.line)]
+
+
+def test_cardinality_lint_flags_id_shaped_labels():
+    project = _lint_project(a="""
+        from ray_tpu.util.metrics import Counter, Histogram
+        C = Counter("card_total")
+        H = Histogram("card_s")
+        def handle(req, oid):
+            C.inc(1.0, {"request": req.request_id})
+            H.observe(0.1, tags={"object": oid.hex()})
+            C.set_default_tags({"trace": req.trace_id})
+        """)
+    findings = _run_metrics_lint(project)
+    assert len(findings) == 3
+    assert all(f.rule == "metrics-label-cardinality" for f in findings)
+    msgs = " | ".join(f.message for f in findings)
+    assert "request_id" in msgs and "hex()" in msgs
+
+
+def test_cardinality_lint_true_negatives_and_pragma():
+    project = _lint_project(b="""
+        from ray_tpu.util.metrics import Counter, Gauge
+        C = Counter("card_tn_total")
+        G = Gauge("card_tn_gauge")
+        def record(self, status, plane_key, name):
+            C.inc(1.0, {"outcome": status, "deployment": name})
+            G.set(2.0, {"plane": plane_key})
+            G.set(1.0)                      # no tags at all
+            x = [].set(1, {"k": name})      # bounded value: fine
+            # graftlint: disable=metrics-label-cardinality
+            C.inc(1.0, {"node": self.node_id.hex()})
+        """)
+    assert _run_metrics_lint(project) == []
+
+
+def test_cardinality_lint_repo_is_clean():
+    from ray_tpu.analysis import repo_root, run_analysis
+
+    findings, _stats = run_analysis(
+        root=repo_root(), select=["metrics-label-cardinality"], jobs=1)
+    assert findings == [], [f.render() for f in findings]

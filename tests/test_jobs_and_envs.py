@@ -162,6 +162,16 @@ def test_dashboard_endpoints(ray_start_regular):
             f"{base}/api/memory", timeout=10).read())
         assert mem and mem[0]["store_capacity_bytes"] > 0
         assert "object store" in html
+        # Core-plane panel: same core_summary read path as the CLI.
+        from ray_tpu.util.metrics import _Registry
+
+        assert _Registry.get().flush_now()
+        core_view = json.loads(urllib.request.urlopen(
+            f"{base}/api/core", timeout=10).read())
+        assert {"rpc", "objects", "pubsub", "control"} <= set(core_view)
+        assert core_view["rpc"]["tx_frames"] > 0
+        html = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert "core planes" in html
     finally:
         server.shutdown()
 

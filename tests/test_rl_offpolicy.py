@@ -195,6 +195,15 @@ def test_sac_single_iteration(ray_start_regular):
         algo.stop()
 
 
+# Tier-1 budget triage (ISSUE 11): this was the single slowest tier-1
+# test at 51.9 s (2026-08-05 profile, suite 801 s vs the 870 s cap) —
+# run-to-reward SAC is ~5k jitted updates + env steps on the 1-core
+# box, and like CQL above it is update-bound, so parallel rollouts
+# can't shrink the wall. Verified passing (best > -600 within budget)
+# before slow-marking; it still runs (and passes) outside tier-1, and
+# SAC's machinery stays covered in tier-1 by the action-space /
+# replay / offline-roundtrip tests in this file.
+@pytest.mark.slow
 @pytest.mark.timeout_s(400)
 def test_sac_learns_pendulum(ray_start_regular):
     """Run-to-reward: SAC pulls Pendulum well above the random baseline
