@@ -4,7 +4,7 @@
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
 	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist \
-	bench-obs
+	bench-obs bench-chaos
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -64,6 +64,14 @@ bench-trace:
 # -> BENCH_SERVE.json.
 bench-obs:
 	python bench_obs.py $(BENCH_ARGS)
+
+# Control-plane MTTR (ISSUE 12): SIGKILL the serve controller under
+# live streams via util/faultinject (never ad-hoc kills), measure
+# detection -> snapshots-flowing recovery, in-flight failures (bound
+# 0) and adopted-in-place replicas -> BENCH_SERVE.json, rows merged
+# without clobbering the existing sections.
+bench-chaos:
+	JAX_PLATFORMS=cpu python bench_chaos.py
 
 # Podracer substrate scaling rows (env-steps/s + learner updates/s at
 # 1/2/4 rollout actors, parameter-staleness p50/p99) -> BENCH_RL.json

@@ -65,10 +65,11 @@ _EXITS = ("fall", "return", "raise", "break", "continue")
 @dataclass
 class Resource:
     rid: int
-    kind: str            # ctor | pair | pool | ref | lease
+    kind: str            # ctor | pair | pool | ref | lease | ckpt
     name: Optional[str]  # local var holding it (ctor/pool/lease)
     recv_key: Optional[str]   # receiver dotted key (pair/ref/pool),
-    #                           or "rpc:<release name>" (lease)
+    #                           "rpc:<release name>" (lease), or
+    #                           "ckpt:<save method>" (ckpt)
     release_verb: str
     label: str
     line: int
@@ -77,6 +78,26 @@ class Resource:
 
 _LEASE_NAMES = frozenset(rules.RPC_LEASE_PAIRS) \
     | frozenset(rules.RPC_LEASE_PAIRS.values())
+# Save-method names of the checkpoint idiom (checkpoint-missing-save):
+# a state-mutating handler "acquires" dirty state at entry and must
+# discharge it by reaching the class's save method on every normal exit.
+_CKPT_SAVES = frozenset(save for save, _methods
+                        in rules.CHECKPOINT_CLASSES.values())
+
+
+def _ckpt_entry(info: FunctionInfo):
+    """(save_method, label) when ``info`` is a handler the checkpoint
+    table obliges to save, else None."""
+    if info.cls is None:
+        return None
+    entry = rules.CHECKPOINT_CLASSES.get(info.cls)
+    if entry is None:
+        return None
+    save, methods = entry
+    name = getattr(info.node, "name", "")
+    if name in methods:
+        return save, f"state mutation in {info.cls}.{name}"
+    return None
 
 
 def _lease_rpc_name(node: ast.AST) -> Optional[str]:
@@ -123,6 +144,33 @@ def _release_summaries(graph: CallGraph) -> Dict[str, Set[Tuple[str, str]]]:
             name = _lease_rpc_name(node)
             if name in lease_releases:
                 direct[info.fqn].add((f"rpc:{name}", name))
+    # checkpoint saves: ``self._save_state()`` on a method's NORMAL
+    # path counts, and propagates through self-call chains below
+    # (delete -> _save_state discharges a caller's obligation). Saves
+    # lexically inside an ``except`` handler are excluded from the
+    # summary: a callee that only checkpoints on its failure path
+    # (_release_reservation queueing a failed release) does not
+    # discharge its caller — summaries are path-insensitive, so
+    # without this exclusion every handler that can reach
+    # _kill_replica would count as checkpointed.
+    for save in _CKPT_SAVES:
+        except_ids: Dict[str, Set[int]] = {}
+        for node, info in graph.calls_by_tail.get(save, ()):
+            if not (isinstance(node.func, ast.Attribute)
+                    and dotted(node.func.value) == "self"):
+                continue
+            ids = except_ids.get(info.fqn)
+            if ids is None:
+                ids = set()
+                for n in _walk_no_nested(info.node):
+                    if isinstance(n, ast.Try):
+                        for handler in n.handlers:
+                            ids.update(id(sub) for sub
+                                       in ast.walk(handler))
+                except_ids[info.fqn] = ids
+            if id(node) in ids:
+                continue
+            direct[info.fqn].add((f"ckpt:{save}", save))
 
     closure = {fqn: set(rel) for fqn, rel in direct.items()}
     changed = True
@@ -140,9 +188,11 @@ def _release_summaries(graph: CallGraph) -> Dict[str, Set[Tuple[str, str]]]:
                     # only self.-keyed releases survive the hop (the
                     # callee's ``self`` is the caller's ``self``); lease
                     # releases are global (reservation-id keyed on the
-                    # head), so they survive too
+                    # head) and checkpoint saves are self-keyed by
+                    # construction, so they survive too
                     cur.update(k for k in closure[callee]
-                               if k[0].startswith(("self.", "rpc:")))
+                               if k[0].startswith(("self.", "rpc:",
+                                                   "ckpt:")))
             if len(cur) != before:
                 changed = True
     return closure
@@ -183,6 +233,16 @@ def _collect_resources(graph: CallGraph, info: FunctionInfo,
         return False
 
     rid = 0
+    # checkpoint obligation: "acquired" at function ENTRY (the handler
+    # is about to mutate durable state), discharged only by reaching
+    # the save method (directly or via a self-callee). Seeded into the
+    # interpreter's initial state by _FnAnalysis.run.
+    ckpt = _ckpt_entry(info)
+    if ckpt is not None:
+        save, label = ckpt
+        out.append(Resource(rid, "ckpt", None, f"ckpt:{save}", save,
+                            label, info.node.lineno, id(info.node)))
+        rid += 1
     for node in _walk_no_nested(info.node):
         # ctor acquires: x = socket.socket(...) / open(...)
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -298,6 +358,10 @@ class _FnAnalysis:
                         # any client object discharges a lease: the
                         # reservation id, not the receiver, keys it
                         out.add(r.rid)
+                    elif r.kind == "ckpt" and verb == r.release_verb \
+                            and recv_d == "self":
+                        # ``self._save_state()``: obligation discharged
+                        out.add(r.rid)
                 # release-through-self-call (``self._drop(st)``)
                 callee, _vs = self.graph.resolve_call_cached(
                     node, self.info)
@@ -305,10 +369,12 @@ class _FnAnalysis:
                     rel = self.summaries.get(callee, ())
                     for r in self.resources:
                         if r.rid in state \
-                                and r.kind in ("pair", "ref", "lease") \
+                                and r.kind in ("pair", "ref", "lease",
+                                               "ckpt") \
                                 and r.recv_key is not None \
                                 and r.recv_key.startswith(("self.",
-                                                           "rpc:")) \
+                                                           "rpc:",
+                                                           "ckpt:")) \
                                 and (r.recv_key, r.release_verb) in rel:
                             out.add(r.rid)
             elif isinstance(node, ast.AugAssign) \
@@ -394,7 +460,11 @@ class _FnAnalysis:
     # ------------------------------------------------------ interpreter
 
     def run(self) -> Outcomes:
-        return self._block(list(self.info.node.body), frozenset())
+        # Checkpoint obligations are live from the first statement; all
+        # other resources enter the state at their acquire site.
+        entry = frozenset(r.rid for r in self.resources
+                          if r.kind == "ckpt")
+        return self._block(list(self.info.node.body), entry)
 
     def _block(self, stmts: List[ast.stmt], state: State) -> Outcomes:
         out: Outcomes = {k: set() for k in _EXITS}
@@ -642,6 +712,9 @@ def _candidate_fqns(graph: CallGraph) -> Set[str]:
         for node, info in graph.calls_by_tail.get(tail, ()):
             if _lease_rpc_name(node) in rules.RPC_LEASE_PAIRS:
                 cands.add(info.fqn)
+    for info in graph.functions.values():
+        if _ckpt_entry(info) is not None:
+            cands.add(info.fqn)
     return cands
 
 
@@ -674,6 +747,12 @@ def check(graph: CallGraph, emit_files=None) -> List[Finding]:
                     if by_rid[rid].kind in ("pair", "lease") \
                             and kind != "raise":
                         continue
+                    # checkpoint obligations are the INVERSE: normal
+                    # exits must have saved; an escaping exception is
+                    # exempt (the handler failed — there may be nothing
+                    # durable to record).
+                    if by_rid[rid].kind == "ckpt" and kind == "raise":
+                        continue
                     prev = leaks.get(rid)
                     if prev is None or ln < prev[1]:
                         label = {"fall": "fall-through",
@@ -685,6 +764,18 @@ def check(graph: CallGraph, emit_files=None) -> List[Finding]:
             if hit is None:
                 continue
             kind_label, ln = hit
+            if r.kind == "ckpt":
+                findings.append(Finding(
+                    rule=rules.CHECKPOINT_MISSING,
+                    path=info.file.relpath, line=r.line,
+                    symbol=info.qualname,
+                    message=f"{r.label}: this state-mutating handler "
+                            f"can exit via {kind_label} (line {ln}) "
+                            f"without reaching {r.release_verb}() — "
+                            f"the mutation is invisible to a restarted "
+                            f"controller (it would replay the previous "
+                            f"checkpoint)"))
+                continue
             findings.append(Finding(
                 rule=rules.RESOURCE_LEAK, path=info.file.relpath,
                 line=r.line, symbol=info.qualname,

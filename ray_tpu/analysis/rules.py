@@ -29,6 +29,7 @@ SHARDING_UNSCOPED = "sharding-unscoped-trace"
 RPC_STUB_DRIFT = "rpc-stub-drift"
 METRICS_COLLISION = "metrics-name-collision"
 METRICS_CARDINALITY = "metrics-label-cardinality"
+CHECKPOINT_MISSING = "checkpoint-missing-save"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -42,6 +43,7 @@ ALL_RULES = (
     SHARDING_UNPINNED, SHARDING_UNSCOPED,
     RPC_STUB_DRIFT,
     METRICS_COLLISION, METRICS_CARDINALITY,
+    CHECKPOINT_MISSING,
 )
 
 # The ten checker families, for ``--jobs`` scheduling and per-family
@@ -52,7 +54,7 @@ FAMILIES = {
     "lock-discipline": (LOCK_ORDER_CYCLE, LOCK_HELD_BLOCKING),
     "lifecycle-hygiene": (SWALLOWED_EXCEPTION, MISSING_FINALLY),
     "guarded-by": (UNGUARDED_FIELD,),
-    "lifetime": (RESOURCE_LEAK,),
+    "lifetime": (RESOURCE_LEAK, CHECKPOINT_MISSING),
     "rpc-contract": (RPC_UNKNOWN, RPC_ARITY, RPC_DEAD),
     "sharding-safety": (SHARDING_CONTRACTION, SHARDING_ANCHOR,
                         SHARDING_UNPINNED, SHARDING_UNSCOPED),
@@ -260,6 +262,24 @@ RPC_LEASE_PAIRS = {
 # The RPC verbs lease acquire/release ride on (client.call today;
 # notify releases would also discharge).
 RPC_LEASE_VERBS = ("call", "notify")
+
+# The CHECKPOINT idiom (the durable-controller twin of the lease
+# rule): a control-plane class whose state checkpoints through the
+# core KV must reach its save method on EVERY normal exit of its
+# state-mutating handlers — a handler that returns without saving
+# makes the mutation invisible to the restarted controller (a
+# controller death right after it silently reverts the op, orphaning
+# replicas / resurrecting deleted apps / losing queued releases).
+# class name -> (save method, handlers that must reach it). The save
+# may be reached through a self.-callee chain (shutdown -> delete ->
+# _save_state counts), resolved over the same summary fixpoint as
+# release-through-call. Escaping exceptions are exempt: the handler
+# failed, so there may be nothing durable to record.
+CHECKPOINT_CLASSES = {
+    "ServeController": ("_save_state",
+                        ("deploy", "delete", "set_route", "enable_http",
+                         "disable_http", "shutdown")),
+}
 
 # ------------------------------------------ v3: sharding/mesh safety
 

@@ -301,6 +301,40 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "metrics_series_dropped gauge reports the overflow — a runaway "
         "label-cardinality producer degrades visibly instead of growing "
         "every heartbeat-cadence RPC without bound."),
+    "faultinject_path": (str, "",
+        "Path of a JSON fault-rules file activating util/faultinject.py "
+        "injection points (kill-process, drop/delay/error a named RPC "
+        "endpoint, pause heartbeats, partition a peer). Empty (default) "
+        "disables every injection point at the cost of one attribute "
+        "read. Set via RAY_TPU_FAULTINJECT_PATH before ray_tpu.init so "
+        "worker processes inherit it; chaos tests drive faults by "
+        "editing the file (re-read on mtime change)."),
+    "rpc_reconnect_backoff_base_ms": (int, 50,
+        "First-retry pause of a ReconnectingClient after a transport "
+        "failure. Doubles per consecutive failure (with +/-50% jitter) "
+        "up to rpc_reconnect_backoff_cap_ms — the first retry stays "
+        "fast (a controller blip heals in ~one beat) while a DEAD "
+        "controller costs a capped trickle of dials instead of the "
+        "tight 0.2 s loop ray_tpu doctor flags as a reconnect storm."),
+    "rpc_reconnect_backoff_cap_ms": (int, 2000,
+        "Ceiling on the ReconnectingClient retry backoff. Bounds the "
+        "extra latency a client adds on top of controller recovery: "
+        "after the controller returns, the next retry lands within at "
+        "most this long (x1.5 jitter)."),
+    "serve_adopt_timeout_s": (float, 5.0,
+        "How long a restarted serve controller pings the replica/proxy "
+        "handles from its checkpoint before declaring the stragglers "
+        "dead. Alive replicas are ADOPTED (same actor, same sub-slice "
+        "reservation — no respawn, no cold prefill); dead ones are "
+        "replaced and their reservations queued for release. Bounds "
+        "control-plane MTTR: snapshots republish right after this "
+        "window at the latest."),
+    "serve_mttr_bound_s": (float, 30.0,
+        "Acceptance bound on serve control-plane MTTR: controller "
+        "death -> routing snapshots flowing again (epoch-bumped "
+        "republish observed by routers). The chaos suite and "
+        "bench_chaos.py assert/record against this; it is a TEST bound, "
+        "not a runtime knob — nothing throttles recovery to it."),
     "controller_metrics_http_port": (int, -1,
         "Port for the controller-side Prometheus /metrics HTTP endpoint "
         "(whole-cluster exposition text, series labeled by node/role/pid). "

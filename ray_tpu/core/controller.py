@@ -179,10 +179,11 @@ class Controller:
                 "kill_actor": self.kill_actor,
                 "kv_put": self.kv_put,
                 "kv_get": self.kv_get,
-                # KV namespace completeness: del/keys round out the API
-                # for external tooling (state CLI, tests); no in-package
-                # caller yet.
-                # graftlint: disable=rpc-dead-endpoint
+                "kv_put_fenced": self.kv_put_fenced,
+                "epoch_bump": self.epoch_bump,
+                # kv_del gained an in-package caller in PR 12
+                # (serve.shutdown drops the serve-controller
+                # checkpoint); kv_keys remains external-tooling-only.
                 "kv_del": self.kv_del,
                 # graftlint: disable=rpc-dead-endpoint
                 "kv_keys": self.kv_keys,
@@ -985,6 +986,42 @@ class Controller:
     def kv_del(self, key: str) -> bool:
         with self._lock:
             return self._kv.pop(key, None) is not None
+
+    # Epoch leases: named monotonic counters living IN the KV (so they
+    # persist with it and a replacement head keeps fencing honest).
+    # ``epoch_bump`` is the lease acquisition a process takes when it
+    # claims a singleton role (the serve controller on every (re)start);
+    # ``kv_put_fenced`` is the write path that role's state goes through
+    # — a writer whose epoch is no longer the newest is a ZOMBIE (its
+    # replacement already bumped) and its write is rejected, not applied
+    # (reference: GCS leader fencing; Serve's controller checkpoint has
+    # exactly one legitimate writer at a time).
+
+    @staticmethod
+    def _epoch_key(name: str) -> str:
+        return f"__epoch__:{name}"
+
+    def epoch_bump(self, name: str) -> int:
+        """Atomically increment and return the named epoch counter."""
+        key = self._epoch_key(name)
+        with self._lock:
+            epoch = int(self._kv.get(key, b"0")) + 1
+            self._kv[key] = str(epoch).encode()
+        self.pubsub.publish("kv", key, None)
+        return epoch
+
+    def kv_put_fenced(self, key: str, value: bytes, epoch: int,
+                      epoch_name: str) -> bool:
+        """``kv_put`` gated on ``epoch`` still being the NEWEST bump of
+        ``epoch_name``: returns False (no write) for a stale writer —
+        the signal to self-fence and stop mutating."""
+        with self._lock:
+            current = int(self._kv.get(self._epoch_key(epoch_name), b"0"))
+            if epoch < current:
+                return False
+            self._kv[key] = value
+        self.pubsub.publish("kv", key, None)
+        return True
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         with self._lock:

@@ -1031,6 +1031,13 @@ class Node:
         seq = 0
         while not self._stopped.wait(config.heartbeat_period_s):
             try:
+                if config.faultinject_path:
+                    # Chaos: a delay rule here PAUSES this node's beats
+                    # (the controller declares it dead past the health
+                    # threshold); an error rule drops individual beats.
+                    from ray_tpu.util import faultinject
+
+                    faultinject.check("node.heartbeat")
                 with self._lock:
                     available = dict(self._available)
                     queue_len = self._queue_len

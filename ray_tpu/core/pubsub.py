@@ -33,6 +33,12 @@ class Pubsub:
         # (channel, key) -> monotonic publish time of the CURRENT version
         # (publish -> deliver latency; guarded by _cond).
         self._pub_ts: Dict[Tuple[str, str], float] = {}
+        # (channel, key) -> highest publisher epoch seen (guarded by
+        # _cond). Keys published WITH an epoch are fenced: a later
+        # publish carrying a lower epoch is rejected — the zombie-old-
+        # controller write the serve plane's restart protocol must
+        # exclude (reference: GCS leader fencing via Redis epochs).
+        self._pub_epochs: Dict[Tuple[str, str], int] = {}
 
     @staticmethod
     def _instrumented() -> bool:
@@ -54,13 +60,26 @@ class Pubsub:
             cm.PSUB_DELIVER_S.observe(time.monotonic() - pub_ts, tags)
 
     def publish(self, channel: str, key: str, value: Any,
-                min_version: int = 0) -> int:
+                min_version: int = 0,
+                epoch: Optional[int] = None) -> Optional[int]:
         """``min_version`` lets a publisher keep its subscribers' version
         clocks monotonic across a HUB restart (head FT): a fresh hub would
         restart at 1, below what long-pollers already saw, stranding them —
-        the publisher passes the floor it knows it reached before."""
+        the publisher passes the floor it knows it reached before.
+
+        ``epoch`` opts the key into publisher FENCING: the hub remembers
+        the highest epoch that published it, and a publish carrying a
+        LOWER epoch returns None without writing — a deposed serve
+        controller (its replacement bumped the epoch) cannot clobber the
+        live snapshot, however late its write arrives. Epoch-less
+        publishes on the same key stay unfenced (back-compat)."""
         instrumented = self._instrumented()
         with self._cond:
+            if epoch is not None:
+                cur_epoch = self._pub_epochs.get((channel, key), 0)
+                if epoch < cur_epoch:
+                    return None  # fenced: a newer publisher owns the key
+                self._pub_epochs[(channel, key)] = epoch
             version = max(self._state.get((channel, key), (0, None))[0] + 1,
                           min_version)
             self._state[(channel, key)] = (version, value)
@@ -77,6 +96,7 @@ class Pubsub:
         with self._cond:
             self._state.pop((channel, key), None)
             self._pub_ts.pop((channel, key), None)
+            self._pub_epochs.pop((channel, key), None)
 
     def poll(self, channel: str, key: str, last_version: int = 0,
              timeout: float = 30.0) -> Optional[Tuple[int, Any]]:

@@ -48,6 +48,7 @@ import cloudpickle
 
 from ray_tpu.core.config import config
 from ray_tpu.core import coremetrics as cm
+from ray_tpu.util import faultinject
 from ray_tpu.util import metrics as um
 
 Addr = Tuple[str, int]
@@ -582,9 +583,20 @@ class RpcServer:
     def _handle(self, st: "_Conn", msg) -> None:
         req_id = msg.get("id")
         try:
+            if config.faultinject_path:
+                # Named-endpoint fault injection (chaos tests only; the
+                # flag gate keeps the hot path one attribute read). A
+                # delay rule here CAN stall the reactor for inline
+                # methods — deliberately: that's how a test simulates a
+                # wedged control plane.
+                # graftlint: disable=reactor-blocking-call
+                faultinject.check(
+                    f"rpc.server.{self._name}.{msg.get('method')}")
             handler = self._handlers[msg["method"]]
             result = handler(*msg.get("args", ()), **msg.get("kwargs", {}))
             reply = {"id": req_id, "ok": True, "result": result}
+        except faultinject.FaultDropped:
+            return  # injected lost reply: the caller's timeout governs
         except BaseException as e:  # noqa: BLE001 — errors must reach the caller
             reply = {"id": req_id, "ok": False, "error": e}
         if req_id is None:
@@ -830,6 +842,11 @@ class RpcClient:
             self._reader.start()
 
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        if config.faultinject_path:
+            # Client-side endpoint faults: error = typed failure the
+            # caller handles (NOT retried by ReconnectingClient), drop =
+            # torn-connection semantics (retried/reconnected).
+            faultinject.check(f"rpc.client.{method}")
         self._ensure_open()
         with self._id_lock:
             self._next_id += 1
@@ -960,6 +977,10 @@ class _PendingCall:
 
 def _connect(addr: Addr, timeout: Optional[float],
              role: str = "peer") -> socket.socket:
+    if config.faultinject_path:
+        # Partition injection: an error/drop rule on this peer's address
+        # makes every dial from this process fail — a one-way partition.
+        faultinject.check(f"rpc.dial.{addr[0]}:{addr[1]}")
     retries = config.rpc_connect_retries
     instrumented = config.core_metrics_enabled
     deadline = None if timeout is None else time.monotonic() + timeout
@@ -1018,6 +1039,22 @@ class ReconnectingClient:
         self._lock = threading.Lock()
         self._closed = False
 
+    @staticmethod
+    def _backoff_s(attempt: int) -> float:
+        """Retry pause for the ``attempt``-th consecutive transport
+        failure: base * 2^attempt, capped, with +/-50% jitter. The first
+        retry stays FAST (base default 50 ms — a controller blip heals
+        within one beat) while a dead controller decays to a capped
+        trickle instead of the flat 0.2 s loop every client in the
+        fleet used to synchronize on — that tight loop IS the
+        reconnect-storm signature ``ray_tpu doctor`` flags, and the
+        clients were its biggest in-tree source."""
+        import random
+
+        base = config.rpc_reconnect_backoff_base_ms / 1e3
+        cap = config.rpc_reconnect_backoff_cap_ms / 1e3
+        return min(cap, base * (2 ** attempt)) * (0.5 + random.random())
+
     def _get(self) -> RpcClient:
         with self._lock:
             if self._closed:
@@ -1048,6 +1085,7 @@ class ReconnectingClient:
     def call(self, method: str, *args, timeout: Optional[float] = None,
              **kwargs):
         deadline = time.monotonic() + self._window
+        attempt = 0
         while True:
             try:
                 return self._get().call(method, *args, timeout=timeout,
@@ -1061,14 +1099,19 @@ class ReconnectingClient:
                 raise
             except (RpcError, ConnectionError, OSError):
                 # Unlocked read: the worst a stale value costs is one
-                # extra 0.2 s retry against a just-closed handle, and
+                # extra jittered retry against a just-closed handle, and
                 # _get() re-checks _closed under _lock before dialing.
                 # graftlint: disable=unguarded-field-access
                 if self._closed or time.monotonic() > deadline:
                     raise
                 if config.core_metrics_enabled:
                     cm.RPC_RECONNECT_RETRIES.inc(1.0, {"role": self._role})
-                time.sleep(0.2)
+                # Jittered exponential backoff between re-dials: fast
+                # first retry, capped decay against a dead peer, and
+                # the jitter de-synchronizes a fleet of clients that
+                # all lost the same controller at the same instant.
+                time.sleep(self._backoff_s(attempt))
+                attempt += 1
 
     def notify(self, method: str, *args, **kwargs) -> None:
         """Best-effort one-way send (no retry: notifications are periodic)."""

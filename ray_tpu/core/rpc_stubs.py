@@ -98,6 +98,9 @@ class ControllerStub(_StubBase):
         return self._call('create_placement_group', pg_id_bytes, bundles,
                           strategy, timeout=timeout)
 
+    def epoch_bump(self, name, *, timeout=_UNSET):
+        return self._call('epoch_bump', name, timeout=timeout)
+
     def finish_job(self, job_id, state=_UNSET, *, timeout=_UNSET):
         return self._call('finish_job', job_id, state=state, timeout=timeout)
 
@@ -131,6 +134,10 @@ class ControllerStub(_StubBase):
 
     def kv_put(self, key, value, overwrite=_UNSET, *, timeout=_UNSET):
         return self._call('kv_put', key, value, overwrite=overwrite,
+                          timeout=timeout)
+
+    def kv_put_fenced(self, key, value, epoch, epoch_name, *, timeout=_UNSET):
+        return self._call('kv_put_fenced', key, value, epoch, epoch_name,
                           timeout=timeout)
 
     def list_actors(self, *, timeout=_UNSET):
@@ -172,10 +179,11 @@ class ControllerStub(_StubBase):
     def psub_poll_many(self, *args, timeout=_UNSET, **kwargs):
         return self._call('psub_poll_many', *args, timeout=timeout, **kwargs)
 
-    def psub_publish(self, channel, key, value, min_version=_UNSET, *,
-                     timeout=_UNSET):
+    def psub_publish(self, channel, key, value, min_version=_UNSET,
+                     epoch=_UNSET, *, timeout=_UNSET):
         return self._call('psub_publish', channel, key, value,
-                          min_version=min_version, timeout=timeout)
+                          min_version=min_version, epoch=epoch,
+                          timeout=timeout)
 
     def psub_snapshot(self, channel, *, timeout=_UNSET):
         return self._call('psub_snapshot', channel, timeout=timeout)

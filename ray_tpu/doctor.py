@@ -22,6 +22,15 @@ historically became hangs:
 * **heartbeat-rtt-outlier** — one node's control-plane RTT is far off
   the fleet median (overloaded host or sick link; next stop:
   ``ray_tpu stacks`` / ``ray_tpu profile`` on that node).
+* **controller-flapping** — the serve controller epoch gauge advanced
+  >= 2 bumps inside the window: every bump is a controller death +
+  restart-with-adoption cycle, so repeated bumps mean the control
+  plane is crash-looping (routing rides cached snapshots meanwhile).
+* **orphan-replica** — a serve replica's owner-epoch series is alive
+  with NO owning controller epoch (no controller series at all, or the
+  replica's epoch persistently below the live controller's): the
+  replica serves traffic nobody reconciles — it will never be healed,
+  autoscaled, or drained.
 
 ``diagnose`` is a pure function over snapshots so tests inject each
 fault into the REAL components and assert the doctor names it; the CLI
@@ -46,6 +55,7 @@ DEFAULT_THRESHOLDS = {
     "ref_growth": 100,             # live handles gained over the window
     "rtt_outlier_floor_s": 0.25,   # never flag RTTs below this
     "rtt_outlier_factor": 5.0,     # x fleet median p99
+    "epoch_bumps": 2,              # controller epoch bumps in the window
 }
 
 
@@ -57,6 +67,25 @@ def _per_source(aggregated, name: str, kind: str) -> Dict[str, float]:
             if m.get("name") == name and m.get("kind") == kind:
                 out[source] = out.get(source, 0.0) + m.get("value", 0.0)
     return out
+
+
+def _gauge_series(aggregated, name: str):
+    """Yield (source, tags dict, value) for every gauge series named
+    ``name`` across sources (no folding — the serve epoch checks need
+    per-series values, not sums)."""
+    for source, metrics in aggregated.items():
+        for m in metrics:
+            if m.get("name") == name and m.get("kind") == "gauge":
+                yield source, dict(m.get("tags", {})), m.get("value", 0.0)
+
+
+def _max_controller_epoch(aggregated) -> Optional[float]:
+    """The OWNING serve-controller epoch in a snapshot: the max across
+    sources (a dead controller's last push lingers until node death, so
+    old-epoch series coexist with the live one — only the max owns)."""
+    vals = [v for _s, _t, v in _gauge_series(aggregated,
+                                             "serve_controller_epoch")]
+    return max(vals) if vals else None
 
 
 def _attribution(source: str, nodes: Optional[List[Dict[str, Any]]]
@@ -222,6 +251,67 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                                "not set the fleet's lease latency)"),
                 })
 
+    # ------------------------------------------- controller-flapping
+    ep_before = _max_controller_epoch(before)
+    ep_after = _max_controller_epoch(after)
+    if (ep_before is not None and ep_after is not None
+            and ep_after - ep_before >= th["epoch_bumps"]):
+        bumps = int(ep_after - ep_before)
+        findings.append({
+            "signature": "controller-flapping", "severity": "critical",
+            "source": "serve-controller",
+            "summary": (f"serve controller epoch advanced {bumps} times "
+                        f"in {interval_s:.0f}s (now epoch "
+                        f"{int(ep_after)}) — the controller is "
+                        f"crash-looping; each bump is a death + "
+                        f"restart-with-adoption cycle, and routing is "
+                        f"riding cached snapshots between them"),
+            "evidence": {"epoch_before": ep_before,
+                         "epoch_after": ep_after},
+            "remedy": ("read the controller worker's log for the crash "
+                       "cause (`ray_tpu logs`); check whether a fault "
+                       "rule / OOM kill / bad deployment config fires "
+                       "on every restart path"),
+        })
+
+    # ---------------------------------------------- orphan-replica
+    # A replica series whose owner epoch has no live controller epoch,
+    # in BOTH snapshots: transient adoption lag (the restarted
+    # controller re-pushes epochs within its adopt window) never
+    # persists across a doctor interval; an orphan does.
+    rep_before = {(s, t.get("deployment", "-")): v
+                  for s, t, v in _gauge_series(before,
+                                               "serve_replica_epoch")}
+    for source, tags, val in _gauge_series(after, "serve_replica_epoch"):
+        dep = tags.get("deployment", "-")
+        prev = rep_before.get((source, dep))
+        if prev is None:
+            continue  # not persistent across the window
+        orphan_now = ep_after is None or val < ep_after
+        orphan_then = ep_before is None or prev < ep_before
+        if orphan_now and orphan_then:
+            owner = ("no controller epoch series exists"
+                     if ep_after is None else
+                     f"the live controller epoch is {int(ep_after)}")
+            findings.append({
+                "signature": "orphan-replica", "severity": "warning",
+                "source": source,
+                "summary": (f"{_attribution(source, nodes)} serves "
+                            f"deployment {dep!r} owned by controller "
+                            f"epoch {int(val)}, but {owner} — no "
+                            f"controller reconciles this replica (it "
+                            f"will never be healed, autoscaled, or "
+                            f"drained)"),
+                "evidence": {"replica_epoch": val,
+                             "controller_epoch": ep_after,
+                             "deployment": dep},
+                "remedy": ("if the serve controller is down, restart "
+                           "it (it adopts live replicas from its "
+                           "checkpoint); if it is up, this replica "
+                           "escaped its checkpoint — kill the replica "
+                           "actor and let reconcile respawn it"),
+            })
+
     order = {"critical": 0, "warning": 1}
     findings.sort(key=lambda f: (order.get(f["severity"], 9),
                                  f["signature"], f["source"]))
@@ -243,7 +333,8 @@ def render(findings: List[Dict[str, Any]]) -> str:
     if not findings:
         return ("no failure signatures detected (checked: "
                 "rpc-backpressure, reconnect-storm, pubsub-lag, "
-                "ref-leak, heartbeat-rtt-outlier)")
+                "ref-leak, heartbeat-rtt-outlier, controller-flapping, "
+                "orphan-replica)")
     lines = [f"{len(findings)} finding(s):", ""]
     for i, f in enumerate(findings, 1):
         lines.append(f"[{i}] {f['severity'].upper()} {f['signature']} "

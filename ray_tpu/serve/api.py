@@ -50,6 +50,41 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
 
+def _controller_alive(handle) -> bool:
+    """Cheap actor-table read: is the serve controller's record ALIVE
+    right now? (RESTARTING/DEAD callers should degrade immediately
+    instead of parking a blocking call against the restart.)"""
+    try:
+        from ray_tpu.core.rpc_stubs import ControllerStub
+        from ray_tpu.core.runtime import get_core_worker
+
+        rec = ControllerStub(get_core_worker().controller).get_actor(
+            handle.actor_id.binary(), timeout=5.0)
+        return rec is not None and rec["state"] == "ALIVE"
+    except Exception:
+        return False
+
+
+def _degraded_status() -> Dict[str, Any]:
+    """The cached view this process's routers hold: what ``status``
+    degrades to while the serve controller is down or restarting. Every
+    entry carries ``degraded: True`` so callers can tell a cached
+    replica count from a reconciled one."""
+    from ray_tpu.serve.deployment import _Router
+
+    with _Router._instances_lock:
+        routers = dict(_Router._instances)
+    out: Dict[str, Any] = {}
+    for name, router in routers.items():
+        with router._lock:
+            out[name] = {
+                "replicas": len(router._replicas),
+                "replica_ids": [r["id"] for r in router._replicas],
+                "degraded": True,
+            }
+    return out
+
+
 def status(timeout: float = 30.0, include_slo: bool = True
            ) -> Dict[str, Any]:
     """Per-deployment control-plane state, plus (``include_slo``) the
@@ -58,9 +93,37 @@ def status(timeout: float = 30.0, include_slo: bool = True
     histogram summaries (count, mean, p50, p99) and outcome counters —
     the same numbers the dashboard serve panel and the proxy's
     ``/metrics`` route report, because all three read the controller's
-    aggregated registry through ``serve.metrics.slo_summary``."""
-    controller = get_or_create_controller()
-    st = ray_tpu.get(controller.status.remote(), timeout=timeout)
+    aggregated registry through ``serve.metrics.slo_summary``.
+
+    FAILS SOFT during a controller outage: when the controller actor is
+    dead or restarting, the call returns this process's cached routing
+    view (entries marked ``degraded: True``) instead of raising — the
+    observing path must not be the thing that breaks first during the
+    exact failure it is observing. The failed probe doubles as the
+    failure report that triggers the controller's restart."""
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    try:
+        # Lookup, not get_or_create: a status probe must neither SPAWN
+        # a control plane nor block a long ping against a restarting
+        # one — the degraded view answers immediately either way.
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        if not _controller_alive(controller):
+            return _degraded_status()  # mid-restart: don't park on it
+        try:
+            st = ray_tpu.get(controller.status.remote(), timeout=timeout)
+        except Exception:
+            # The failed call doubles as the failure report that starts
+            # the controller's restart. Retry once on the same handle
+            # ONLY if the record is still ALIVE — that's the
+            # fresh-handle-to-restarted-actor case (stale incarnation
+            # hint, the failure taught the handle the live one); a
+            # record now RESTARTING means a real outage: degrade.
+            if not _controller_alive(controller):
+                return _degraded_status()
+            st = ray_tpu.get(controller.status.remote(), timeout=timeout)
+    except Exception:
+        return _degraded_status()
     if include_slo:
         try:
             from ray_tpu.core.runtime import get_core_worker
@@ -131,6 +194,20 @@ def shutdown(drain_timeout_s: float = 10.0) -> None:
                 ray_tpu.kill(controller)
             except Exception:  # graftlint: disable=swallowed-exception (best-effort serve teardown)
                 pass
+        # Drop the durable checkpoint too: shutdown is the ONE
+        # controller death that must not be survived — a controller
+        # created later (next serve.run) starts fresh instead of
+        # adopting the ghosts of the plane we just tore down. (The
+        # graceful path already checkpointed empty state; this covers
+        # the timed-out/killed path.)
+        try:
+            from ray_tpu.core.rpc_stubs import ControllerStub
+            from ray_tpu.core.runtime import get_core_worker
+            from ray_tpu.serve.controller import STATE_KEY
+
+            ControllerStub(get_core_worker().controller).kv_del(STATE_KEY)
+        except Exception:  # graftlint: disable=swallowed-exception (best-effort serve teardown)
+            pass
     _Router.reset_all()
 
 

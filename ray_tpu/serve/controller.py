@@ -20,13 +20,22 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.config import config
 from ray_tpu.core.rpc_stubs import ControllerStub
+from ray_tpu.util import faultinject
 from ray_tpu.util.ratelimit import log_every
 
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_ray_tpu_serve_controller"
 SNAPSHOT_CHANNEL = "serve_routes"
+# Control-plane FT (mirrors core/controller.py save_state/_restore_state,
+# through the core KV instead of a file): every mutating op checkpoints
+# under STATE_KEY, fenced by the EPOCH_NAME epoch lease — a restarted
+# controller bumps the epoch, restores the checkpoint, and ADOPTS the
+# replicas that survived; a deposed zombie's writes are rejected.
+STATE_KEY = "serve:controller:state"
+EPOCH_NAME = "serve_controller"
 
 
 class ReplicaRecord:
@@ -72,6 +81,7 @@ class ServeController:
     """Runs as a named actor; all methods are invoked via actor calls."""
 
     def __init__(self):
+        faultinject.check("serve.controller.init")
         self._deployments: Dict[str, DeploymentRecord] = {}
         self._last_models: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}  # HTTP route prefix -> app name
@@ -82,10 +92,26 @@ class ServeController:
         # Sub-slice reservation ids whose release RPC failed (head
         # briefly unreachable): retried every reconcile tick — a
         # silently dropped release would strand the chips until the
-        # hosting node dies. Guarded by _lock.
+        # hosting node dies. Guarded by _lock; PERSISTED in the
+        # checkpoint (a controller death with a queued release must not
+        # leak the chips until node death).
         self._pending_releases: List[str] = []
         self._lock = threading.Lock()
+        # Serializes checkpoint writers (a slow save interleaving with a
+        # fresh one would let the stale snapshot win the KV write).
+        self._save_mutex = threading.Lock()
         self._stop = threading.Event()
+        # Epoch lease (reference: GCS leader fencing): bumped on every
+        # controller (re)start, stamped into every snapshot, replica
+        # assignment, and fenced KV write. 0 = not yet acquired (head
+        # unreachable at start; the reconcile loop keeps trying).
+        self._epoch = 0
+        self._fenced = False
+        self._acquire_epoch()
+        # Rebuild from the last checkpoint BEFORE the reconcile threads
+        # start: adoption must finish deciding which replicas live so
+        # the first reconcile tick heals instead of double-spawning.
+        self._restore_state()
         from ray_tpu.util import metrics as um
 
         um.add_collector(self._collect_metrics)
@@ -98,6 +124,224 @@ class ServeController:
             target=self._proxy_loop, name="serve-proxy-reconcile",
             daemon=True)
         self._proxy_reconciler.start()
+        # Record the adoption outcome under the new epoch immediately:
+        # dying again before the first mutation must not replay the
+        # previous incarnation's view of the world.
+        self._save_state()
+
+    # ------------------------------------------------- durable state (FT)
+
+    def _acquire_epoch(self) -> None:
+        from ray_tpu.core.runtime import get_core_worker
+
+        try:
+            self._epoch = ControllerStub(
+                get_core_worker().controller).epoch_bump(EPOCH_NAME)
+        except Exception:
+            # Head unreachable at start: run epoch-less for now —
+            # publishes go out unfenced and checkpoints are skipped —
+            # and the reconcile loop keeps retrying the lease.
+            log_every("serve.epoch", 10.0, logger,
+                      "serve controller epoch lease unavailable; "
+                      "running unfenced until the head answers",
+                      exc_info=True)
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """Copy every durable field. rec.replicas is read WITHOUT
+        rec.lock on purpose: _save_state runs on paths that already
+        hold rec.lock (_add_replica's spawn-failure release under
+        _settle), so taking it here would be a lock-order cycle with
+        _save_mutex (graftlint caught exactly that). The GIL makes the
+        ``list(...)`` copy coherent; a snapshot racing a replica
+        append/remove just records the neighboring state, and the
+        mutating path's own save (deploy/reconcile both end with one)
+        supersedes it within the same tick — same discipline as
+        ``status()``'s lock-free replica reads."""
+        with self._lock:
+            recs = list(self._deployments.values())
+            state: Dict[str, Any] = {
+                "epoch": self._epoch,
+                "routes": dict(self._routes),
+                "http_cfg": (dict(self._http_cfg)
+                             if self._http_cfg else None),
+                "proxies": {
+                    n: {"actor_id": p.handle.actor_id.binary(),
+                        "addr": tuple(p.addr) if p.addr else None}
+                    for n, p in self._proxies.items()},
+                "pending_releases": list(self._pending_releases),
+            }
+        deployments = []
+        for rec in recs:
+            replicas = [
+                {"replica_id": r.replica_id,
+                 "actor_id": r.handle.actor_id.binary(),
+                 "sub_slice": (dict(r.sub_slice)
+                               if r.sub_slice else None)}
+                for r in list(rec.replicas)]
+            deployments.append({
+                "name": rec.name, "cls_blob": rec.cls_blob,
+                "init_args": rec.init_args,
+                "init_kwargs": rec.init_kwargs, "cfg": rec.cfg,
+                "next_replica_ord": rec.next_replica_ord,
+                "pub_version": rec.pub_version,
+                "deleting": rec.deleting, "replicas": replicas})
+        state["deployments"] = deployments
+        return state
+
+    def _save_state(self) -> None:
+        """Checkpoint the control plane through the core KV, fenced by
+        the epoch lease. Every state-mutating handler must reach this
+        before returning (graftlint: checkpoint-missing-save); the
+        reconcile/proxy loops save when their pass changed anything. A
+        False from the fenced write means a newer epoch exists — this
+        instance is a zombie and ceases all mutation."""
+        faultinject.check("serve.controller.save_state")
+        if self._fenced or self._epoch <= 0:
+            return
+        import pickle
+
+        from ray_tpu.core.runtime import get_core_worker
+
+        with self._save_mutex:
+            blob = pickle.dumps(self._snapshot_state())
+            try:
+                # graftlint: disable=lock-held-blocking
+                # _save_mutex exists precisely to serialize this RPC
+                # with concurrent snapshots: an unserialized slow save
+                # would let a STALE snapshot overwrite a fresher one.
+                # Nothing else ever takes _save_mutex.
+                ok = ControllerStub(
+                    get_core_worker().controller).kv_put_fenced(
+                        STATE_KEY, blob, self._epoch, EPOCH_NAME)
+            except Exception:
+                # Head blip: state is stale until the next mutation or
+                # reconcile-tick change saves again. Never silent —
+                # degraded fault tolerance is an operator concern.
+                log_every("serve.save_state", 10.0, logger,
+                          "serve controller checkpoint failed; restart "
+                          "would replay the previous checkpoint",
+                          exc_info=True)
+                return
+        if not ok:
+            self._fence("the checkpoint KV rejected this epoch's write")
+
+    def _fence(self, why: str) -> None:
+        """A newer controller epoch exists: this instance is a zombie
+        (its replacement already restored and owns the plane). Cease
+        every mutation — but do NOT drain: the replicas now belong to
+        the successor, and killing them from here would be exactly the
+        split-brain damage fencing exists to prevent."""
+        if self._fenced:
+            return
+        self._fenced = True
+        self._stop.set()
+        logger.warning(
+            "serve controller epoch %s fenced (%s): ceasing mutation; "
+            "the successor controller owns the serve plane", self._epoch,
+            why)
+
+    def _restore_state(self) -> None:
+        """Rebuild from the last checkpoint and ADOPT surviving actors.
+
+        Replicas are pinged (concurrently, one shared deadline): the
+        live ones keep their actor AND their sub-slice reservation —
+        the topology view outlived the controller, so re-reserving
+        would double-book chips, and respawning would re-pay prefill
+        and weight loading for no reason. Only the dead are replaced
+        (their reservations queue for release), mid-delete deployments
+        finish draining, and every snapshot republishes under the new
+        epoch with its persisted version floor so router clocks stay
+        monotonic."""
+        faultinject.check("serve.controller.restore")
+        import pickle
+
+        from ray_tpu.core.runtime import get_core_worker
+
+        try:
+            blob = ControllerStub(
+                get_core_worker().controller).kv_get(STATE_KEY)
+        except Exception:
+            log_every("serve.restore", 10.0, logger,
+                      "serve controller checkpoint unreadable (head "
+                      "unreachable); starting empty", exc_info=True)
+            return
+        if not blob:
+            return
+        try:
+            state = pickle.loads(blob)
+        except Exception:
+            # A corrupt checkpoint must not brick the replacement
+            # controller: starting empty (deployments re-run) beats not
+            # starting (routing stalls forever).
+            logger.warning("serve controller checkpoint corrupt; "
+                           "starting empty", exc_info=True)
+            return
+        from ray_tpu.core.actor import ActorHandle
+        from ray_tpu.core.ids import ActorID
+
+        self._routes = dict(state.get("routes") or {})
+        self._http_cfg = state.get("http_cfg")
+        self._pending_releases = list(state.get("pending_releases") or [])
+        for node_hex, p in (state.get("proxies") or {}).items():
+            proxy = ProxyRecord(node_hex,
+                                ActorHandle(ActorID(p["actor_id"])))
+            proxy.addr = tuple(p["addr"]) if p.get("addr") else None
+            # Adopted as-is: the proxy loop health-checks at 1 Hz and
+            # replaces the dead, exactly as for any hung proxy.
+            self._proxies[node_hex] = proxy
+        pings = []
+        for d in state.get("deployments") or []:
+            rec = DeploymentRecord(d["name"], d["cls_blob"],
+                                   d["init_args"], d["init_kwargs"],
+                                   d["cfg"])
+            rec.next_replica_ord = d["next_replica_ord"]
+            rec.pub_version = d["pub_version"]
+            rec.deleting = bool(d.get("deleting"))
+            self._deployments[d["name"]] = rec
+            for r in d.get("replicas") or []:
+                handle = ActorHandle(ActorID(r["actor_id"]))
+                # Fire all pings first; gather below on one deadline.
+                pings.append((rec, r, handle, handle.ping.remote()))
+        adopted = dead = 0
+        deadline = time.monotonic() + config.serve_adopt_timeout_s
+        for rec, r, handle, ref in pings:
+            try:
+                ray_tpu.get(ref, timeout=max(0.2,
+                                             deadline - time.monotonic()))
+            except Exception:
+                dead += 1
+                sub = r.get("sub_slice")
+                if sub:
+                    # The dead replica's reservation releases through
+                    # the normal retry queue (idempotent on the head).
+                    self._pending_releases.append(sub["reservation_id"])
+                continue
+            rec.replicas.append(
+                ReplicaRecord(handle, r["replica_id"], r.get("sub_slice")))
+            adopted += 1
+            try:
+                # Adoption handshake: the replica now reports THIS
+                # epoch as its owner (doctor's orphan-replica gauge).
+                handle.set_owner_epoch.remote(self._epoch)
+            except Exception:
+                log_every("serve.adopt_epoch", 10.0, logger,
+                          "epoch push to adopted replica %s failed",
+                          r["replica_id"], exc_info=True)
+        # Deployments the old controller died mid-delete: finish them.
+        for rec in [r for r in self._deployments.values() if r.deleting]:
+            self._drain(rec)
+            del self._deployments[rec.name]
+            self._publish(rec)
+        # Routing resumes here: epoch-stamped snapshots above the
+        # persisted version floor (MTTR clock stops on this publish).
+        for rec in self._deployments.values():
+            self._publish(rec)
+        if pings or self._pending_releases:
+            logger.info(
+                "serve controller epoch %s restored: %d replica(s) "
+                "adopted in place, %d dead queued for replacement, %d "
+                "pending sub-slice release(s) resumed", self._epoch,
+                adopted, dead, len(self._pending_releases))
 
     # ------------------------------------------------------------ deploy
 
@@ -111,6 +355,7 @@ class ServeController:
         under the same lock — otherwise a reconcile tick that snapshotted
         the old record could resurrect old-class replicas and publish them
         over the live name."""
+        faultinject.check("serve.controller.deploy")
         with self._lock:
             old = self._deployments.get(name)
             rec = DeploymentRecord(name, cls_blob, init_args, init_kwargs,
@@ -141,7 +386,9 @@ class ServeController:
         # node's timeout (graftlint: lock-held-blocking).
         for replica in doomed:
             self._kill_replica(replica)
-        return self._publish(rec)
+        version = self._publish(rec)
+        self._save_state()
+        return version
 
     def _target_replicas(self, rec: DeploymentRecord) -> int:
         auto = rec.cfg.get("autoscaling")
@@ -237,7 +484,7 @@ class ServeController:
                     init_kwargs["mesh_shape"] = tuple(mesh_shape)
             handle = actor_cls.options(**opts).remote(
                 rec.cls_blob, rec.init_args, init_kwargs,
-                replica_id=replica_id)
+                replica_id=replica_id, owner_epoch=self._epoch)
         except Exception:
             if sub is not None:
                 self._release_reservation(sub["reservation_id"],
@@ -301,37 +548,57 @@ class ServeController:
                       "releasing sub-slice %s of replica %s failed; "
                       "queued for retry", reservation_id, owner,
                       exc_info=True)
+            # Checkpoint the queued release IMMEDIATELY: a controller
+            # death between here and the retry must not leak the chips
+            # until node death (the restarted controller resumes the
+            # queue from the checkpoint).
+            self._save_state()
 
     def _collect_metrics(self) -> None:
-        """Snapshot-time gauge: pending sub-slice release depth (failed
-        release RPCs are stranded chips until the retry succeeds)."""
+        """Snapshot-time gauges: pending sub-slice release depth (failed
+        release RPCs are stranded chips until the retry succeeds) and
+        the controller epoch (the doctor's controller-flapping /
+        orphan-replica input)."""
         from ray_tpu.serve import metrics as smetrics
 
         with self._lock:
             depth = len(self._pending_releases)
         smetrics.PENDING_RELEASES.set(float(depth))
+        if self._epoch > 0:
+            smetrics.CONTROLLER_EPOCH.set(float(self._epoch))
 
     def _retry_pending_releases(self) -> None:
         """Reconcile-tick retry of release RPCs that failed (head
         blip): idempotent on the controller, so replaying an id that
-        already released is harmless."""
+        already released is harmless — including one the previous
+        controller incarnation managed to release before dying."""
         with self._lock:
             if not self._pending_releases:
                 return
+        # Chaos hook BEFORE the queue is popped: a die/error rule here
+        # kills the controller mid-release-retry with the queue intact.
+        faultinject.check("serve.controller.retry_pending_releases")
+        with self._lock:
             pending = self._pending_releases
             self._pending_releases = []
         from ray_tpu.core.runtime import get_core_worker
 
+        released = 0
         for rid in pending:
             try:
                 ControllerStub(get_core_worker().controller) \
                     .release_subslice(rid)
+                released += 1
             except Exception:
                 with self._lock:
                     self._pending_releases.append(rid)
                 log_every("serve.release_retry", 10.0, logger,
                           "retrying sub-slice release %s failed", rid,
                           exc_info=True)
+        if released:
+            # The drained ids must leave the checkpoint too: a restart
+            # replaying them is harmless (idempotent) but noisy.
+            self._save_state()
 
     def _drain(self, rec: DeploymentRecord) -> None:
         while rec.replicas:
@@ -341,10 +608,19 @@ class ServeController:
         """Push the routing snapshot (replica actor ids + model residency)
         to subscribers through the cluster pubsub (LongPollHost shape).
         Returns the published version so deploy() callers can wait for
-        their own snapshot to reach their router."""
+        their own snapshot to reach their router.
+
+        Snapshots are EPOCH-STAMPED and the hub fences them: a deposed
+        zombie controller's publish is rejected server-side (and this
+        instance self-fences on the rejection), and routers additionally
+        ignore any snapshot whose epoch regresses below one they've
+        applied."""
         from ray_tpu.core.runtime import get_core_worker
 
+        if self._fenced:
+            return None
         snapshot = {
+            "epoch": self._epoch,
             "replicas": [
                 {"actor_id": r.handle.actor_id.binary(),
                  "replica_id": r.replica_id,
@@ -364,13 +640,19 @@ class ServeController:
             # min_version keeps subscriber clocks monotonic across a hub
             # (head) restart: routers long-poll with the last version they
             # saw, so a republish below it would never wake them.
-            rec.pub_version = ControllerStub(
+            version = ControllerStub(
                 get_core_worker().controller).psub_publish(
                     SNAPSHOT_CHANNEL, rec.name, snapshot,
-                    rec.pub_version + 1)
-            return rec.pub_version
+                    rec.pub_version + 1,
+                    self._epoch if self._epoch > 0 else None)
         except Exception:
             return None
+        if version is None:
+            # The hub fenced this publish: a newer epoch owns the key.
+            self._fence("the snapshot hub rejected this epoch's publish")
+            return None
+        rec.pub_version = version
+        return version
 
     # ----------------------------------------------------------- queries
 
@@ -483,6 +765,7 @@ class ServeController:
             self._routes = {p: n for p, n in self._routes.items()
                             if n != name}
             self._routes[prefix] = name
+        self._save_state()
 
     def get_routes(self) -> Dict[str, str]:
         with self._lock:
@@ -490,17 +773,29 @@ class ServeController:
 
     def delete(self, name: str) -> None:
         with self._lock:
-            # Route purge + record removal atomically: a concurrent
-            # redeploy can't leave a route pointing at a popped record.
+            # Route purge + tombstone atomically: a concurrent redeploy
+            # can't leave a route pointing at a doomed record. The
+            # record STAYS in _deployments (deleting=True) until the
+            # drain finishes — so the tombstone checkpoint below still
+            # knows the replicas, and a controller death mid-drain
+            # restores a record it finishes killing instead of
+            # orphaning live replica actors nobody reconciles.
             self._routes = {p: n for p, n in self._routes.items()
                             if n != name}
-            rec = self._deployments.pop(name, None)
+            rec = self._deployments.get(name)
             if rec is not None:
                 rec.deleting = True  # under lock: reconcile must not heal it
+        self._save_state()  # tombstone first, then drain
         if rec is not None:
             self._drain(rec)
+            with self._lock:
+                # Identity-guarded pop: a redeploy racing the drain owns
+                # the name now; only remove OUR tombstoned record.
+                if self._deployments.get(name) is rec:
+                    del self._deployments[name]
             self._publish(rec)
             self._last_models.pop(name, None)
+            self._save_state()
 
     def shutdown(self, drain_timeout_s: float = 10.0) -> None:
         self._stop.set()
@@ -511,6 +806,10 @@ class ServeController:
             names = list(self._deployments)
         for name in names:
             self.delete(name)
+        # The final checkpoint is EMPTY state: a controller created
+        # after a deliberate shutdown must start fresh, not adopt the
+        # ghosts of a torn-down serve plane.
+        self._save_state()
 
     # -------------------------------------------------- HTTP data plane
 
@@ -524,6 +823,7 @@ class ServeController:
         fixed port works like the reference's :8000)."""
         with self._lock:
             self._http_cfg = {"host": host, "port": port}
+        self._save_state()
         # Convergence belongs to the 1 Hz _proxy_loop thread — doing it
         # here would hold this serially-executed actor (and thus every
         # deploy/status/get_routes call) hostage to slow proxy starts.
@@ -541,6 +841,7 @@ class ServeController:
             self._http_cfg = None
             proxies = list(self._proxies.values())
             self._proxies.clear()
+        self._save_state()
         # Drain all proxies CONCURRENTLY: serial drains would make this
         # call's latency scale with node count past the caller's timeout.
         drains = [(p, p.handle.drain.remote(drain_timeout_s))
@@ -596,6 +897,8 @@ class ServeController:
         alive = set(alive_list)
         with self._lock:
             current = dict(self._proxies)
+            before = {n: p.handle.actor_id
+                      for n, p in self._proxies.items()}
         # Departed nodes: drain what's left of the proxy, forget it.
         for node_hex, proxy in current.items():
             if node_hex not in alive:
@@ -668,6 +971,14 @@ class ServeController:
                 log_every("serve.proxy_start", 5.0, logger,
                           "starting proxy on node %s failed", node_hex,
                           exc_info=True)
+        with self._lock:
+            after = {n: p.handle.actor_id
+                     for n, p in self._proxies.items()}
+        if after != before:
+            # Proxy membership changed: checkpoint so a restarted
+            # controller adopts the live proxies instead of binding
+            # duplicates next to them (EADDRINUSE on fixed ports).
+            self._save_state()
 
     def _start_proxy(self, node_hex: str, cfg: Dict[str, Any]) -> None:
         from ray_tpu.core.placement import NodeAffinitySchedulingStrategy
@@ -702,6 +1013,17 @@ class ServeController:
 
     def _reconcile_loop(self) -> None:
         while not self._stop.wait(0.25):
+            # Chaos hook: a die rule here SIGKILLs the controller at a
+            # deterministic point in its duty cycle (the canonical
+            # "controller death is a non-event" injection).
+            faultinject.check("serve.controller.reconcile_tick")
+            if self._epoch <= 0:
+                # Epoch lease was unavailable at start: keep trying —
+                # until it lands, publishes are unfenced and nothing
+                # checkpoints.
+                self._acquire_epoch()
+                if self._epoch > 0:
+                    self._save_state()
             try:
                 self._retry_pending_releases()
             except Exception:
@@ -828,7 +1150,12 @@ class ServeController:
             if downscaled is not None:
                 self._kill_replica(downscaled)
         # Model residency changes also need a push (multiplex routing).
-        if changed or self._models_changed(rec):
+        if changed:
+            self._publish(rec)
+            # Structural change (replica healed/scaled): checkpoint so a
+            # controller death right now restores THIS replica set.
+            self._save_state()
+        elif self._models_changed(rec):
             self._publish(rec)
         elif rec.pub_version:
             # Head-restart healing: a restarted cluster controller comes
@@ -849,7 +1176,15 @@ class ServeController:
                 except Exception:
                     cur = rec.pub_version  # unreachable hub: not a reset
                 if cur is None or (isinstance(cur, tuple)
-                                   and cur[0] < rec.pub_version):
+                                   and (cur[0] < rec.pub_version
+                                        or (isinstance(cur[1], dict)
+                                            and cur[1].get(
+                                                "epoch", self._epoch)
+                                            < self._epoch))):
+                    # Version regression (hub restarted empty) OR epoch
+                    # regression (a zombie's stamp survives on the hub
+                    # — possible only in the pre-fencing window): either
+                    # way this epoch's snapshot must own the key again.
                     self._publish(rec)
 
     def _min_replicas(self, rec: DeploymentRecord) -> int:
@@ -879,7 +1214,18 @@ def get_or_create_controller():
 
     try:
         handle = ray_tpu.get_actor(CONTROLLER_NAME)
-        ray_tpu.get(handle.ping.remote(), timeout=30.0)
+        try:
+            ray_tpu.get(handle.ping.remote(), timeout=30.0)
+        except ActorUnavailableError:
+            # One retry on the SAME handle. A fresh handle hints
+            # incarnation 0, so its first call to a RESTARTED
+            # (max_restarts=-1) controller always fails — and the
+            # failure taught the handle the live incarnation. When the
+            # controller is genuinely down, attempt 1 doubled as the
+            # failure report that triggers its restart, and this retry
+            # parks until the restarted incarnation is ALIVE — callers
+            # resume against the recovered control plane.
+            ray_tpu.get(handle.ping.remote(), timeout=30.0)
         return handle
     except (ValueError, ActorDiedError, ActorUnavailableError):
         pass  # absent or dead: (re)create — name registration allows

@@ -193,6 +193,11 @@ class _Router:
         self._replicas: List[Dict[str, Any]] = []  # {handle, id, models}
         self._inflight: Dict[str, int] = {}
         self._version = 0
+        # Highest controller epoch whose snapshot this router applied:
+        # snapshots from an OLDER epoch (a zombie controller racing its
+        # replacement) are ignored — client-side belt to the pubsub
+        # hub's server-side fencing suspender.
+        self._ctrl_epoch = 0
         self._have_snapshot = threading.Event()
         self._max_ongoing = 8
         self._deleted = False
@@ -208,6 +213,15 @@ class _Router:
 
     def _apply(self, version: int, snapshot: Dict[str, Any]) -> None:
         with self._lock:
+            epoch = int(snapshot.get("epoch") or 0)
+            if epoch and epoch < self._ctrl_epoch:
+                # Zombie-epoch snapshot: keep serving the newer view.
+                # (The version clock still advances with the poll loop,
+                # so the next legitimate publish wakes us normally.)
+                self._version = max(self._version, version)
+                return
+            if epoch:
+                self._ctrl_epoch = epoch
             self._version = version
             self._deleted = snapshot.get("deleted", False)
             self._max_ongoing = snapshot.get("max_ongoing_requests", 8)

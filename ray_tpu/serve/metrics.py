@@ -85,6 +85,22 @@ PENDING_RELEASES = Gauge(
     "(ServeController._pending_releases depth — growth means chips are "
     "stranded until the reconcile loop gets through).")
 
+CONTROLLER_EPOCH = Gauge(
+    "serve_controller_epoch",
+    "Monotonic serve-controller epoch (bumped on every controller "
+    "(re)start via the core epoch lease). A delta >= 2 over a doctor "
+    "window means the controller is crash-looping "
+    "(controller-flapping); the max across sources is the OWNING epoch "
+    "replicas are checked against.")
+
+REPLICA_EPOCH = Gauge(
+    "serve_replica_epoch",
+    "The controller epoch that owns this replica (assigned at spawn, "
+    "re-pushed at adoption). A replica whose epoch stays below the "
+    "live controller epoch — or that reports with no controller series "
+    "at all — is serving traffic nobody reconciles (orphan-replica).",
+    tag_keys=("deployment",))
+
 # Outcomes worth a counter key even at zero; keeps dashboards stable.
 OUTCOMES = ("completed", "cancelled", "deadline_exceeded", "shed", "error")
 

@@ -82,35 +82,75 @@ class _RouteTable:
     """Longest-prefix route lookup against the serve controller's route
     table, cached briefly (the reference's proxy gets pushed route updates
     via LongPollHost; a 2 s pull cache gives the same convergence window
-    without a standing subscription per proxy)."""
+    without a standing subscription per proxy).
+
+    Outage-tolerant by construction: the controller is LOOKED UP, never
+    created (a proxy must not spawn a control plane to route a request),
+    a failed refresh serves the stale cache and backs off further
+    refresh attempts for 2 s — so during a controller outage the data
+    plane keeps routing on its last known table, paying at most one
+    short probe per backoff window instead of one per request. With no
+    cache at all, ``resolve`` returns None and the caller falls back to
+    the first path segment — fresh proxies still route the common
+    ``/<app>`` shape with the controller down."""
 
     def __init__(self):
         self._cache: Optional[Tuple[float, Dict[str, str]]] = None
+        self._backoff_until = 0.0
         self._lock = threading.Lock()
 
     def invalidate(self) -> None:
         with self._lock:
             self._cache = None
 
-    def resolve(self, path: str) -> Optional[str]:
-        from ray_tpu.serve.controller import get_or_create_controller
-
+    def _refresh(self, now: float) -> Optional[Dict[str, str]]:
         import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
 
+        from ray_tpu.serve.api import _controller_alive
+
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            if not _controller_alive(controller):
+                # Mid-restart: degrade WITHOUT parking a blocking call
+                # on the request path — stale routes serve meanwhile.
+                raise RuntimeError("serve controller not ALIVE")
+            try:
+                routes = ray_tpu.get(controller.get_routes.remote(),
+                                     timeout=5.0)
+            except Exception:
+                # Same-handle retry, but only against a live record: a
+                # restarted controller rejects a fresh handle's first
+                # call (stale incarnation hint); a record that just
+                # went RESTARTING is an outage — the failed call above
+                # already reported it.
+                if not _controller_alive(controller):
+                    raise
+                routes = ray_tpu.get(controller.get_routes.remote(),
+                                     timeout=5.0)
+        except Exception:
+            # Dead/restarting controller. The failed actor call above
+            # doubles as the failure report that triggers its restart;
+            # meanwhile the stale cache keeps the data plane moving.
+            with self._lock:
+                self._backoff_until = time.monotonic() + 2.0
+            return None
+        with self._lock:
+            self._cache = (now, routes)
+            self._backoff_until = 0.0
+        return routes
+
+    def resolve(self, path: str) -> Optional[str]:
         now = time.monotonic()
         with self._lock:
             cache = self._cache
-        if cache is None or now - cache[0] > 2.0:
-            try:
-                controller = get_or_create_controller()
-                routes = ray_tpu.get(controller.get_routes.remote(),
-                                     timeout=10.0)
-                with self._lock:
-                    self._cache = (now, routes)
-            except Exception:
-                routes = {} if cache is None else cache[1]
-        else:
-            routes = cache[1]
+            backoff_until = self._backoff_until
+        routes = None
+        if (cache is None or now - cache[0] > 2.0) \
+                and now >= backoff_until:
+            routes = self._refresh(now)
+        if routes is None:
+            routes = {} if cache is None else cache[1]
         path = "/" + path.strip("/")
         best = None
         for prefix, name in routes.items():

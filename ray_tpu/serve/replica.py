@@ -115,7 +115,7 @@ def loaded_model_ids(instance) -> List[str]:
 
 class ReplicaActor:
     def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict,
-                 replica_id: str = ""):
+                 replica_id: str = "", owner_epoch: int = 0):
         from ray_tpu.core import serialization
 
         if replica_id:
@@ -130,6 +130,28 @@ class ReplicaActor:
         self._total = 0
         self._lock = threading.Lock()
         self._started = time.monotonic()
+        # The controller epoch that owns this replica: assigned at
+        # spawn, re-pushed by a restarted controller when it ADOPTS the
+        # replica (set_owner_epoch). Exported as the serve_replica_epoch
+        # gauge so `ray_tpu doctor` can flag replicas no live controller
+        # epoch owns (orphan-replica).
+        self._owner_epoch = int(owner_epoch)
+        if replica_id:
+            from ray_tpu.util import metrics as um
+
+            um.add_collector(self._collect_epoch)
+
+    def _collect_epoch(self) -> None:
+        from ray_tpu.serve import metrics as smetrics
+
+        smetrics.REPLICA_EPOCH.set(
+            float(self._owner_epoch),
+            {"deployment": _replica_ident["deployment"]})
+
+    def set_owner_epoch(self, epoch: int) -> None:
+        """Adoption handshake from a restarted controller: monotonic —
+        a zombie's stale push can't regress the owning epoch."""
+        self._owner_epoch = max(self._owner_epoch, int(epoch))
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        multiplexed_model_id: str = "",

@@ -390,6 +390,68 @@ def test_mutation_dropped_lease_release_caught():
     assert "reserve_subslice" in hits[0].message
 
 
+def test_mutation_dropped_checkpoint_save_caught():
+    """Acceptance (PR 12): a state-mutating ServeController handler
+    that stops reaching _save_state before returning is a repo-blocking
+    finding — the mutation would be invisible to a restarted
+    controller."""
+    project = repo_project_with(
+        "ray_tpu/serve/controller.py",
+        """            self._routes[prefix] = name
+        self._save_state()""",
+        """            self._routes[prefix] = name""")
+    found = run_checker(lifetime.check, project)
+    hits = [f for f in found if f.rule == rules.CHECKPOINT_MISSING]
+    assert [f.symbol for f in hits] == ["ServeController.set_route"], \
+        [f.render() for f in found]
+    assert "_save_state" in hits[0].message
+
+
+def test_mutation_deploy_checkpoint_not_discharged_by_callees():
+    """deploy reaches _kill_replica, whose transitive _save_state lives
+    on an EXCEPTION path (queued-release checkpoint) — that must not
+    count as deploy having checkpointed: drop deploy's own save and the
+    rule still fires."""
+    project = repo_project_with(
+        "ray_tpu/serve/controller.py",
+        """        version = self._publish(rec)
+        self._save_state()
+        return version""",
+        """        version = self._publish(rec)
+        return version""")
+    found = run_checker(lifetime.check, project)
+    hits = [f for f in found if f.rule == rules.CHECKPOINT_MISSING]
+    assert [f.symbol for f in hits] == ["ServeController.deploy"], \
+        [f.render() for f in found]
+
+
+def test_checkpoint_discharged_via_self_callee_wrapper():
+    """TN: routing the save through a self.-callee wrapper (the
+    summary fixpoint's via-self hop) discharges the obligation."""
+    project = repo_project_with(
+        "ray_tpu/serve/controller.py",
+        """            self._routes[prefix] = name
+        self._save_state()""",
+        """            self._routes[prefix] = name
+        self._checkpoint_now()
+
+    def _checkpoint_now(self):
+        self._save_state()""")
+    found = run_checker(lifetime.check, project)
+    assert not [f for f in found if f.rule == rules.CHECKPOINT_MISSING
+                and f.symbol == "ServeController.set_route"], \
+        [f.render() for f in found]
+
+
+def test_repo_clean_checkpoint_rule():
+    """Every listed ServeController handler reaches _save_state today."""
+    project = Project.load(repo_root())
+    found = run_checker(lifetime.check, project)
+    assert not [f for f in found
+                if f.rule == rules.CHECKPOINT_MISSING], \
+        [f.render() for f in found]
+
+
 def test_mutation_handler_signature_drift_caught():
     """Acceptance: a handler signature change without --gen-stubs fails
     the drift gate."""

@@ -231,6 +231,11 @@ def _bare_serve_controller():
     ctl = ServeController.__new__(ServeController)
     ctl._pending_releases = []
     ctl._lock = threading.Lock()
+    # PR 12 checkpoint plumbing: epoch 0 = lease never acquired, so
+    # _save_state (called when a release gets queued) is a no-op shell.
+    ctl._save_mutex = threading.Lock()
+    ctl._epoch = 0
+    ctl._fenced = False
     return ctl
 
 
