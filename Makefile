@@ -4,7 +4,7 @@
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
 	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist \
-	bench-obs bench-chaos
+	bench-obs bench-chaos bench-gang
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -72,6 +72,13 @@ bench-obs:
 # without clobbering the existing sections.
 bench-chaos:
 	JAX_PLATFORMS=cpu python bench_chaos.py
+
+# Multi-host gang bench (ISSUE 13): formation latency, member-death ->
+# reconciled MTTR and coordinator-failover MTTR for 2/4/8-host virtual
+# groups (8x8/8 virtual slice), faults via util/faultinject at the
+# member beat site -> BENCH_SERVE.json rows, merge-preserving.
+bench-gang:
+	JAX_PLATFORMS=cpu python bench_gang.py
 
 # Podracer substrate scaling rows (env-steps/s + learner updates/s at
 # 1/2/4 rollout actors, parameter-staleness p50/p99) -> BENCH_RL.json

@@ -1,0 +1,142 @@
+"""Multi-host gang bench (ISSUE 13): gang formation latency,
+member-death -> reconciled MTTR, and coordinator-failover MTTR for
+2/4/8-host VIRTUAL groups (one dev-box node advertising an 8x8 grid at
+8 chips per host = 8 virtual hosts), all faults driven through
+util/faultinject at the member beat site — never ad-hoc kills.
+
+Rows merge into BENCH_SERVE.json preserving every other row (the PR 6
+merge idiom):
+
+* ``gang_form_s_{n}h``        — HostGroup.start(): reserve + register
+  + spawn n members + elect + configure;
+* ``gang_member_mttr_s_{n}h`` — SIGKILL a non-coordinator member ->
+  whole-gang reconciled (fresh members, bumped epoch, old sub-slice
+  released exactly once);
+* ``gang_coord_mttr_s_{n}h``  — SIGKILL the COORDINATOR -> re-election
+  completes under the bumped epoch.
+
+Run: ``make bench-gang`` (CPU host; the bound being measured is
+control-plane latency, so no accelerator is involved).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="write /tmp instead of BENCH_SERVE.json")
+    parser.add_argument("--sizes", default="2,4,8",
+                        help="comma-separated gang sizes")
+    args = parser.parse_args()
+
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["RAY_TPU_VIRTUAL_SLICE"] = "8x8/8"
+    faults_path = f"/tmp/ray_tpu_bench_gang_{os.getpid()}.json"
+    os.environ["RAY_TPU_FAULTINJECT_PATH"] = faults_path
+
+    import ray_tpu
+    from ray_tpu.core.config import config
+    from ray_tpu.core.multihost import HostGroup
+    from ray_tpu.util.faultinject import Faults
+
+    config.faultinject_path = faults_path
+    ray_tpu.init(num_cpus=16)
+
+    sizes = [int(s) for s in args.sizes.split(",")]
+    rows = []
+
+    def wait_epoch(group, epoch, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = group.status()
+            if st["epoch"] >= epoch and st["state"] == "ALIVE":
+                return True
+            time.sleep(0.05)
+        return False
+
+    for n in sizes:
+        # ---------------------------------------------- formation
+        t0 = time.monotonic()
+        g = HostGroup(n, name=f"bench-form-{n}",
+                      max_group_restarts=2).start()
+        form_s = time.monotonic() - t0
+        rows.append({
+            "metric": f"gang_form_s_{n}h",
+            "value": round(form_s, 3), "unit": "s",
+            "note": (f"{n}-host gang: reserve sub-slice + register + "
+                     f"spawn {n} members + elect coordinator + "
+                     f"configure (virtual 8x8/8 slice, cpu host)")})
+
+        # ------------------------------------- member-death MTTR
+        victim = f"host-{n - 1}"  # non-coordinator
+        with Faults(faults_path) as f:
+            rule = f.add(f"multihost.member.bench-form-{n}.{victim}.beat",
+                         "die", once_global=True,
+                         rule_id=f"kill-m-{n}")
+            deadline = time.monotonic() + 30.0
+            while (not f.marker_fired(rule)
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            t0 = time.monotonic()
+            assert wait_epoch(g, 2), g.status()
+            mttr = time.monotonic() - t0
+        rows.append({
+            "metric": f"gang_member_mttr_s_{n}h",
+            "value": round(mttr, 3), "unit": "s",
+            "note": (f"SIGKILL {victim} (faultinject at its beat site) "
+                     f"-> whole {n}-host gang reconciled: all members "
+                     f"respawned under epoch 2, old sub-slice released "
+                     f"once; beat {config.mh_member_beat_period_s}s / "
+                     f"monitor {config.mh_monitor_period_s}s")})
+
+        # ------------------------------- coordinator-failover MTTR
+        with Faults(faults_path) as f:
+            rule = f.add(f"multihost.member.bench-form-{n}.host-0.beat",
+                         "die", once_global=True,
+                         rule_id=f"kill-c-{n}")
+            deadline = time.monotonic() + 30.0
+            while (not f.marker_fired(rule)
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            t0 = time.monotonic()
+            assert wait_epoch(g, 3), g.status()
+            coord_mttr = time.monotonic() - t0
+            coord = g.coordinator()
+            assert coord["epoch"] == 3, coord
+        rows.append({
+            "metric": f"gang_coord_mttr_s_{n}h",
+            "value": round(coord_mttr, 3), "unit": "s",
+            "note": (f"SIGKILL the COORDINATOR (host-0) of the "
+                     f"{n}-host gang -> re-election completed: fresh "
+                     f"gang under epoch 3, fenced election record "
+                     f"rewritten, deposed epoch rejected")})
+        g.shutdown()
+
+    ray_tpu.shutdown()
+
+    out_path = "BENCH_SERVE.json"
+    doc = {"artifact": "BENCH_SERVE", "rows": []}
+    if os.path.exists(out_path) and not args.quick:
+        with open(out_path) as f:
+            doc = json.load(f)
+        emitted = {r["metric"] for r in rows}
+        doc["rows"] = [r for r in doc.get("rows", [])
+                       if r["metric"] not in emitted]
+    if args.quick:
+        out_path = "/tmp/bench_gang_quick.json"
+    doc["rows"] = doc.get("rows", []) + rows
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()

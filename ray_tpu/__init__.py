@@ -48,3 +48,4 @@ from ray_tpu.core.placement import (  # noqa: F401
     remove_placement_group,
     reserve_subslice,
 )
+from ray_tpu.core.multihost import HostGroup  # noqa: F401

@@ -258,6 +258,14 @@ RESOURCE_REFCOUNT_ATTRS = ("refcount",)
 # a leaked reservation strands its chips until the hosting node dies.
 RPC_LEASE_PAIRS = {
     "reserve_subslice": "release_subslice",
+    # A host-group registration is a controller-side resource exactly
+    # like a sub-slice lease, at GANG granularity: HostGroup._form
+    # acquires the group record (and the gang epoch) before spawning
+    # members, and a partial-spawn failure must drop it on every
+    # exception path alongside the sub-slice release — a leaked record
+    # strands the group id and its fencing epoch (the PR 8 _add_replica
+    # leak shape, one level up).
+    "mh_register_group": "mh_drop_group",
 }
 # The RPC verbs lease acquire/release ride on (client.call today;
 # notify releases would also discharge).

@@ -309,6 +309,29 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "read. Set via RAY_TPU_FAULTINJECT_PATH before ray_tpu.init so "
         "worker processes inherit it; chaos tests drive faults by "
         "editing the file (re-read on mtime change)."),
+    "mh_member_beat_period_s": (float, 0.25,
+        "Period of a host-group member's membership heartbeat to the "
+        "group registry (core/multihost.py). The beat carries the "
+        "member's group epoch; a 'fenced' reply is how a zombie member "
+        "of a deposed gang incarnation learns to stop touching group "
+        "state."),
+    "mh_monitor_period_s": (float, 0.3,
+        "Period of the HostGroup driver-side monitor pinging every gang "
+        "member. One failed member reconciles the WHOLE group (kill all, "
+        "release the sub-slice exactly once, optional restart under a "
+        "bumped epoch)."),
+    "mh_ping_timeout_s": (float, 5.0,
+        "Timeout on each monitor ping before a gang member is declared "
+        "dead (the push to a SIGKILLed worker fails fast; this bounds "
+        "the wedged-but-listening case)."),
+    "mh_barrier_timeout_s": (float, 30.0,
+        "Default timeout for group rendezvous barriers (program-hash "
+        "checks, jax bootstrap alignment). A timeout is a typed refusal "
+        "naming the absent members — never a silent hang."),
+    "mh_form_timeout_s": (float, 60.0,
+        "How long gang formation waits for every member actor to come "
+        "up before declaring the spawn failed (all-or-nothing: a "
+        "partial gang is torn down and the sub-slice released)."),
     "rpc_reconnect_backoff_base_ms": (int, 50,
         "First-retry pause of a ReconnectingClient after a transport "
         "failure. Doubles per consecutive failure (with +/-50% jitter) "

@@ -164,6 +164,13 @@ class Controller:
         # + serve's LongPollHost): actor/job/PG state transitions and KV
         # writes publish here so clients wait on pushes, not poll loops.
         self.pubsub = Pubsub()
+        # Multi-host gang registry (core/multihost.py): group epochs,
+        # rendezvous barriers (program-hash checks park handler threads
+        # here exactly like the pubsub long-polls), fenced group KV and
+        # membership beats. Internally locked — accessed off self._lock.
+        from ray_tpu.core.multihost import GroupRegistry
+
+        self.multihost = GroupRegistry()
         self._server = RpcServer(
             handlers={
                 "register_node": self.register_node,
@@ -197,6 +204,13 @@ class Controller:
                 "reserve_subslice": self.reserve_subslice,
                 "release_subslice": self.release_subslice,
                 "topology_state": self.topology_state,
+                "mh_register_group": self.multihost.register_group,
+                "mh_drop_group": self.multihost.drop_group,
+                "mh_barrier": self.multihost.barrier,
+                "mh_member_beat": self.multihost.member_beat,
+                "mh_group_put": self.multihost.group_put,
+                "mh_group_get": self.multihost.group_get,
+                "mh_group_state": self.multihost.group_state,
                 "autoscaler_state": self.autoscaler_state,
                 "push_metrics": self.push_metrics,
                 "list_metrics": self.list_metrics,

@@ -163,6 +163,36 @@ NODE_HEARTBEAT_RTT = Histogram(
     "Node-observed heartbeat round-trip to the controller; one series "
     "per node.", boundaries=_RTT_BUCKETS, tag_keys=("node",))
 
+# ------------------------------------------------------ multihost plane
+#
+# Host-group gangs (core/multihost.py). Barrier waits span instant
+# rendezvous (everyone already arrived) through straggler-bound stalls;
+# the entered/absent split per member is what `ray_tpu doctor`'s
+# gang-hang signature reads.
+
+_BARRIER_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    30.0, 60.0)
+
+MH_GROUPS = Gauge(
+    "mh_groups",
+    "Host groups currently registered with the controller's group "
+    "registry.")
+MH_MEMBER_EPOCH = Gauge(
+    "mh_member_epoch",
+    "Group epoch each gang member last heartbeat under; a member "
+    "pinned below its group's current epoch is a fenced zombie.",
+    tag_keys=("group", "member"))
+MH_BARRIER_ENTERED = Gauge(
+    "mh_barrier_entered",
+    "1 when the member has arrived at a currently-pending group "
+    "barrier, 0 when the gang is waiting on it (uniform 0 when no "
+    "barrier is pending). Persistent divergence is the gang-hang "
+    "signature.", tag_keys=("group", "member"))
+MH_BARRIER_WAIT_S = Histogram(
+    "mh_barrier_wait_s",
+    "Time a member parked in a group rendezvous barrier before "
+    "completion or timeout.", boundaries=_BARRIER_BUCKETS)
+
 
 # ----------------------------------------------------- cluster summary
 
@@ -259,5 +289,12 @@ def core_summary(aggregated: Dict[str, List[Dict[str, Any]]]
                                            tag="node"),
         "pending_subslice_releases": sum(gauge_totals(
             aggregated, "serve_pending_subslice_releases").values()),
+    }
+    out["multihost"] = {
+        "groups": sum(gauge_totals(aggregated, "mh_groups").values()),
+        "member_series": len(gauge_totals(aggregated,
+                                          "mh_member_epoch")),
+        "barrier_wait_s": _merged_summary(aggregated,
+                                          "mh_barrier_wait_s"),
     }
     return out

@@ -158,6 +158,36 @@ class ControllerStub(_StubBase):
     def metrics_text(self, *, timeout=_UNSET):
         return self._call('metrics_text', timeout=timeout)
 
+    def mh_barrier(self, group_id, name, member, epoch, payload=_UNSET,
+                   timeout_s=_UNSET, *, timeout=_UNSET):
+        return self._call('mh_barrier', group_id, name, member, epoch,
+                          payload=payload, timeout_s=timeout_s,
+                          timeout=timeout)
+
+    def mh_drop_group(self, group_id, *, timeout=_UNSET):
+        return self._call('mh_drop_group', group_id, timeout=timeout)
+
+    def mh_group_get(self, group_id, key, *, timeout=_UNSET):
+        return self._call('mh_group_get', group_id, key, timeout=timeout)
+
+    def mh_group_put(self, group_id, key, value, epoch, *, timeout=_UNSET):
+        return self._call('mh_group_put', group_id, key, value, epoch,
+                          timeout=timeout)
+
+    def mh_group_state(self, group_id=_UNSET, *, timeout=_UNSET):
+        return self._call('mh_group_state', group_id=group_id,
+                          timeout=timeout)
+
+    def mh_member_beat(self, group_id, member, epoch, *, timeout=_UNSET):
+        return self._call('mh_member_beat', group_id, member, epoch,
+                          timeout=timeout)
+
+    def mh_register_group(self, group_id, num_hosts, reservation_id=_UNSET,
+                          owner=_UNSET, *, timeout=_UNSET):
+        return self._call('mh_register_group', group_id, num_hosts,
+                          reservation_id=reservation_id, owner=owner,
+                          timeout=timeout)
+
     def pick_node(self, resources, strategy=_UNSET, caller_node_id=_UNSET,
                   excluded=_UNSET, *, timeout=_UNSET):
         return self._call('pick_node', resources, strategy=strategy,

@@ -97,13 +97,20 @@ def detect_slice(resources: Optional[Dict[str, float]] = None,
     Dev box: ``RAY_TPU_VIRTUAL_SLICE`` (e.g. ``"2x4"`` or ``"8"``) opts a
     CPU node into advertising a virtual slice over the forced host
     devices — serving tests and the single-process GSPMD path use this.
-    Returns None when the node has no accelerator story (pure CPU nodes
-    stay out of the topology view entirely)."""
+    An optional ``/N`` suffix (``"4x4/4"``) sets chips-per-host below
+    the full slice, making the single dev-box node advertise a virtual
+    MULTI-host slice (4x4 grid, 4 chips per host = 4 hosts) — the gang
+    substrate (core/multihost.py) spawns one member per virtual host
+    against it, the multi-raylet-in-one-machine trick at host
+    granularity. Returns None when the node has no accelerator story
+    (pure CPU nodes stay out of the topology view entirely)."""
     virt = os.environ.get("RAY_TPU_VIRTUAL_SLICE")
     if virt:
-        topo = parse_topology(virt)
+        spec, _, cph = virt.partition("/")
+        topo = parse_topology(spec)
         return SliceInfo(f"virtual-{node_hint or os.getpid()}", topo,
-                         chips_per_host=topo[0] * topo[1])
+                         chips_per_host=(int(cph) if cph
+                                         else topo[0] * topo[1]))
     chips = int((resources or {}).get("TPU", 0))
     if chips <= 0:
         return None
@@ -230,6 +237,8 @@ class SliceGrid:
             "slice_id": self.info.slice_id,
             "topology": list(self.info.topology),
             "chips": self.info.chips,
+            "chips_per_host": self.info.chips_per_host,
+            "hosts": self.info.hosts,
             "chips_free": self.free_chips,
             "largest_free_block": self.largest_free_block(),
             "fragmentation": self.fragmentation(),

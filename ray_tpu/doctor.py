@@ -31,6 +31,12 @@ historically became hangs:
   replica's epoch persistently below the live controller's): the
   replica serves traffic nobody reconciles — it will never be healed,
   autoscaled, or drained.
+* **gang-hang** — a host group's members' barrier-entered gauges
+  diverge for the whole window (some members arrived at a pending
+  rendezvous barrier, others never did): the gang is wedged
+  pre-collective, and the STRAGGLER hosts are named — the multi-host
+  debugging story (a hung collective itself is invisible; the barrier
+  in front of it is not).
 
 ``diagnose`` is a pure function over snapshots so tests inject each
 fault into the REAL components and assert the doctor names it; the CLI
@@ -312,6 +318,57 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                            "actor and let reconcile respawn it"),
             })
 
+    # ------------------------------------------------------ gang-hang
+    # A pending barrier splits a group's members into entered (gauge 1)
+    # and absent (gauge 0). Divergence that persists across BOTH
+    # snapshots — same members still absent, same gang still parked —
+    # is a wedge, not a transient rendezvous in progress.
+    def _entered(agg) -> Dict[Tuple[str, str], float]:
+        out: Dict[Tuple[str, str], float] = {}
+        for _src, tags, val in _gauge_series(agg, "mh_barrier_entered"):
+            out[(tags.get("group", "-"),
+                 tags.get("member", "-"))] = val
+        return out
+
+    ent_before = _entered(before)
+    ent_after = _entered(after)
+    for grp in sorted({g for g, _m in ent_after}):
+        mem_after = {m: v for (g, m), v in ent_after.items()
+                     if g == grp}
+        mem_before = {m: v for (g, m), v in ent_before.items()
+                      if g == grp}
+        if not mem_before:
+            continue  # group not present across the whole window
+
+        def _split(d):
+            return ({m for m, v in d.items() if v >= 1.0},
+                    {m for m, v in d.items() if v < 1.0})
+
+        in_a, out_a = _split(mem_after)
+        in_b, out_b = _split(mem_before)
+        stragglers = sorted(out_a & out_b)
+        if not (in_a and in_b and stragglers):
+            continue
+        findings.append({
+            "signature": "gang-hang", "severity": "critical",
+            "source": f"group:{grp}",
+            "summary": (f"host group {grp!r}: member(s) "
+                        f"{', '.join(stragglers)} never entered the "
+                        f"rendezvous barrier the rest of the gang "
+                        f"({', '.join(sorted(in_a))}) is parked at, "
+                        f"across the whole {interval_s:.0f}s window — "
+                        f"the group is wedged pre-collective "
+                        f"(straggler or partitioned host)"),
+            "evidence": {"stragglers": stragglers,
+                         "entered": sorted(in_a)},
+            "remedy": ("inspect the straggler's worker process "
+                       "(`ray_tpu stacks`); if it died, the group "
+                       "monitor reconciles the whole gang — check "
+                       "mh_member_epoch for a fenced zombie. Barrier "
+                       "timeouts convert this hang into a typed "
+                       "refusal naming the absent members"),
+        })
+
     order = {"critical": 0, "warning": 1}
     findings.sort(key=lambda f: (order.get(f["severity"], 9),
                                  f["signature"], f["source"]))
@@ -334,7 +391,7 @@ def render(findings: List[Dict[str, Any]]) -> str:
         return ("no failure signatures detected (checked: "
                 "rpc-backpressure, reconnect-storm, pubsub-lag, "
                 "ref-leak, heartbeat-rtt-outlier, controller-flapping, "
-                "orphan-replica)")
+                "orphan-replica, gang-hang)")
     lines = [f"{len(findings)} finding(s):", ""]
     for i, f in enumerate(findings, 1):
         lines.append(f"[{i}] {f['severity'].upper()} {f['signature']} "

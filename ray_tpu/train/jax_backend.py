@@ -1,4 +1,5 @@
-"""Multi-host JAX runtime bootstrap for train worker groups.
+"""Low-level JAX runtime bootstrap: the per-PROCESS half of multi-host
+mesh formation.
 
 The TPU-native analogue of the reference's torch process-group setup
 (``train/torch/config.py:65-170``: ``_setup_torch_process_group`` with
@@ -8,6 +9,14 @@ coordinator, every worker calls ``jax.distributed.initialize``, and the
 result is ONE global device view — ``jax.devices()`` spans all hosts, a
 ``Mesh`` built over it compiles cross-host collectives over ICI/DCN
 (SURVEY §5.8: "the mesh is declared, not connected").
+
+GANG orchestration lives one layer up in ``ray_tpu.core.multihost``
+(the shared substrate for train worker groups, tune trial gangs and
+HostGroup): group registration, the barrier'd bootstrap-fingerprint
+check (a misaligned ``num_processes`` would otherwise hang
+``jax.distributed.initialize`` itself), coordinator election and epoch
+fencing all happen there; this module only knows how to join ONE
+process to an already-agreed-on coordinator.
 
 Two deployment shapes, one code path:
 

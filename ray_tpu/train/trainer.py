@@ -24,7 +24,8 @@ from typing import Any, Callable, Dict, List, Optional
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig, ScalingConfig
-from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.worker_group import (GangReservationError, WorkerGroup,
+                                        launch_gang)
 
 
 @dataclass
@@ -120,26 +121,35 @@ class JaxTrainer:
         from ray_tpu.core import serialization
 
         sc = self.scaling_config
-        # Deterministic driver-side failures (unpicklable train fn) raise
-        # HERE, outside the retry budget — only distributed failures below
-        # convert to attempt failures.
+        # Deterministic driver-side failures (unpicklable train fn,
+        # unreservable gang) raise HERE, outside the retry budget — only
+        # distributed failures below convert to attempt failures.
         fn_blob = serialization.dumps_function(self._train_fn)
-        group = WorkerGroup(sc.num_workers, sc.worker_resources(),
-                            sc.placement_strategy, jax_config=sc.jax_config)
+        try:
+            # The shared gang-request path (worker_group.launch_gang —
+            # tune trials use the same one): placement gang + worker
+            # start + the optional jax.distributed bootstrap through
+            # core/multihost.py. All-or-nothing: a failure inside hands
+            # back a fully torn-down gang.
+            group = launch_gang(sc, self.run_config.storage_path,
+                                self._name, latest_checkpoint,
+                                dataset_shards_per_rank=(
+                                    self.dataset_shards_per_rank()))
+        except GangReservationError:
+            raise  # the cluster cannot fit the gang: not retriable here
+        except Exception as e:
+            # A worker can die between starting its train thread and
+            # the start() reply flushing (e.g. the loop crashes
+            # immediately): that's an attempt failure, not a driver
+            # error — the retry budget owns it.
+            raise _AttemptFailed(
+                f"worker group setup failed: {e}", latest_checkpoint)
         try:
             try:
-                group.start(self.run_config.storage_path, self._name,
-                            latest_checkpoint,
-                            dataset_shards_per_rank=(
-                                self.dataset_shards_per_rank()))
                 group.run(self._train_fn, self._config, fn_blob=fn_blob)
             except _AttemptFailed:
                 raise
             except Exception as e:
-                # A worker can die between starting its train thread and
-                # the start() reply flushing (e.g. the loop crashes
-                # immediately): that's an attempt failure, not a driver
-                # error — the retry budget owns it.
                 raise _AttemptFailed(
                     f"worker group setup failed: {e}", latest_checkpoint)
             return self._poll_until_done(group, history, latest_checkpoint)

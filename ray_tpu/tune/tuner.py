@@ -40,6 +40,7 @@ from ray_tpu.train.worker_group import (
     GangReservationError,
     TrainWorker,
     WorkerGroup,
+    launch_gang,
 )
 from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
 from ray_tpu.tune.search import generate_variants
@@ -369,18 +370,21 @@ class Tuner:
         start_ckpt = checkpoint or trial.latest_checkpoint
         experiment = f"{self._name}/{trial.id}"
         if self._trainer is not None:
-            # Gang trial: a full WorkerGroup per trial — per-trial PG,
-            # N workers, optional jax.distributed bootstrap — with the
-            # sampled config merged over train_loop_config (reference:
+            # Gang trial: the trial REQUESTS a gang through the shared
+            # launch path (worker_group.launch_gang — the same code
+            # trainer attempts use): per-trial PG, N workers, and the
+            # optional multi-process jax.distributed bootstrap routed
+            # through core/multihost.py (group registration +
+            # bootstrap-hash barrier) instead of hand-rolled
+            # coordinator/env wiring here. The trial's sampled config
+            # merges over train_loop_config (reference:
             # base_trainer.py:608 config-merge into the trainable).
-            sc = self._trainer.scaling_config
-            group = WorkerGroup(sc.num_workers, sc.worker_resources(),
-                                sc.placement_strategy,
-                                jax_config=sc.jax_config)
+            group = launch_gang(
+                self._trainer.scaling_config, self._storage, experiment,
+                start_ckpt,
+                dataset_shards_per_rank=(
+                    self._trainer.dataset_shards_per_rank()))
             try:
-                group.start(self._storage, experiment, start_ckpt,
-                            dataset_shards_per_rank=(
-                                self._trainer.dataset_shards_per_rank()))
                 merged = {**(self._trainer._config or {}), **trial.config}
                 group.run(None, merged, fn_blob=fn_blob)
             except Exception:

@@ -56,9 +56,14 @@ Actions:
 
 Sites instrumented in-tree: ``rpc.server.<server>.<method>``,
 ``rpc.client.<method>``, ``rpc.dial.<host>:<port>``,
-``node.heartbeat``, and the serve controller lifecycle points
+``node.heartbeat``, the serve controller lifecycle points
 (``serve.controller.init`` / ``.restore`` / ``.save_state`` /
-``.reconcile_tick`` / ``.retry_pending_releases`` / ``.deploy``).
+``.reconcile_tick`` / ``.retry_pending_releases`` / ``.deploy``), and
+the multihost gang (``multihost.barrier.<group>.<member>`` at member-
+side barrier entry — a delay/drop rule manufactures a straggler for
+the doctor's gang-hang signature — and
+``multihost.member.<group>.<member>.beat`` in the member heartbeat
+loop, where a ``die`` rule SIGKILLs exactly that host's worker).
 """
 
 from __future__ import annotations

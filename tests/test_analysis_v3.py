@@ -390,6 +390,51 @@ def test_mutation_dropped_lease_release_caught():
     assert "reserve_subslice" in hits[0].message
 
 
+def test_mutation_gang_dropped_subslice_release_caught():
+    """Acceptance (ISSUE 13): HostGroup._form's partial-spawn cleanup
+    must hand the sub-slice back on every exception path — removing
+    the release from _abort_formation is the _add_replica leak shape
+    at GANG granularity, and a repo-blocking finding."""
+    project = repo_project_with(
+        "ray_tpu/core/multihost.py",
+        "            stub.release_subslice(reservation_id)\n",
+        "            pass\n")
+    found = run_checker(lifetime.check, project)
+    hits = [f for f in found if f.rule == rules.RESOURCE_LEAK
+            and f.symbol == "HostGroup._form"]
+    assert len(hits) == 1, [f.render() for f in found]
+    assert "reserve_subslice" in hits[0].message
+
+
+def test_mutation_gang_dropped_group_drop_caught():
+    """The mh_register_group -> mh_drop_group lease pair (rules
+    extension): a partial spawn that stops dropping the half-created
+    group record leaks it (and its fencing epoch) — caught statically
+    through the _abort_formation self-callee chain."""
+    project = repo_project_with(
+        "ray_tpu/core/multihost.py",
+        """            stub.mh_drop_group(self.group_id)
+        except Exception:
+            log_every("multihost.abort_drop\"""",
+        """            pass
+        except Exception:
+            log_every("multihost.abort_drop\"""")
+    found = run_checker(lifetime.check, project)
+    hits = [f for f in found if f.rule == rules.RESOURCE_LEAK
+            and f.symbol == "HostGroup._form"]
+    assert len(hits) == 1, [f.render() for f in found]
+    assert "mh_register_group" in hits[0].message
+
+
+def test_gang_lease_repo_clean():
+    """TN: the real multihost module discharges both gang leases on
+    every exception path (release through the _abort_formation
+    self-callee, ownership handoff via _commit_formation)."""
+    found = run_checker(lifetime.check, Project.load(repo_root()))
+    assert [f for f in found
+            if f.path == "ray_tpu/core/multihost.py"] == []
+
+
 def test_mutation_dropped_checkpoint_save_caught():
     """Acceptance (PR 12): a state-mutating ServeController handler
     that stops reaching _save_state before returning is a repo-blocking

@@ -5,7 +5,15 @@ in-process; these tests drive ``jax.distributed`` bootstrap through
 JaxTrainer/WorkerGroup across real separate worker *processes* on the CPU
 backend — the same code path a TPU pod slice uses (one worker per host),
 modeled on the reference's process-group setup test surface
-(``train/torch/config.py:65-170``, ``train/tests/test_backend.py``)."""
+(``train/torch/config.py:65-170``, ``train/tests/test_backend.py``).
+
+Since ISSUE 13 the bootstrap routes through the multihost gang
+substrate (``core/multihost.py``): group registration + the barrier'd
+bootstrap-fingerprint check precede ``jax.distributed.initialize``.
+The two collective-running tests stay skip-marked on this image
+(jaxlib 0.4.37 CPU backend), but the routing itself and the REAL
+2-process bootstrap (which does work on CPU — only collectives fail)
+are exercised un-skipped below."""
 
 import os
 
@@ -27,6 +35,71 @@ _multiprocess_cpu_skip = pytest.mark.skip(
     reason="jaxlib 0.4.37 CPU backend cannot run multiprocess "
            "computations (XLA INVALID_ARGUMENT); needs TPU or a "
            "gloo-enabled jaxlib")
+
+
+def test_worker_group_bootstrap_routes_through_multihost(monkeypatch):
+    """The gang bootstrap is the MULTIHOST subsystem's: WorkerGroup
+    registers a host group and delegates runtime formation to
+    multihost.form_jax_runtime (no second copy of the coordinator/env
+    wiring survives here or in tune's trial path)."""
+    from ray_tpu.core import multihost
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    calls = {}
+    monkeypatch.setattr(
+        multihost, "register_gang",
+        lambda n, **kw: calls.setdefault("register", (n, kw))
+        and None or ("gang-test", 7))
+    monkeypatch.setattr(
+        multihost, "form_jax_runtime",
+        lambda workers, jc, *, group_id, epoch: calls.setdefault(
+            "form", (list(workers), jc, group_id, epoch)))
+    monkeypatch.setattr(
+        multihost, "leave_jax_runtime",
+        lambda workers, group_id=None, timeout=None: calls.setdefault(
+            "leave", (list(workers), group_id)))
+
+    g = WorkerGroup.__new__(WorkerGroup)
+    g.workers = [object(), object()]
+    g.jax_config = JaxConfig(distributed=True, platform="cpu",
+                             local_device_count=2)
+    g._jax_bootstrapped = False
+    g._gang_id = None
+    g._bootstrap_jax()
+    assert calls["register"][0] == 2
+    assert g._jax_bootstrapped and g._gang_id == "gang-test"
+    workers, jc, group_id, epoch = calls["form"]
+    assert workers == g.workers and jc is g.jax_config
+    assert (group_id, epoch) == ("gang-test", 7)
+    g._leave_jax_distributed()
+    assert calls["leave"] == (g.workers, "gang-test")
+
+
+def test_real_two_process_bootstrap_forms_through_gang(
+        ray_start_regular):
+    """The REAL jax.distributed bootstrap across two worker processes
+    (initialize works on the CPU backend — only collectives fail):
+    both workers pass the bootstrap-fingerprint barrier, join one
+    global 4-device view, and the group record lives exactly as long
+    as the gang."""
+    from ray_tpu.core import multihost
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    group = WorkerGroup(2, {"CPU": 1},
+                        jax_config=JaxConfig(distributed=True,
+                                             platform="cpu",
+                                             local_device_count=2))
+    try:
+        group.start(None, "mh_bootstrap_route", None)
+        assert group._gang_id is not None
+        st = multihost.registry_state(group._gang_id)
+        assert st["num_hosts"] == 2 and st["epoch"] == 1
+        assert st["owner"] == "train-worker-group"
+    finally:
+        gang_id = group._gang_id
+        group.shutdown()
+    # Cooperative leave dropped the group record with the gang.
+    assert multihost.registry_state(gang_id) is None
 
 
 @_multiprocess_cpu_skip
