@@ -4,7 +4,7 @@
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
 	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist \
-	bench-obs bench-chaos bench-gang
+	bench-obs bench-chaos bench-gang bench-pipeline
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -79,6 +79,13 @@ bench-chaos:
 # member beat site -> BENCH_SERVE.json rows, merge-preserving.
 bench-gang:
 	JAX_PLATFORMS=cpu python bench_gang.py
+
+# Pipeline-parallel training plane (ISSUE 14): inter-stage activation
+# bytes/s through the object plane at 2/4 stages, 1F1B bubble fraction
+# vs microbatch count, ZeRO-1 per-replica optimizer-state bytes at
+# data=2/4/8 -> BENCH_TUNE.json "rows", merge-preserving.
+bench-pipeline:
+	JAX_PLATFORMS=cpu python bench_pipeline.py
 
 # Podracer substrate scaling rows (env-steps/s + learner updates/s at
 # 1/2/4 rollout actors, parameter-staleness p50/p99) -> BENCH_RL.json

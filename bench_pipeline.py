@@ -1,0 +1,173 @@
+"""Pipeline-parallel training plane bench (ISSUE 14, ROADMAP #5).
+
+Rows (merge-preserving into BENCH_TUNE.json — the existing PBT artifact
+keeps its keys, pipeline rows live under ``"rows"``):
+
+* ``pipe_act_mb_per_s_{n}s``   — inter-stage tensor bytes/s (activations
+  forward + input-gradients backward) through the object plane / RPC
+  write path at 2 and 4 stages;
+* ``pipe_step_s_{n}s``         — wall time of one 8-microbatch optimizer
+  step at that stage count;
+* ``pipe_bubble_frac_m{m}_4s`` — measured bubble fraction (1 − mean
+  stage occupancy / wall) at 4 stages for 2/4/8 microbatches: more
+  microbatches amortize the fill/drain ramps, the 1F1B story;
+* ``zero1_state_ratio_d{n}``   — ZeRO-1 per-replica optimizer-state
+  bytes vs the unsharded optimizer at data = 2/4/8 (acceptance bound:
+  ≤ 0.6 at data=2).
+
+Run: ``make bench-pipeline`` (CPU host, virtual multi-host slice; the
+numbers under measurement are schedule/control-plane shape, not model
+FLOPs — a 1-core box time-slices the stage "hosts").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="write /tmp instead of BENCH_TUNE.json")
+    args = parser.parse_args()
+
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["RAY_TPU_VIRTUAL_SLICE"] = "4x4/4"
+
+    import jax
+    import numpy as np
+    import optax
+
+    import ray_tpu
+    from ray_tpu.models import llama
+    from ray_tpu.train.pipeline_plane import PipelinePlane, microbatches
+
+    cfg = llama.LlamaConfig(vocab_size=128, dim=64, n_layers=4,
+                            n_heads=4, n_kv_heads=2, mlp_dim=128,
+                            max_seq_len=128)
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+
+    def step_data(n_micro, batch=8, seq=65):
+        return microbatches(
+            {"tokens": rng.integers(0, cfg.vocab_size,
+                                    (batch, seq)).astype(np.int32)},
+            n_micro)
+
+    rows = []
+    ray_tpu.init(num_cpus=8)
+    try:
+        # ---------------- activation throughput at 2 / 4 stages
+        for n_stages in (2, 4):
+            plane = PipelinePlane(
+                cfg, params, n_stages=n_stages, n_microbatches=8,
+                lr=1e-3, window=n_stages,
+                name=f"bench-{n_stages}s").start()
+            try:
+                plane.train_step(step_data(8))  # warm the stage jits
+                moved0 = plane.stats()["tensor_bytes_moved"]
+                t0 = time.monotonic()
+                n_steps = 3
+                for _ in range(n_steps):
+                    plane.train_step(step_data(8))
+                wall = time.monotonic() - t0
+                moved = plane.stats()["tensor_bytes_moved"] - moved0
+                rows.append({
+                    "metric": f"pipe_act_mb_per_s_{n_stages}s",
+                    "value": round(moved / wall / 1e6, 2),
+                    "unit": "MB/s",
+                    "note": (f"inter-stage activation+gradient bytes "
+                             f"through the object plane, {n_stages} "
+                             f"stages x 8 microbatches, debug llama "
+                             f"(dim {cfg.dim}, seq 64), cpu host — "
+                             f"{moved} B over {n_steps} steps")})
+                rows.append({
+                    "metric": f"pipe_step_s_{n_stages}s",
+                    "value": round(wall / n_steps, 3), "unit": "s",
+                    "note": (f"one 8-microbatch 1F1B optimizer step at "
+                             f"{n_stages} stages (window {n_stages}), "
+                             f"warm jits, cpu host")})
+            finally:
+                plane.stop()
+
+        # ---------------- bubble fraction vs microbatch count (4 stages)
+        plane = PipelinePlane(cfg, params, n_stages=4, n_microbatches=2,
+                              lr=1e-3, window=4,
+                              name="bench-bubble").start()
+        try:
+            # Per-microbatch batch stays 2 rows at every m (batch=2m),
+            # so every step reuses ONE warmed jit shape per stage.
+            plane.n_microbatches = 8
+            plane.train_step(step_data(8, batch=16))  # warm the jits
+            for m in (2, 4, 8):
+                plane.n_microbatches = m
+                busy0 = plane.stats()["stage_busy_s"]
+                t0 = time.monotonic()
+                plane.train_step(step_data(m, batch=2 * m))
+                wall = time.monotonic() - t0
+                busy = [b - a for a, b in
+                        zip(busy0, plane.stats()["stage_busy_s"])]
+                bubble = 1.0 - sum(busy) / (len(busy) * wall)
+                rows.append({
+                    "metric": f"pipe_bubble_frac_m{m}_4s",
+                    "value": round(bubble, 3), "unit": "frac",
+                    "note": (f"1 - mean stage occupancy / step wall at "
+                             f"4 stages, {m} microbatches (1F1B fill/"
+                             f"drain ramp; the 1-core host time-slices "
+                             f"stages, so the floor is scheduling "
+                             f"overhead, not compute overlap)")})
+        finally:
+            plane.stop()
+    finally:
+        ray_tpu.shutdown()
+
+    # ---------------- ZeRO-1 per-replica optimizer-state bytes
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    opt = optax.adam(1e-3)
+    zcfg = llama.PRESETS["debug"]
+    zparams = llama.init_params(zcfg, jax.random.key(1))
+    for n_data in (2, 4, 8):
+        mesh = MeshSpec(data=n_data, fsdp=1).build(
+            jax.devices()[:n_data])
+        rep = NamedSharding(mesh, P())
+        placed = jax.device_put(
+            jax.tree.map(lambda x: np.array(x), zparams),
+            jax.tree.map(lambda _: rep, zparams))
+        plain = ts.per_replica_state_bytes(
+            ts.init_optimizer_state(opt, placed))
+        z1 = ts.per_replica_state_bytes(
+            ts.init_zero1_opt_state(opt, placed, mesh))
+        rows.append({
+            "metric": f"zero1_state_ratio_d{n_data}",
+            "value": round(z1 / plain, 4), "unit": "x",
+            "note": (f"ZeRO-1 per-replica optimizer-state bytes vs "
+                     f"unsharded adam at data={n_data} (debug llama; "
+                     f"~1/N — indivisible tiny leaves replicate). "
+                     f"Acceptance: <= 0.6 at data=2")})
+
+    out_path = "BENCH_TUNE.json"
+    doc = {}
+    if os.path.exists(out_path) and not args.quick:
+        with open(out_path) as f:
+            doc = json.load(f)
+    emitted = {r["metric"] for r in rows}
+    doc["rows"] = [r for r in doc.get("rows", [])
+                   if r["metric"] not in emitted] + rows
+    if args.quick:
+        out_path = "/tmp/bench_pipeline_quick.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -229,6 +229,14 @@ RESOURCE_METHOD_PAIRS = {
     # Page-allocator refcount sharing (serve/paging.py): an incref pins
     # a pool page a later decref/free must release.
     "incref": "decref",
+    # Pipeline-plane activation-ref ownership (train/pipeline_plane.py
+    # RefLedger): ``ledger.borrow_ref(desc)`` registers an in-flight
+    # ObjectRef the process keeps alive; ``ledger.drop_ref(desc)`` must
+    # run on every exception path (and on stage death, via the
+    # _drop_inflight self-callee) — a desc surviving a raise pins its
+    # activation tensor cluster-wide, the serve ``_add_replica`` leak
+    # shape for ObjectRefs.
+    "borrow_ref": "drop_ref",
 }
 # Slot-pool attributes: ``self._free.pop()`` leases a slot that
 # ``self._free.append(slot)`` returns (DecodeEngine slot discipline);
@@ -266,6 +274,13 @@ RPC_LEASE_PAIRS = {
     # strands the group id and its fencing epoch (the PR 8 _add_replica
     # leak shape, one level up).
     "mh_register_group": "mh_drop_group",
+    # A pipeline record (core/pipereg.py) is the same shape at the
+    # training plane: PipelinePlane._form_record acquires the record
+    # (and its fencing epoch) before pushing stage state, and a partial
+    # formation must drop it on every exception path (discharge lives
+    # in the _abort_formation self-callee) — a leaked record strands
+    # the pipeline id and fences nothing.
+    "pipe_register": "pipe_drop",
 }
 # The RPC verbs lease acquire/release ride on (client.call today;
 # notify releases would also discharge).
@@ -298,7 +313,13 @@ CHECKPOINT_CLASSES = {
 # for training), but they identify which logical axes CAN shard, which
 # is how the row-parallel weights are derived.
 SHARDING_RULES_MODULE = "ray_tpu.parallel.sharding"
-SHARDING_BITEXACT_TABLES = ("DECODE_RULES",)
+# ZERO1_STATE_RULES is bit-exact-contracted for a different reason
+# than DECODE_RULES: optimizer-state sharding annotations touch only
+# elementwise update math, which is safe precisely BECAUSE the table
+# never names an axis that sits in contraction position — the moment a
+# model axis (embed/heads/mlp/...) is added, the same annotations
+# would split reductions of the traced step.
+SHARDING_BITEXACT_TABLES = ("DECODE_RULES", "ZERO1_STATE_RULES")
 SHARDING_TRAIN_TABLE = "DEFAULT_RULES"
 # Module + function names the weight logical-axes tables live in: the
 # train table plus the decode overrides (``decode_param_axes`` re-binds

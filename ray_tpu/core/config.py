@@ -344,6 +344,22 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "extra latency a client adds on top of controller recovery: "
         "after the controller returns, the next retry lands within at "
         "most this long (x1.5 jitter)."),
+    "pipe_step_timeout_s": (float, 120.0,
+        "Wall-clock bound on one pipeline-parallel optimizer step "
+        "(train/pipeline_plane.py): past it the driver raises a typed "
+        "PipelineError naming the per-stage schedule state instead of "
+        "hanging — a wedged stage becomes a diagnosis, not a stall "
+        "(see ray_tpu doctor's pipeline-stall signature)."),
+    "pipe_setup_timeout_s": (float, 120.0,
+        "How long PipelinePlane waits for every stage actor to pull "
+        "its params/optimizer state and compile its programs during "
+        "(re)formation before declaring the setup failed."),
+    "pipe_snapshot_every": (int, 1,
+        "PipelinePlane pulls a driver-owned snapshot of every stage's "
+        "params/optimizer state every N completed optimizer steps — "
+        "the resume point after a whole-gang restart (a snapshot owned "
+        "by a stage actor would die with it). 0 disables snapshots "
+        "(a gang death then restarts training from step 0)."),
     "serve_adopt_timeout_s": (float, 5.0,
         "How long a restarted serve controller pings the replica/proxy "
         "handles from its checkpoint before declaring the stragglers "

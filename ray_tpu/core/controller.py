@@ -171,6 +171,13 @@ class Controller:
         from ray_tpu.core.multihost import GroupRegistry
 
         self.multihost = GroupRegistry()
+        # Pipeline-parallel training registry (core/pipereg.py): epoch-
+        # fenced per-pipeline progress records (the resume point a
+        # re-formed stage gang asks for). Internally locked — accessed
+        # off self._lock.
+        from ray_tpu.core.pipereg import PipelineRegistry
+
+        self.pipelines = PipelineRegistry()
         self._server = RpcServer(
             handlers={
                 "register_node": self.register_node,
@@ -211,6 +218,10 @@ class Controller:
                 "mh_group_put": self.multihost.group_put,
                 "mh_group_get": self.multihost.group_get,
                 "mh_group_state": self.multihost.group_state,
+                "pipe_register": self.pipelines.register,
+                "pipe_drop": self.pipelines.drop,
+                "pipe_step_complete": self.pipelines.step_complete,
+                "pipe_state": self.pipelines.state,
                 "autoscaler_state": self.autoscaler_state,
                 "push_metrics": self.push_metrics,
                 "list_metrics": self.list_metrics,

@@ -193,6 +193,43 @@ MH_BARRIER_WAIT_S = Histogram(
     "Time a member parked in a group rendezvous barrier before "
     "completion or timeout.", boundaries=_BARRIER_BUCKETS)
 
+# ------------------------------------------------------ pipeline plane
+#
+# MPMD pipeline training (train/pipeline_plane.py). The driver-side
+# scheduler owns these series (it sees every dispatch and completion,
+# including the ones a stalled stage never answers): the per-stage idle
+# split is what `ray_tpu doctor`'s pipeline-stall signature reads — a
+# straggler stage is BUSY (idle ~0) while every stage starved behind it
+# idles for the whole window.
+
+# Descriptor sizes: stage RPCs must carry refs + metadata, never
+# tensors; anything near the top buckets means activation bytes leaked
+# into the control path (tests pin the p99 against the budget).
+_DESC_BUCKETS = (128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0, 8192.0,
+                 16384.0)
+
+PIPE_STAGE_IDLE_S = Gauge(
+    "pipeline_stage_idle_s",
+    "Seconds each pipeline stage has been idle (no dispatched work), "
+    "as seen by the driver-side scheduler; 0 while a call is in "
+    "flight. One stage busy while the rest idle for a whole doctor "
+    "window is the pipeline-stall signature.",
+    tag_keys=("pipeline", "stage"))
+PIPE_ACTIVATION_BYTES = Gauge(
+    "pipeline_activation_bytes",
+    "Bytes of activation/gradient tensors currently in flight through "
+    "the object plane for a pipeline (driver ref-ledger accounting; "
+    "returns to 0 between steps).", tag_keys=("pipeline",))
+PIPE_INFLIGHT = Gauge(
+    "pipeline_inflight_microbatches",
+    "Microbatches admitted but not yet fully backpropagated (the 1F1B "
+    "in-flight window actually in use).", tag_keys=("pipeline",))
+PIPE_DESC_BYTES = Histogram(
+    "pipeline_desc_bytes",
+    "Serialized stage-RPC descriptor size (ref + metadata, never "
+    "tensor bytes — the tensors ride the object plane).",
+    boundaries=_DESC_BUCKETS, tag_keys=("pipeline",))
+
 
 # ----------------------------------------------------- cluster summary
 
@@ -296,5 +333,14 @@ def core_summary(aggregated: Dict[str, List[Dict[str, Any]]]
                                           "mh_member_epoch")),
         "barrier_wait_s": _merged_summary(aggregated,
                                           "mh_barrier_wait_s"),
+    }
+    out["pipeline"] = {
+        "inflight_microbatches": sum(gauge_totals(
+            aggregated, "pipeline_inflight_microbatches").values()),
+        "activation_bytes": sum(gauge_totals(
+            aggregated, "pipeline_activation_bytes").values()),
+        "stage_idle_s": _tag_map(gauge_totals(
+            aggregated, "pipeline_stage_idle_s"), "stage"),
+        "desc_bytes": _merged_summary(aggregated, "pipeline_desc_bytes"),
     }
     return out

@@ -834,9 +834,18 @@ class HostGroup:
                  name: Optional[str] = None,
                  max_group_restarts: int = 1,
                  worker_options: Optional[Dict[str, Any]] = None,
+                 worker_cls: Optional[type] = None,
                  owner: str = ""):
         if num_hosts < 1:
             raise ValueError("num_hosts must be >= 1")
+        if worker_cls is not None and not issubclass(worker_cls,
+                                                     HostWorker):
+            # The gang contract (beat loop, fencing, barrier entry,
+            # aligned ctx) lives in HostWorker; a member class that
+            # doesn't extend it would silently opt out of epoch fencing.
+            raise TypeError(f"worker_cls must extend HostWorker, got "
+                            f"{worker_cls!r}")
+        self._worker_cls = worker_cls or HostWorker
         self.group_id = name or f"gang-{uuid.uuid4().hex[:8]}"
         self.num_hosts = int(num_hosts)
         self.max_group_restarts = int(max_group_restarts)
@@ -986,7 +995,7 @@ class HostGroup:
 
         chip_ids = [[origin[0] + i, origin[1] + j]
                     for i in range(shape[0]) for j in range(shape[1])]
-        actor_cls = ray_tpu.remote(HostWorker)
+        actor_cls = ray_tpu.remote(self._worker_cls)
         try:
             for rank in range(self.num_hosts):
                 ctx = {

@@ -197,6 +197,22 @@ class ControllerStub(_StubBase):
     def ping(self, *args, timeout=_UNSET, **kwargs):
         return self._call('ping', *args, timeout=timeout, **kwargs)
 
+    def pipe_drop(self, pipeline_id, *, timeout=_UNSET):
+        return self._call('pipe_drop', pipeline_id, timeout=timeout)
+
+    def pipe_register(self, pipeline_id, num_stages, group_id=_UNSET,
+                      owner=_UNSET, *, timeout=_UNSET):
+        return self._call('pipe_register', pipeline_id, num_stages,
+                          group_id=group_id, owner=owner, timeout=timeout)
+
+    def pipe_state(self, pipeline_id=_UNSET, *, timeout=_UNSET):
+        return self._call('pipe_state', pipeline_id=pipeline_id,
+                          timeout=timeout)
+
+    def pipe_step_complete(self, pipeline_id, step, epoch, *, timeout=_UNSET):
+        return self._call('pipe_step_complete', pipeline_id, step, epoch,
+                          timeout=timeout)
+
     def psub_drop(self, channel, key, *, timeout=_UNSET):
         return self._call('psub_drop', channel, key, timeout=timeout)
 

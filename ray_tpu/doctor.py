@@ -37,6 +37,11 @@ historically became hangs:
   pre-collective, and the STRAGGLER hosts are named — the multi-host
   debugging story (a hung collective itself is invisible; the barrier
   in front of it is not).
+* **pipeline-stall** — one pipeline stage's idle gauge diverges from
+  the rest of its pipeline across the whole window: the busy stage
+  (idle ~0 while everyone else starves behind it) IS the straggler,
+  and is named — a slow/wedged stage otherwise just reads as "training
+  got slower".
 
 ``diagnose`` is a pure function over snapshots so tests inject each
 fault into the REAL components and assert the doctor names it; the CLI
@@ -62,6 +67,8 @@ DEFAULT_THRESHOLDS = {
     "rtt_outlier_floor_s": 0.25,   # never flag RTTs below this
     "rtt_outlier_factor": 5.0,     # x fleet median p99
     "epoch_bumps": 2,              # controller epoch bumps in the window
+    "pipe_stall_idle_s": 0.5,      # starved-stage idle floor (both snaps)
+    "pipe_stall_ratio": 0.3,       # straggler idle <= ratio * max idle
 }
 
 
@@ -369,6 +376,66 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                        "refusal naming the absent members"),
         })
 
+    # -------------------------------------------------- pipeline-stall
+    # A healthy pipeline's stages all cycle busy/idle together; a
+    # straggler stage stays BUSY (idle ~0) while every stage starved
+    # behind it idles. Divergence must hold in BOTH snapshots — a
+    # transient bubble (warmup, between steps) never persists across a
+    # doctor window, a wedged or delay-injected stage does.
+    def _stage_idle(agg) -> Dict[Tuple[str, str], float]:
+        out: Dict[Tuple[str, str], float] = {}
+        for _src, tags, val in _gauge_series(agg,
+                                             "pipeline_stage_idle_s"):
+            out[(tags.get("pipeline", "-"),
+                 tags.get("stage", "-"))] = val
+        return out
+
+    idle_before = _stage_idle(before)
+    idle_after = _stage_idle(after)
+    for pipe in sorted({p for p, _s in idle_after}):
+        st_after = {s: v for (p, s), v in idle_after.items()
+                    if p == pipe}
+        st_before = {s: v for (p, s), v in idle_before.items()
+                     if p == pipe}
+        if len(st_after) < 2 or not st_before:
+            continue  # 1-stage pipelines / not present all window
+
+        def _split_stall(d):
+            mx = max(d.values())
+            if mx < th["pipe_stall_idle_s"]:
+                return set(), set()
+            busy = {s for s, v in d.items()
+                    if v <= th["pipe_stall_ratio"] * mx}
+            return busy, set(d) - busy
+
+        busy_a, idle_a = _split_stall(st_after)
+        busy_b, idle_b = _split_stall(st_before)
+        stragglers = sorted(busy_a & busy_b)
+        starved = sorted(idle_a & idle_b)
+        if not (stragglers and starved):
+            continue
+        worst = max(st_after.values())
+        findings.append({
+            "signature": "pipeline-stall", "severity": "critical",
+            "source": f"pipeline:{pipe}",
+            "summary": (f"pipeline {pipe!r}: stage(s) "
+                        f"{', '.join(stragglers)} stayed busy while "
+                        f"{', '.join(starved)} idled up to "
+                        f"{worst:.1f}s across the whole "
+                        f"{interval_s:.0f}s window — "
+                        f"{', '.join(stragglers)} is the straggler "
+                        f"the rest of the pipeline is starving "
+                        f"behind"),
+            "evidence": {"stragglers": stragglers, "starved": starved,
+                         "stage_idle_s": st_after},
+            "remedy": ("inspect the straggler stage's worker "
+                       "(`ray_tpu stacks`; a dead stage reconciles "
+                       "the whole gang instead — check pipe_state / "
+                       "mh_group_state). pipe_step_timeout_s bounds "
+                       "the stall: past it the driver raises a typed "
+                       "PipelineError naming the schedule state"),
+        })
+
     order = {"critical": 0, "warning": 1}
     findings.sort(key=lambda f: (order.get(f["severity"], 9),
                                  f["signature"], f["source"]))
@@ -391,7 +458,7 @@ def render(findings: List[Dict[str, Any]]) -> str:
         return ("no failure signatures detected (checked: "
                 "rpc-backpressure, reconnect-storm, pubsub-lag, "
                 "ref-leak, heartbeat-rtt-outlier, controller-flapping, "
-                "orphan-replica, gang-hang)")
+                "orphan-replica, gang-hang, pipeline-stall)")
     lines = [f"{len(findings)} finding(s):", ""]
     for i, f in enumerate(findings, 1):
         lines.append(f"[{i}] {f['severity'].upper()} {f['signature']} "

@@ -100,6 +100,87 @@ def split_llama_stages(params: Dict[str, Any], config,
     return stages
 
 
+# ------------------------------------------------------ stage training
+#
+# The MPMD training half ("Scaling Deep Learning Training with MPMD
+# Pipeline Parallelism", PAPERS.md): each stage owns its slice's
+# forward AND backward as two pure jittable programs. The residual a
+# stage keeps between its forward and backward is its INPUT activation
+# (the backward recomputes the stage forward inside ``jax.vjp`` — the
+# same activation-recompute schedule ``config.remat`` already applies
+# within a stage, lifted to stage granularity), so nothing traced ever
+# crosses a process boundary: activations and gradients move as arrays,
+# residual stashes stay stage-local, and 1F1B's memory bound is
+# ``window`` stashed inputs per stage instead of every layer's
+# activations.
+
+
+def llama_stage_loss_fn(config, first: bool) -> Callable:
+    """Last-stage head: ``fn(stage_params, x, targets) -> scalar loss``
+    — the stage forward's fp32 logits fed through the same next-token
+    CE math as ``llama.loss_fn``'s unchunked path (identical ops, so a
+    1-stage pipeline is bit-exact vs the single-process loss)."""
+    base = llama_stage_fn(config, first=first, last=True)
+
+    def fn(p, x, targets):
+        logits = base(p, x)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    return fn
+
+
+def make_stage_train_fns(config, stage_index: int,
+                         n_stages: int) -> Tuple[Callable, Callable]:
+    """``(fwd, bwd)`` pure jittable programs for one training stage.
+
+    Non-last stage: ``fwd(p, x) -> out`` and ``bwd(p, x, g_out) ->
+    (g_params, g_x)``. Last stage: ``fwd(p, x, targets) -> loss`` and
+    ``bwd(p, x, targets) -> (loss, g_params, g_x)`` (cotangent 1.0 on
+    the scalar loss — exactly ``value_and_grad``'s pullback, so the
+    degenerate 1-stage pipeline reproduces the single-process step
+    bit-for-bit). ``x`` is token ids on stage 0, hidden states
+    elsewhere; ``g_x`` on stage 0 is None (token ids have no
+    cotangent). The backward takes the stage INPUT as its residual and
+    recomputes the forward inside ``jax.vjp``."""
+    first, last = stage_index == 0, stage_index == n_stages - 1
+
+    if last:
+        loss_fn = llama_stage_loss_fn(config, first)
+
+        def fwd_last(p, x, targets):
+            return loss_fn(p, x, targets)
+
+        def bwd_last(p, x, targets):
+            one = jnp.ones((), jnp.float32)
+            if first:
+                loss, pullback = jax.vjp(
+                    lambda pp: loss_fn(pp, x, targets), p)
+                (g_params,) = pullback(one)
+                return loss, g_params, None
+            loss, pullback = jax.vjp(
+                lambda pp, xx: loss_fn(pp, xx, targets), p, x)
+            g_params, g_x = pullback(one)
+            return loss, g_params, g_x
+
+        return fwd_last, bwd_last
+
+    stage_fn = llama_stage_fn(config, first, last=False)
+
+    def bwd(p, x, g_out):
+        if first:
+            _out, pullback = jax.vjp(lambda pp: stage_fn(pp, x), p)
+            (g_params,) = pullback(g_out)
+            return g_params, None
+        _out, pullback = jax.vjp(stage_fn, p, x)
+        g_params, g_x = pullback(g_out)
+        return g_params, g_x
+
+    return stage_fn, bwd
+
+
 def make_stage_worker(config, stage_index: int, n_stages: int,
                       stage_params: Dict[str, Any]) -> Callable:
     """A host-callable closure for one pipeline stage, jitted lazily in

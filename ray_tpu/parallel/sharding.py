@@ -124,6 +124,25 @@ DECODE_RULES: Rules = {
 }
 
 
+# ZeRO-1 optimizer-state overlay ("Automatic Cross-Replica Sharding of
+# Weight Update in Data-Parallel Training", PAPERS.md): optimizer state
+# (mu/nu, fp32 master copies) is sharded across the DATA axis as a
+# sharding annotation — each replica keeps 1/N of the state, updates its
+# shard, and the updated params are all-gathered once per step
+# (train_step.build_zero1_train_step pins this with out_shardings).
+# The table deliberately uses a STATE-ONLY logical axis name: optimizer
+# state is elementwise math, so sharding it can never split a
+# reduction — but the moment a MODEL axis name (embed/heads/mlp/...)
+# appears here, the same annotations would partition contraction dims
+# of the traced step. graftlint's sharding-partitioned-contraction rule
+# polices exactly that (ZERO1_STATE_RULES is a bit-exactness table:
+# an entry naming an axis that appears in contraction position at any
+# einsum/dot site in models/ or parallel/ fails `make lint`).
+ZERO1_STATE_RULES: Rules = {
+    "zero1_shard": "data",
+}
+
+
 def decode_rules(config, mesh: Mesh) -> Rules:
     """DECODE_RULES specialized to a config + mesh: a dim only shards
     over "model" when its size divides the axis (an indivisible head or

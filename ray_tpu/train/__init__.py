@@ -21,3 +21,20 @@ from ray_tpu.train.session import (  # noqa: F401
     report,
 )
 from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401
+
+
+def __getattr__(name):
+    # PipelinePlane pulls in the multihost/actor stack; keep the
+    # common `from ray_tpu import train` import light by resolving the
+    # pipeline plane lazily. importlib, NOT a from-import: `from
+    # ray_tpu.train import pipeline_plane` consults THIS __getattr__
+    # before importing the submodule — infinite recursion.
+    if name in ("PipelinePlane", "StageActor", "PipelineError",
+                "pipeline_plane"):
+        import importlib
+
+        mod = importlib.import_module("ray_tpu.train.pipeline_plane")
+        if name == "pipeline_plane":
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(name)

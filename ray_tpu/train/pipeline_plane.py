@@ -1,0 +1,1112 @@
+"""Pipeline-parallel training plane: MPMD stage actors on a HostGroup.
+
+The training half of ROADMAP #5 ("Scaling Deep Learning Training with
+MPMD Pipeline Parallelism", PAPERS.md), built on three planes that
+already exist:
+
+* **Stages are actors** (:class:`StageActor`, one per host of an
+  ICI-contiguous sub-slice) gang-placed through
+  :class:`~ray_tpu.core.multihost.HostGroup` — placement is
+  all-or-nothing (a refusal feeds the autoscaler's pending demand and
+  no stage ever spawns), membership beats fence deposed epochs, and ONE
+  stage dying reconciles the WHOLE gang under a bumped epoch.
+* **Tensors ride the object plane, RPCs carry descriptors** (the PR 10
+  ``TrajectoryShard`` idiom): a stage ``put()``s its output activation
+  (or input-gradient) and ships only ``{ref, mb, nbytes, ...}`` — a few
+  hundred bytes against :data:`PIPE_DESC_BYTE_BUDGET`, pinned by the
+  ``pipeline_desc_bytes`` histogram — while the actual tensor bytes
+  move through the PR 1 non-blocking scatter-gather write path on the
+  consumer's pull.
+* **The schedule is driver-side 1F1B**: the plane dispatches at most
+  one compute call per stage (a stage IS one compute unit), prefers
+  backward over forward when both are ready (the 1F1B rule that bounds
+  stashed activations), and admits new microbatches only while fewer
+  than ``window`` are in flight. Each stage's backward residual is its
+  INPUT activation — the backward recomputes the stage forward inside
+  ``jax.vjp`` (``parallel.pipeline.make_stage_train_fns``) — so a
+  stage stashes at most ``window`` microbatch inputs, never per-layer
+  activations.
+
+Data contract (loss parity): per-stage gradients accumulate in fp32 in
+microbatch order and divide by the microbatch count before ONE
+optimizer update per stage per step — the same math as the
+single-process accumulation loop (:func:`single_process_baseline`), so
+the 1-stage degenerate pipeline is bit-exact against the local run of
+the same stage programs and multi-stage runs match the full-model
+baseline within the repo's relative-tolerance bounds (f32
+reduction-order drift under XLA fusion differences).
+
+Failure model: a stage death is a WHOLE-GANG event (HostGroup
+reconciles: kill all, release the sub-slice exactly once, re-form
+under epoch+1). The plane detects the epoch bump (or the failed call),
+drops every in-flight activation ref (:class:`RefLedger` — zero leaked
+refs is a ``stop()`` contract, not a hope), re-registers the pipeline
+(``pipe_register`` bumps the registry epoch, fencing any straggler
+``pipe_step_complete`` from the dead incarnation), re-pushes the last
+driver-owned snapshot to the fresh gang and REPLAYS the interrupted
+step — training resumes from the last completed optimizer step.
+
+Fault-injection sites: ``pipeline.stage.<pipeline>.<stage>.fwd``
+(stage-side forward entry — a ``delay`` rule makes that stage the
+straggler the doctor's pipeline-stall signature must name); stage
+SIGKILL rides the inherited member beat site
+(``multihost.member.<group>.<member>.beat``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.core.errors import RayTpuError
+from ray_tpu.core.multihost import HostGroup, HostWorker
+from ray_tpu.util import faultinject
+from ray_tpu.util.ratelimit import log_every
+
+logger = logging.getLogger(__name__)
+
+# A stage RPC is metadata-only by contract; anything close to this many
+# serialized bytes means tensor bytes leaked into the control path
+# (pinned by tests/test_pipeline_plane.py off the pipeline_desc_bytes
+# histogram).
+PIPE_DESC_BYTE_BUDGET = 8192
+
+
+class PipelineError(RayTpuError):
+    """Typed pipeline-plane failure: formation refused twice, the gang
+    exhausted its restart budget, or a step exceeded
+    ``pipe_step_timeout_s`` (the schedule state is in the message — a
+    deadlock surfaces as a diagnosis, never a hang)."""
+
+
+class _GangDisrupted(Exception):
+    """Internal: a stage call failed / the gang epoch moved mid-step —
+    drop in-flight refs and replay the step on the re-formed gang."""
+
+
+# =====================================================================
+# Activation-ref ownership ledger
+# =====================================================================
+
+
+class RefLedger:
+    """Tracks every in-flight activation/gradient descriptor this
+    process holds a live ObjectRef through. ``borrow_ref`` on receipt,
+    ``drop_ref`` when the consuming stage's reply lands — and on EVERY
+    exception path and on stage death (the serve ``_add_replica`` leak
+    shape, for ObjectRefs: graftlint's resource-leak-path rule pairs
+    the two verbs, ``rules.RESOURCE_METHOD_PAIRS``). A ref that stays
+    in the ledger pins tensor bytes cluster-wide; the ledger count is
+    the ``pipeline_activation_bytes``/``inflight`` gauge source and
+    must be zero after ``stop()``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live: Dict[int, Dict[str, Any]] = {}
+
+    def borrow_ref(self, desc: Dict[str, Any]):
+        """Register a descriptor whose ``ref`` this process now keeps
+        alive; returns the ref for immediate use."""
+        with self._lock:
+            self._live[id(desc)] = desc
+        return desc.get("ref")
+
+    def drop_ref(self, desc: Dict[str, Any]) -> bool:
+        """Forget a descriptor (idempotent). The ObjectRef handle dies
+        with the ledger entry, so the owner may free the tensor."""
+        with self._lock:
+            return self._live.pop(id(desc), None) is not None
+
+    def live(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._live.values())
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def live_bytes(self) -> int:
+        with self._lock:
+            return sum(int(d.get("nbytes", 0))
+                       for d in self._live.values())
+
+
+# =====================================================================
+# The stage actor
+# =====================================================================
+
+
+class StageActor(HostWorker):
+    """One pipeline stage: a gang member (inherits the HostGroup beat
+    loop, epoch fencing and barrier entry) that owns its layer slice's
+    params + optimizer state and two jitted programs (stage forward,
+    stage backward-with-recompute). Compute calls are driver-serialized
+    (the scheduler dispatches at most one per stage) and additionally
+    guarded by ``_compute_lock`` so gang-control traffic (ping/beat)
+    can stay concurrent."""
+
+    def __init__(self, ctx: Dict[str, Any]):
+        super().__init__(ctx)
+        self._compute_lock = threading.Lock()
+        self._ledger = RefLedger()
+        self._spec: Optional[Dict[str, Any]] = None
+        self._stash: Dict[int, Any] = {}
+        self._g_acc = None
+        self._losses: Dict[int, float] = {}
+        self._step = 0
+
+    # ------------------------------------------------------- formation
+
+    def setup_stage(self, spec: Dict[str, Any],
+                    state_desc: Dict[str, Any]) -> Dict[str, Any]:
+        """Configure this member as pipeline stage ``spec['stage']``:
+        pull the state blob (params / optimizer state / step) from the
+        object plane, build the stage programs, reset schedule state.
+        Idempotent per (re)formation — a fresh gang member starts
+        unconfigured and the plane pushes the resume snapshot here."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.parallel.pipeline import make_stage_train_fns
+
+        ref = self._ledger.borrow_ref(state_desc)
+        try:
+            import ray_tpu
+
+            state = ray_tpu.get(ref, timeout=60.0)
+        finally:
+            self._ledger.drop_ref(state_desc)
+        with self._compute_lock:
+            cfg = spec["config"]
+            stage, n_stages = int(spec["stage"]), int(spec["n_stages"])
+            fwd, bwd = make_stage_train_fns(cfg, stage, n_stages)
+            self._fwd = jax.jit(fwd)
+            self._bwd = jax.jit(bwd)
+            self._optimizer = optax.adam(float(spec["lr"]))
+            self._params = jax.tree.map(jnp.asarray, state["params"])
+            if state.get("opt_state") is not None:
+                self._opt_state = jax.tree.map(jnp.asarray,
+                                               state["opt_state"])
+            else:
+                self._opt_state = self._optimizer.init(self._params)
+            self._apply = jax.jit(self._make_apply())
+            self._spec = dict(spec)
+            self._stash.clear()
+            self._losses.clear()
+            self._g_acc = None
+            self._step = int(state.get("step", 0))
+            return {"stage": stage, "step": self._step}
+
+    def _make_apply(self):
+        import jax
+        import optax
+
+        def apply(params, opt_state, g_acc, n_micro):
+            grads = jax.tree.map(lambda g: g / n_micro, g_acc)
+            updates, new_opt = self._optimizer.update(grads, opt_state,
+                                                      params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, optax.global_norm(grads)
+
+        return apply
+
+    # -------------------------------------------------------- schedule
+
+    def _pull(self, desc: Dict[str, Any]):
+        """Resolve a descriptor's tensor from the object plane; the
+        local borrow is net-zero (dropped in the finally) — the
+        DRIVER's ledger owns the in-flight ref."""
+        import jax.numpy as jnp
+        import ray_tpu
+
+        ref = self._ledger.borrow_ref(desc)
+        try:
+            return jnp.asarray(ray_tpu.get(ref, timeout=60.0))
+        finally:
+            self._ledger.drop_ref(desc)
+
+    def _ship(self, kind: str, mb: int, value) -> Dict[str, Any]:
+        """Put a tensor into the object plane and build the descriptor
+        that rides the RPC reply instead of it."""
+        import ray_tpu
+
+        arr = np.asarray(value)
+        ref = ray_tpu.put(arr)
+        return {"kind": kind, "mb": int(mb),
+                "stage": int(self._spec["stage"]), "ref": ref,
+                "nbytes": int(arr.nbytes), "shape": tuple(arr.shape),
+                "dtype": str(arr.dtype)}
+
+    def forward(self, mb: int, in_desc: Dict[str, Any],
+                tgt_desc: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+        """One microbatch forward. Stage 0 receives token ids, later
+        stages hidden states; the LAST stage also receives targets and
+        returns the scalar loss (no tensor ships). The input is stashed
+        as this microbatch's backward residual."""
+        from ray_tpu.core.config import config
+
+        spec = self._spec
+        if spec is None:
+            raise PipelineError("stage not configured (setup_stage "
+                                "first)")
+        if config.faultinject_path:
+            faultinject.check(
+                f"pipeline.stage.{spec['pipeline']}.{spec['stage']}.fwd")
+        last = int(spec["stage"]) == int(spec["n_stages"]) - 1
+        # Pulls stay OUTSIDE the compute lock: the object-plane read
+        # must never serialize behind a running jit program (or vice
+        # versa — gang control traffic shares this actor).
+        x = self._pull(in_desc)
+        targets = self._pull(tgt_desc) if last else None
+        with self._compute_lock:
+            if last:
+                self._stash[int(mb)] = (x, targets)
+                loss = self._fwd(self._params, x, targets)
+                self._losses[int(mb)] = float(loss)
+                return {"kind": "loss", "mb": int(mb),
+                        "stage": int(spec["stage"]),
+                        "loss": float(loss)}
+            self._stash[int(mb)] = x
+            out = self._fwd(self._params, x)
+            return self._ship("act", mb, out)
+
+    def backward(self, mb: int,
+                 g_desc: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        """One microbatch backward: consume the stashed residual,
+        recompute the stage forward inside ``jax.vjp``, accumulate the
+        fp32 param gradient (microbatch order — the driver dispatches
+        backwards in order), ship the input gradient upstream (stage 0
+        ships nothing: token ids have no cotangent)."""
+        import jax
+
+        spec = self._spec
+        first = int(spec["stage"]) == 0
+        last = int(spec["stage"]) == int(spec["n_stages"]) - 1
+        g_out = None if last else self._pull(g_desc)
+        with self._compute_lock:
+            residual = self._stash.pop(int(mb))
+            if last:
+                x, targets = residual
+                _loss, g_params, g_x = self._bwd(self._params, x,
+                                                 targets)
+            else:
+                g_params, g_x = self._bwd(self._params, residual, g_out)
+            if self._g_acc is None:
+                self._g_acc = jax.tree.map(
+                    lambda g: g.astype("float32"), g_params)
+            else:
+                self._g_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), self._g_acc,
+                    g_params)
+            if first:
+                return {"kind": "bwd0", "mb": int(mb), "stage": 0}
+            return self._ship("grad", mb, g_x)
+
+    def apply_update(self, n_micro: int, step: int) -> Dict[str, Any]:
+        """One optimizer update from the accumulated gradients (mean
+        over microbatches). ``step`` must match this stage's clock —
+        a re-formed gang resuming from a snapshot must never double-
+        apply."""
+        with self._compute_lock:
+            if step != self._step:
+                raise PipelineError(
+                    f"stage {self._spec['stage']} asked to apply step "
+                    f"{step} but its clock is {self._step} (snapshot "
+                    f"resume drift)")
+            if self._stash:
+                raise PipelineError(
+                    f"apply_update with {len(self._stash)} residuals "
+                    f"still stashed (schedule bug)")
+            self._params, self._opt_state, gnorm = self._apply(
+                self._params, self._opt_state, self._g_acc,
+                float(n_micro))
+            self._g_acc = None
+            losses, self._losses = self._losses, {}
+            self._step += 1
+            return {"stage": int(self._spec["stage"]),
+                    "step": self._step, "grad_norm": float(gnorm),
+                    "losses": losses}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Host copies of this stage's state, returned BY VALUE so the
+        driver owns the bytes (an object-plane ref owned by this actor
+        would die with it — the whole point of the snapshot is to
+        outlive the gang)."""
+        import jax
+
+        with self._compute_lock:
+            return {
+                "stage": int(self._spec["stage"]),
+                "step": self._step,
+                "params": jax_to_numpy(self._params),
+                "opt_state": jax_to_numpy(self._opt_state),
+            }
+
+    def stage_stats(self) -> Dict[str, Any]:
+        with self._compute_lock:
+            return {"stage": (None if self._spec is None
+                              else int(self._spec["stage"])),
+                    "step": self._step,
+                    "stashed": len(self._stash),
+                    "ledger": self._ledger.count()}
+
+
+# =====================================================================
+# Single-process baselines (parity + bench)
+# =====================================================================
+
+
+def microbatches(batch: Dict[str, np.ndarray],
+                 n_micro: int) -> List[Dict[str, np.ndarray]]:
+    """Split a ``{"tokens": (B, S+1)}`` batch into ``n_micro``
+    inputs/targets microbatches along the batch dim."""
+    toks = np.asarray(batch["tokens"])
+    if toks.shape[0] % n_micro:
+        raise ValueError(f"batch {toks.shape[0]} not divisible into "
+                         f"{n_micro} microbatches")
+    out = []
+    for part in np.split(toks, n_micro):
+        out.append({"inputs": part[:, :-1].astype(np.int32),
+                    "targets": part[:, 1:].astype(np.int32)})
+    return out
+
+
+def single_process_baseline(config, params, lr: float,
+                            step_batches: List[List[Dict[str, Any]]],
+                            n_stages: Optional[int] = None
+                            ) -> Tuple[List[float], Any]:
+    """The in-process reference the pipeline's loss curve is checked
+    against: per-microbatch grads accumulated fp32 in order, divided by
+    the count, one adam update per step — the pipeline's exact data
+    contract. ``n_stages=None`` runs the full model through
+    ``llama.loss_fn`` (independent math; relative-tolerance parity);
+    ``n_stages=k`` chains the SAME stage programs the actors jit
+    (bit-exactness reference for the degenerate configs)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.pipeline import (make_stage_train_fns,
+                                           split_llama_stages)
+
+    optimizer = optax.adam(lr)
+
+    if n_stages is None:
+        vg = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn(p, b, config)))
+        params = jax.tree.map(jnp.asarray, params)
+        opt_state = optimizer.init(params)
+
+        @jax.jit
+        def apply(p, s, g, n):
+            g = jax.tree.map(lambda x: x / n, g)
+            updates, s = optimizer.update(g, s, p)
+            return optax.apply_updates(p, updates), s
+
+        losses = []
+        for mbs in step_batches:
+            g_acc, step_losses = None, []
+            for mb in mbs:
+                loss, g = vg(params, {"inputs": mb["inputs"],
+                                      "targets": mb["targets"]})
+                step_losses.append(float(loss))
+                g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
+                g_acc = g if g_acc is None else jax.tree.map(
+                    lambda a, b: a + b, g_acc, g)
+            params, opt_state = apply(params, opt_state, g_acc,
+                                      float(len(mbs)))
+            losses.append(float(np.mean(np.asarray(step_losses,
+                                                   np.float32))))
+        return losses, params
+
+    stages = split_llama_stages(params, config, n_stages)
+    stage_params = [jax.tree.map(jnp.asarray, p) for p, _fn in stages]
+    fns = [make_stage_train_fns(config, i, n_stages)
+           for i in range(n_stages)]
+    fwds = [jax.jit(f) for f, _b in fns]
+    bwds = [jax.jit(b) for _f, b in fns]
+    opt_states = [optimizer.init(p) for p in stage_params]
+
+    @jax.jit
+    def apply(p, s, g, n):
+        g = jax.tree.map(lambda x: x / n, g)
+        updates, s = optimizer.update(g, s, p)
+        return optax.apply_updates(p, updates), s
+
+    losses = []
+    for mbs in step_batches:
+        g_accs = [None] * n_stages
+        step_losses = []
+        for mb in mbs:
+            acts = [jnp.asarray(mb["inputs"])]
+            for i in range(n_stages - 1):
+                acts.append(fwds[i](stage_params[i], acts[i]))
+            targets = jnp.asarray(mb["targets"])
+            loss = fwds[-1](stage_params[-1], acts[-1], targets)
+            step_losses.append(float(loss))
+            _loss, gp, gx = bwds[-1](stage_params[-1], acts[-1],
+                                     targets)
+            grads = {n_stages - 1: gp}
+            for i in range(n_stages - 2, -1, -1):
+                gp, gx = bwds[i](stage_params[i], acts[i], gx)
+                grads[i] = gp
+            for i in range(n_stages):
+                g = jax.tree.map(lambda x: x.astype(jnp.float32),
+                                 grads[i])
+                g_accs[i] = g if g_accs[i] is None else jax.tree.map(
+                    lambda a, b: a + b, g_accs[i], g)
+        for i in range(n_stages):
+            stage_params[i], opt_states[i] = apply(
+                stage_params[i], opt_states[i], g_accs[i],
+                float(len(mbs)))
+        losses.append(float(np.mean(np.asarray(step_losses,
+                                               np.float32))))
+    return losses, stage_params
+
+
+# =====================================================================
+# The driver-side plane
+# =====================================================================
+
+# pid-scoped unique names, the rl.distributed.new_plane_key idiom.
+_pipe_counter = itertools.count(1)
+
+
+def _new_pipe_name() -> str:
+    return f"pipe-{os.getpid()}-{next(_pipe_counter)}"
+
+
+class PipelinePlane:
+    """Driver-side pipeline: gang placement, 1F1B scheduling, ref
+    ownership, metrics, snapshots and whole-gang restart recovery. See
+    the module docstring for the contract."""
+
+    def __init__(self, config, params, *, n_stages: int,
+                 n_microbatches: int, lr: float = 1e-3,
+                 window: Optional[int] = None,
+                 name: Optional[str] = None,
+                 chips_per_host: Optional[int] = None,
+                 max_group_restarts: int = 2,
+                 snapshot_every: Optional[int] = None):
+        from ray_tpu.core.config import config as rt_config
+
+        if n_stages < 1:
+            raise ValueError("n_stages must be >= 1")
+        if config.n_layers < n_stages:
+            raise ValueError(f"{config.n_layers} layers cannot split "
+                             f"into {n_stages} stages")
+        self.config = config
+        self.n_stages = int(n_stages)
+        self.n_microbatches = int(n_microbatches)
+        self.lr = float(lr)
+        self.window = int(window) if window else self.n_stages
+        self.name = name or _new_pipe_name()
+        self._chips_per_host = chips_per_host
+        self._max_group_restarts = int(max_group_restarts)
+        self._snapshot_every = (rt_config.pipe_snapshot_every
+                                if snapshot_every is None
+                                else int(snapshot_every))
+        self._init_params = params
+        self._group: Optional[HostGroup] = None
+        self._lock = threading.Lock()
+        self._ledger = RefLedger()
+        self._epoch = 0             # pipe-registry epoch (fencing)
+        self._gang_epoch = 0        # group epoch the stages were set up under
+        self._step = 0              # next optimizer step to run
+        self._snapshot: Optional[Dict[str, Any]] = None
+        self._losses: List[float] = []
+        self._stage_last_event = [time.monotonic()] * self.n_stages
+        self._stage_busy: List[Optional[Any]] = [None] * self.n_stages
+        self._stage_busy_since: List[float] = [0.0] * self.n_stages
+        # Cumulative dispatch->reply occupancy per stage (bench reads
+        # deltas: bubble fraction = 1 - sum(busy)/(stages * wall)).
+        self._stage_busy_s: List[float] = [0.0] * self.n_stages
+        # Cumulative inter-stage tensor bytes (activations forward +
+        # input-gradients backward) moved through the object plane.
+        self._tensor_bytes_moved = 0
+        self._inflight_mbs = 0
+        from ray_tpu.util import metrics as um
+
+        um.add_collector(self._collect)
+
+    # ------------------------------------------------------- formation
+
+    def start(self) -> "PipelinePlane":
+        """Gang-place the stages (all-or-nothing through the HostGroup
+        sub-slice reservation) and register the pipeline record. Both
+        acquisitions are discharged on every exception path between
+        acquire and the handoff to ``self`` — a partial formation
+        strands neither a gang nor a fenced pipeline record."""
+        group = HostGroup(
+            self.n_stages, name=f"{self.name}-gang",
+            chips_per_host=self._chips_per_host,
+            max_group_restarts=self._max_group_restarts,
+            worker_cls=StageActor,
+            owner=f"pipeline:{self.name}").start()
+        self._form_record(group)
+        return self
+
+    def _form_record(self, group: HostGroup) -> None:
+        """Register the pipeline record, set the fresh gang up, hand
+        both to ``self`` (the lease local ``reg`` stays a subscript
+        borrow through the fallible region; discharge lives in the
+        ``_abort_formation`` self-callee)."""
+        from ray_tpu.core.rpc_stubs import ControllerStub
+
+        stub = ControllerStub(_controller_client())
+        reg = stub.pipe_register(self.name, self.n_stages,
+                                 group.group_id,
+                                 f"pid:{os.getpid()}")
+        try:
+            self._setup_stages(group, int(reg["epoch"]))
+        except BaseException:
+            self._abort_formation(stub, group)
+            raise
+        self._commit_formation(group, reg)
+
+    def _abort_formation(self, stub, group: HostGroup) -> None:
+        """Partial-formation cleanup: drop the pipeline record and tear
+        the gang down — each best-effort in its own guard, so a head
+        blip during one cannot strand the other."""
+        try:
+            stub.pipe_drop(self.name)
+        except Exception:
+            log_every("pipeline.abort_drop", 10.0, logger,
+                      "dropping pipeline %s during formation abort "
+                      "failed", self.name, exc_info=True)
+        try:
+            group.shutdown()
+        except Exception:
+            log_every("pipeline.abort_gang", 10.0, logger,
+                      "tearing down gang of pipeline %s during "
+                      "formation abort failed", self.name,
+                      exc_info=True)
+
+    def _commit_formation(self, group: HostGroup, reg) -> None:
+        with self._lock:
+            self._group = group
+            self._epoch = int(reg["epoch"])
+
+    def _adopt_epoch(self, reg) -> None:
+        with self._lock:
+            self._epoch = int(reg["epoch"])
+
+    def _setup_stages(self, group: HostGroup, epoch: int) -> None:
+        """Push per-stage state to a fresh gang: the resume snapshot if
+        one exists, else the initial split. Stage state rides the
+        object plane (driver-owned refs, dropped once every stage has
+        pulled its blob)."""
+        import ray_tpu
+        from ray_tpu.core.config import config as rt_config
+        from ray_tpu.parallel.pipeline import split_llama_stages
+
+        if self._snapshot is not None:
+            states = [
+                {"params": s["params"], "opt_state": s["opt_state"],
+                 "step": s["step"]}
+                for s in self._snapshot["stages"]]
+            resume_step = int(self._snapshot["step"])
+        else:
+            stages = split_llama_stages(self._init_params, self.config,
+                                        self.n_stages)
+            states = [{"params": jax_to_numpy(p), "opt_state": None,
+                       "step": 0} for p, _fn in stages]
+            resume_step = 0
+        members = group.members
+        descs, refs = [], []
+        try:
+            for i, state in enumerate(states):
+                desc = {"kind": "state", "stage": i,
+                        "ref": ray_tpu.put(state)}
+                self._ledger.borrow_ref(desc)
+                descs.append(desc)
+                spec = {"pipeline": self.name, "stage": i,
+                        "n_stages": self.n_stages, "config": self.config,
+                        "lr": self.lr, "epoch": epoch}
+                refs.append(members[i].setup_stage.remote(spec, desc))
+            replies = ray_tpu.get(refs,
+                                  timeout=rt_config.pipe_setup_timeout_s)
+        finally:
+            for desc in descs:
+                self._ledger.drop_ref(desc)
+        for i, rep in enumerate(replies):
+            if int(rep["step"]) != resume_step:
+                raise PipelineError(
+                    f"stage {i} resumed at step {rep['step']}, plane "
+                    f"expected {resume_step}")
+        with self._lock:
+            self._step = resume_step
+            self._gang_epoch = group.epoch
+            self._stage_busy = [None] * self.n_stages
+            now = time.monotonic()
+            self._stage_last_event = [now] * self.n_stages
+            self._inflight_mbs = 0
+
+    # -------------------------------------------------------- recovery
+
+    def _ensure_gang(self) -> None:
+        """Before (re)running a step: if the gang was reconciled under
+        a new epoch since the stages were set up, wait for it to be
+        ALIVE, re-register the pipeline (epoch bump fences the dead
+        incarnation's step reports) and re-push the snapshot."""
+        group = self._group
+        if group is None:
+            raise PipelineError(f"pipeline {self.name} not started")
+        deadline = time.monotonic() + 60.0
+        while True:
+            state, epoch = group.state, group.epoch
+            if state == "ALIVE" and epoch == self._gang_epoch:
+                return
+            if state == "ALIVE":
+                break  # re-formed gang: needs a fresh setup
+            if state in ("DEAD", "SHUTDOWN"):
+                raise PipelineError(
+                    f"pipeline {self.name}: gang is {state} "
+                    f"({group.status()['death_cause']})")
+            if time.monotonic() > deadline:
+                raise PipelineError(
+                    f"pipeline {self.name}: gang stuck in {state}")
+            time.sleep(0.05)
+        from ray_tpu.core.rpc_stubs import ControllerStub
+
+        stub = ControllerStub(_controller_client())
+        # Re-registration bumps the record's epoch (fencing the dead
+        # incarnation's in-flight reports); the record itself already
+        # belongs to this plane, so ownership hands off to self BEFORE
+        # the fallible setup — a failed setup keeps the registration
+        # (the next attempt re-registers and bumps again).
+        reg = stub.pipe_register(self.name, self.n_stages,
+                                 group.group_id,
+                                 f"pid:{os.getpid()}")
+        self._adopt_epoch(reg)
+        self._setup_stages(group, self._epoch)
+        logger.info(
+            "pipeline %s: re-formed gang adopted (gang epoch %d, "
+            "pipeline epoch %d), resuming from step %d", self.name,
+            self._gang_epoch, self._epoch, self._step)
+
+    def _await_reconcile(self) -> None:
+        """After a mid-step disruption: the gang monitor needs a beat
+        to notice a dead member and reconcile — replaying against the
+        old incarnation's corpses just burns attempts. Park until the
+        group epoch moves (reconciliation happened; _ensure_gang will
+        re-push the snapshot), the group leaves ALIVE (reconciling/
+        dead), or every member answers a ping (the disruption was
+        transient — replay on the live gang)."""
+        import ray_tpu
+
+        group = self._group
+        if group is None:
+            return
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if group.epoch != self._gang_epoch \
+                    or group.state != "ALIVE":
+                return
+            members = group.members
+            try:
+                ray_tpu.get([m.ping.remote() for m in members],
+                            timeout=2.0)
+                return  # whole gang answers: transient, replay now
+            except Exception:
+                time.sleep(0.2)  # dead member: wait for the monitor
+
+    def _drop_inflight(self) -> int:
+        """Drop every in-flight activation/gradient ref — the abort
+        path (stage death, step failure). Refs whose owner died with
+        its stage free on the owner side; the driver's handles must not
+        pin the rest."""
+        dropped = 0
+        for desc in self._ledger.live():
+            if self._ledger.drop_ref(desc):
+                dropped += 1
+        with self._lock:
+            self._stage_busy = [None] * self.n_stages
+            self._inflight_mbs = 0
+        return dropped
+
+    # -------------------------------------------------------- training
+
+    def train_step(self, mbs: List[Dict[str, Any]]) -> float:
+        """Run ONE optimizer step over ``mbs`` microbatches with the
+        1F1B schedule; returns the mean microbatch loss. A whole-gang
+        disruption mid-step drops the in-flight window and REPLAYS the
+        step on the re-formed gang (same data — the resume contract)."""
+        if len(mbs) != self.n_microbatches:
+            raise ValueError(f"expected {self.n_microbatches} "
+                             f"microbatches, got {len(mbs)}")
+        attempts = self._max_group_restarts + 1
+        for attempt in range(attempts):
+            self._ensure_gang()
+            try:
+                return self._run_step_once(mbs)
+            except _GangDisrupted as e:
+                dropped = self._drop_inflight()
+                logger.warning(
+                    "pipeline %s: step %d disrupted (%s); dropped %d "
+                    "in-flight refs, replaying on the re-formed gang "
+                    "(attempt %d/%d)", self.name, self._step, e,
+                    dropped, attempt + 1, attempts)
+                self._await_reconcile()
+        raise PipelineError(
+            f"pipeline {self.name}: step {self._step} failed after "
+            f"{attempts} gang incarnations")
+
+    def run(self, step_batches: List[List[Dict[str, Any]]]
+            ) -> List[float]:
+        """Convenience loop: one ``train_step`` per entry."""
+        return [self.train_step(mbs) for mbs in step_batches]
+
+    # The 1F1B scheduler. One dispatch per stage (a stage is one
+    # compute unit); backward preferred over forward (bounds the
+    # stash); admission gated by the in-flight window.
+    def _run_step_once(self, mbs: List[Dict[str, Any]]) -> float:
+        import ray_tpu
+        from ray_tpu.core.config import config as rt_config
+        from ray_tpu.core.serialization import serialized_size
+
+        group = self._group
+        members = group.members
+        if len(members) != self.n_stages:
+            raise _GangDisrupted("gang re-forming (member list short)")
+        S, n = self.n_stages, len(mbs)
+        last = S - 1
+        ready_fwd: List[deque] = [deque() for _ in range(S)]
+        ready_bwd: List[deque] = [deque() for _ in range(S)]
+        task_by_ref: Dict[Any, Tuple[str, int, int,
+                                     Optional[Dict[str, Any]]]] = {}
+        tgt_descs: Dict[int, Dict[str, Any]] = {}
+        losses: Dict[int, float] = {}
+        admitted = retired = 0
+        deadline = time.monotonic() + rt_config.pipe_step_timeout_s
+
+        try:
+            def admit() -> None:
+                nonlocal admitted
+                while (admitted < n
+                       and admitted - retired < self.window):
+                    m = admitted
+                    tok = {"kind": "tok", "mb": m,
+                           "ref": ray_tpu.put(mbs[m]["inputs"]),
+                           "nbytes": int(mbs[m]["inputs"].nbytes)}
+                    self._ledger.borrow_ref(tok)
+                    tgt = {"kind": "tgt", "mb": m,
+                           "ref": ray_tpu.put(mbs[m]["targets"]),
+                           "nbytes": int(mbs[m]["targets"].nbytes)}
+                    self._ledger.borrow_ref(tgt)
+                    tgt_descs[m] = tgt
+                    ready_fwd[0].append((m, tok))
+                    admitted += 1
+                with self._lock:
+                    self._inflight_mbs = admitted - retired
+
+            def dispatch(s: int) -> None:
+                if self._stage_busy[s] is not None:
+                    return
+                if ready_bwd[s]:
+                    m, gdesc = ready_bwd[s].popleft()
+                    ref = members[s].backward.remote(m, gdesc)
+                    task_by_ref[ref] = ("bwd", m, s, gdesc)
+                elif ready_fwd[s]:
+                    m, in_desc = ready_fwd[s].popleft()
+                    tgt = tgt_descs[m] if s == last else None
+                    ref = members[s].forward.remote(m, in_desc, tgt)
+                    task_by_ref[ref] = ("fwd", m, s, in_desc)
+                else:
+                    return
+                with self._lock:
+                    self._stage_busy[s] = ref
+                    self._stage_busy_since[s] = time.monotonic()
+
+            admit()
+            for s in range(S):
+                dispatch(s)
+
+            while retired < n:
+                busy = [r for r in self._stage_busy if r is not None]
+                if not busy:
+                    raise PipelineError(
+                        f"pipeline {self.name}: scheduler wedged at "
+                        f"step {self._step} (admitted {admitted}, "
+                        f"retired {retired}, window {self.window})")
+                if time.monotonic() > deadline:
+                    raise PipelineError(
+                        f"pipeline {self.name}: step {self._step} "
+                        f"exceeded pipe_step_timeout_s "
+                        f"({rt_config.pipe_step_timeout_s:.0f}s); "
+                        f"stage state: "
+                        f"{[bool(r) for r in self._stage_busy]}")
+                done, _ = ray_tpu.wait(busy, num_returns=1, timeout=1.0)
+                if not done:
+                    if group.epoch != self._gang_epoch \
+                            or group.state != "ALIVE":
+                        raise _GangDisrupted("gang epoch moved")
+                    continue
+                for ref in done:
+                    kind, m, s, consumed = task_by_ref.pop(ref)
+                    try:
+                        reply = ray_tpu.get(ref, timeout=30.0)
+                    except Exception as e:
+                        raise _GangDisrupted(
+                            f"stage {s} {kind}(mb={m}) failed: "
+                            f"{type(e).__name__}") from e
+                    self._observe_desc(serialized_size(reply))
+                    now = time.monotonic()
+                    with self._lock:
+                        self._stage_busy[s] = None
+                        self._stage_busy_s[s] += \
+                            now - self._stage_busy_since[s]
+                        self._stage_last_event[s] = now
+                    if consumed is not None:
+                        self._ledger.drop_ref(consumed)
+                    if kind == "fwd":
+                        if s < last:
+                            self._ledger.borrow_ref(reply)
+                            with self._lock:
+                                self._tensor_bytes_moved += \
+                                    int(reply.get("nbytes", 0))
+                            ready_fwd[s + 1].append((m, reply))
+                        else:
+                            losses[m] = float(reply["loss"])
+                            self._ledger.drop_ref(tgt_descs.pop(m))
+                            ready_bwd[last].append((m, None))
+                    else:
+                        if s > 0:
+                            self._ledger.borrow_ref(reply)
+                            with self._lock:
+                                self._tensor_bytes_moved += \
+                                    int(reply.get("nbytes", 0))
+                            ready_bwd[s - 1].append((m, reply))
+                        else:
+                            retired += 1
+                    admit()
+                    for st in range(S):
+                        dispatch(st)
+
+            # ---- all microbatches backpropagated: one update per stage
+            refs = [a.apply_update.remote(n, self._step)
+                    for a in members]
+            try:
+                ray_tpu.get(refs, timeout=60.0)
+            except Exception as e:
+                raise _GangDisrupted(
+                    f"apply_update failed: {type(e).__name__}") from e
+            # Snapshot BEFORE any driver bookkeeping: if the gang dies
+            # during the pull, this step's effects are lost with it and
+            # the replay (from the previous snapshot, with the same
+            # data) is exactly right — nothing must remember a step
+            # whose state evaporated.
+            completed = self._step
+            if self._snapshot_every \
+                    and (completed + 1) % self._snapshot_every == 0:
+                self._take_snapshot(members)
+        except BaseException:
+            # Every in-flight activation/gradient ref is dropped on the
+            # way out — the abort path must strand nothing (graftlint
+            # resource-leak-path, ObjectRef shape).
+            self._drop_inflight()
+            raise
+
+        if self._ledger.count():
+            # Accounting bug, not a transient: every desc has exactly
+            # one consumer whose reply drops it.
+            leaked = self._ledger.count()
+            self._drop_inflight()
+            raise PipelineError(
+                f"pipeline {self.name}: {leaked} refs still in the "
+                f"ledger after a completed step (scheduler accounting "
+                f"bug)")
+        step_loss = float(np.mean(np.asarray(
+            [losses[m] for m in range(n)], np.float32)))
+        with self._lock:
+            self._step = completed + 1
+            self._losses.append(step_loss)
+            self._inflight_mbs = 0
+        self._report_step(completed)
+        return step_loss
+
+    def _observe_desc(self, nbytes: int) -> None:
+        from ray_tpu.core.config import config as rt_config
+
+        if not rt_config.core_metrics_enabled:
+            return
+        from ray_tpu.core import coremetrics as cm
+
+        cm.PIPE_DESC_BYTES.observe(float(nbytes),
+                                   tags={"pipeline": self.name})
+
+    def _report_step(self, completed: int) -> None:
+        """Record the completed step on the controller's pipeline
+        registry, fenced by the pipeline epoch: a deposed incarnation's
+        late report is rejected, never applied."""
+        from ray_tpu.core.rpc_stubs import ControllerStub
+
+        try:
+            reply = ControllerStub(_controller_client())\
+                .pipe_step_complete(self.name, completed, self._epoch)
+        except Exception:
+            log_every("pipeline.step_report", 10.0, logger,
+                      "reporting step %d of pipeline %s failed",
+                      completed, self.name, exc_info=True)
+            return
+        if not reply.get("ok"):
+            logger.warning(
+                "pipeline %s: step report fenced (%s) — a newer "
+                "incarnation owns the record", self.name, reply)
+
+    def _take_snapshot(self, members) -> None:
+        import ray_tpu
+
+        try:
+            snaps = ray_tpu.get([a.snapshot.remote() for a in members],
+                                timeout=60.0)
+        except Exception as e:
+            raise _GangDisrupted(
+                f"snapshot failed: {type(e).__name__}") from e
+        with self._lock:
+            # The stage clocks are authoritative (they already applied
+            # the update this snapshot captures).
+            self._snapshot = {"step": int(snaps[0]["step"]),
+                              "stages": snaps}
+
+    # --------------------------------------------------------- surface
+
+    def losses(self) -> List[float]:
+        with self._lock:
+            return list(self._losses)
+
+    def snapshot_params(self):
+        """The last snapshot's per-stage params (numpy), for parity
+        checks."""
+        with self._lock:
+            if self._snapshot is None:
+                return None
+            return [s["params"] for s in self._snapshot["stages"]]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            busy = [r is not None for r in self._stage_busy]
+            out = {
+                "pipeline": self.name,
+                "n_stages": self.n_stages,
+                "window": self.window,
+                "step": self._step,
+                "epoch": self._epoch,
+                "gang_epoch": self._gang_epoch,
+                "inflight_microbatches": self._inflight_mbs,
+                "ledger_refs": self._ledger.count(),
+                "ledger_bytes": self._ledger.live_bytes(),
+                "stage_busy": busy,
+                "stage_busy_s": list(self._stage_busy_s),
+                "tensor_bytes_moved": self._tensor_bytes_moved,
+            }
+        out["group"] = None if self._group is None \
+            else self._group.status()
+        return out
+
+    def registry_state(self) -> Optional[Dict[str, Any]]:
+        """The controller's record of this pipeline (``pipe_state``)."""
+        from ray_tpu.core.rpc_stubs import ControllerStub
+
+        return ControllerStub(_controller_client()).pipe_state(self.name)
+
+    def _collect(self) -> None:
+        """Snapshot-time collector: the doctor's pipeline-stall signal.
+        A stage with a dispatched call is BUSY (idle 0); a stage with
+        nothing outstanding has been idle since its last event — one
+        stage busy while the rest idle for a whole window names the
+        straggler."""
+        from ray_tpu.core.config import config as rt_config
+
+        if not rt_config.core_metrics_enabled:
+            return
+        from ray_tpu.core import coremetrics as cm
+
+        now = time.monotonic()
+        with self._lock:
+            rows = [(f"s{i}",
+                     0.0 if self._stage_busy[i] is not None
+                     else max(0.0, now - self._stage_last_event[i]))
+                    for i in range(self.n_stages)]
+            inflight = float(self._inflight_mbs)
+            act_bytes = float(self._ledger.live_bytes())
+        # Pipeline names and stage indexes are bounded by live planes
+        # (a handful per driver), not request volume.
+        # graftlint: disable=metrics-label-cardinality
+        cm.PIPE_INFLIGHT.set(inflight, tags={"pipeline": self.name})
+        # graftlint: disable=metrics-label-cardinality
+        cm.PIPE_ACTIVATION_BYTES.set(act_bytes,
+                                     tags={"pipeline": self.name})
+        for stage, idle in rows:
+            # graftlint: disable=metrics-label-cardinality
+            cm.PIPE_STAGE_IDLE_S.set(idle, tags={"pipeline": self.name,
+                                                 "stage": stage})
+
+    def stop(self) -> Dict[str, Any]:
+        """Deterministic teardown: drop every in-flight ref, flatten
+        the gauges, drop the pipeline record, shut the gang down.
+        Returns the leak-accounting report the shutdown test pins —
+        ``inflight_refs_dropped`` is 0 on any clean between-steps
+        stop."""
+        dropped = self._drop_inflight()
+        from ray_tpu.core.rpc_stubs import ControllerStub
+
+        try:
+            ControllerStub(_controller_client()).pipe_drop(self.name)
+        except Exception:
+            log_every("pipeline.stop_drop", 10.0, logger,
+                      "dropping pipeline record %s failed", self.name,
+                      exc_info=True)
+        group, self._group = self._group, None
+        if group is not None:
+            group.shutdown()
+        self._zero_gauges()
+        from ray_tpu.core.object_ref import _RefTracker
+
+        _RefTracker.get().flush()
+        return {"inflight_refs_dropped": dropped,
+                "ledger_refs": self._ledger.count(),
+                "steps_completed": self._step}
+
+    def _zero_gauges(self) -> None:
+        from ray_tpu.core.config import config as rt_config
+
+        if not rt_config.core_metrics_enabled:
+            return
+        from ray_tpu.core import coremetrics as cm
+
+        # graftlint: disable=metrics-label-cardinality
+        cm.PIPE_INFLIGHT.set(0.0, tags={"pipeline": self.name})
+        # graftlint: disable=metrics-label-cardinality
+        cm.PIPE_ACTIVATION_BYTES.set(0.0, tags={"pipeline": self.name})
+        for i in range(self.n_stages):
+            # graftlint: disable=metrics-label-cardinality
+            cm.PIPE_STAGE_IDLE_S.set(0.0, tags={"pipeline": self.name,
+                                                "stage": f"s{i}"})
+
+
+# ---------------------------------------------------------------- misc
+
+
+def _controller_client():
+    from ray_tpu.core.runtime import get_core_worker
+
+    return get_core_worker().controller
+
+
+def jax_to_numpy(tree):
+    """Host copies of a jax/numpy pytree (snapshot/setup payloads)."""
+    import jax
+
+    return jax.tree.map(np.asarray, tree)

