@@ -134,14 +134,25 @@ def build_train_step(
 
 
 def zero1_state_shardings(mesh: Mesh, opt_state: Any,
-                          rules: Optional[Rules] = None):
+                          zero1_rules: Optional[Rules] = None):
     """NamedShardings for an optimizer-state pytree: each array leaf
     shards its FIRST axis-divisible dim over the ZeRO-1 mesh axis; leaves
     with no divisible dim (scalars like adam's ``count``, tiny norms)
     replicate — jax 0.4.37 rejects uneven shardings, and a ragged shard
     would waste the padding anyway. Works on concrete arrays or
-    ``jax.eval_shape`` structs."""
-    table = rules or ZERO1_STATE_RULES
+    ``jax.eval_shape`` structs.
+
+    ``zero1_rules`` is the ZeRO-1 STATE table (default
+    :data:`~ray_tpu.parallel.sharding.ZERO1_STATE_RULES`), not the
+    model-axis rules table — a table without the ``zero1_shard`` key is
+    rejected rather than silently replicating the state."""
+    table = zero1_rules or ZERO1_STATE_RULES
+    if "zero1_shard" not in table:
+        raise ValueError(
+            "ZeRO-1 state table has no 'zero1_shard' key — this looks "
+            "like a model-axis rules table passed where the state "
+            "table belongs (the state would silently replicate); pass "
+            "it as rules=, and the state table as zero1_rules=")
     mesh_ax = table.get("zero1_shard")
     n = mesh.shape.get(mesh_ax, 1) if isinstance(mesh_ax, str) else 1
     replicated_sh = NamedSharding(mesh, P())
@@ -159,12 +170,13 @@ def zero1_state_shardings(mesh: Mesh, opt_state: Any,
 
 
 def init_zero1_opt_state(optimizer: optax.GradientTransformation, params,
-                         mesh: Mesh, rules: Optional[Rules] = None):
+                         mesh: Mesh,
+                         zero1_rules: Optional[Rules] = None):
     """``optimizer.init`` jitted with ZeRO-1 out_shardings: every state
     leaf materializes already sharded over the data axis — no replica
     ever holds the full optimizer state."""
     state_shape = jax.eval_shape(optimizer.init, params)
-    shardings = zero1_state_shardings(mesh, state_shape, rules)
+    shardings = zero1_state_shardings(mesh, state_shape, zero1_rules)
     with jax.transfer_guard("allow"):
         return jax.jit(optimizer.init, out_shardings=shardings)(params)
 
@@ -175,6 +187,7 @@ def build_zero1_train_step(
     mesh: Mesh,
     params,
     rules: Optional[Rules] = None,
+    zero1_rules: Optional[Rules] = None,
     extra_metrics: Optional[Callable] = None,
     accum_steps: int = 1,
 ):
@@ -182,9 +195,18 @@ def build_zero1_train_step(
     jit pins out_shardings — params REPLICATED (the once-per-step
     all-gather of the updated weights), optimizer state sharded per
     :func:`zero1_state_shardings`. ``params`` is only inspected for
-    structure (``jax.eval_shape``); pass the live pytree."""
+    structure (``jax.eval_shape``); pass the live pytree.
+
+    ``rules`` and ``zero1_rules`` are DISTINCT namespaces: ``rules`` is
+    the model-axis table the step body runs under (resolving the
+    model's ``constrain`` calls, like :func:`build_train_step`),
+    ``zero1_rules`` is the ZeRO-1 state table (default
+    ``ZERO1_STATE_RULES``). A single parameter used to feed both, so
+    any non-None value silently broke one of the two uses — most
+    treacherously, a model table made ``zero1_shard`` miss and the
+    state replicated with no error."""
     state_shape = jax.eval_shape(optimizer.init, params)
-    opt_shardings = zero1_state_shardings(mesh, state_shape, rules)
+    opt_shardings = zero1_state_shardings(mesh, state_shape, zero1_rules)
     replicated_sh = NamedSharding(mesh, P())
     param_shardings = jax.tree.map(lambda _: replicated_sh, params)
     return build_train_step(

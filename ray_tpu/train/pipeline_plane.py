@@ -44,12 +44,22 @@ refs is a ``stop()`` contract, not a hope), re-registers the pipeline
 (``pipe_register`` bumps the registry epoch, fencing any straggler
 ``pipe_step_complete`` from the dead incarnation), re-pushes the last
 driver-owned snapshot to the fresh gang and REPLAYS the interrupted
-step — training resumes from the last completed optimizer step.
+step — training resumes from the last completed optimizer step. A
+TRANSIENT disruption (every member still answers ping — nothing died,
+no reconcile) replays on the surviving gang: each step opens with a
+``begin_step`` fan-out that clears the stages' per-step accumulator
+state (the aborted attempt's completed backwards must not be counted
+again) and cross-checks the stage clocks against the plane's — drifted
+clocks (an apply reply lost AFTER stages applied) rewind the whole
+gang from the snapshot instead of double-applying.
 
 Fault-injection sites: ``pipeline.stage.<pipeline>.<stage>.fwd``
 (stage-side forward entry — a ``delay`` rule makes that stage the
-straggler the doctor's pipeline-stall signature must name); stage
-SIGKILL rides the inherited member beat site
+straggler the doctor's pipeline-stall signature must name; a one-shot
+``error`` rule manufactures the transient mid-step disruption);
+``pipeline.stage.<pipeline>.<stage>.snap`` (stage-side snapshot entry
+— an ``error`` rule makes the post-apply snapshot pull fail while the
+gang stays alive); stage SIGKILL rides the inherited member beat site
 (``multihost.member.<group>.<member>.beat``).
 """
 
@@ -220,6 +230,27 @@ class StageActor(HostWorker):
 
     # -------------------------------------------------------- schedule
 
+    def begin_step(self, step: int) -> Dict[str, Any]:
+        """Reset per-step schedule state (``_g_acc``/``_stash``/
+        ``_losses``) before the driver (re)runs an optimizer step. A
+        replay on a SURVIVING gang (transient disruption: every member
+        still answered ping, so no reconcile rebuilt the stages) would
+        otherwise accumulate into gradients left by the aborted attempt
+        and silently double-count its completed backwards. Returns the
+        stage clock; the DRIVER compares it against ``step`` — a
+        mismatch means this stage already applied the step about to be
+        replayed (its apply reply was lost, not its update), which is
+        snapshot-re-push territory, not an error here."""
+        with self._compute_lock:
+            if self._spec is None:
+                raise PipelineError("stage not configured (setup_stage "
+                                    "first)")
+            self._stash.clear()
+            self._losses.clear()
+            self._g_acc = None
+            return {"stage": int(self._spec["stage"]),
+                    "step": self._step}
+
     def _pull(self, desc: Dict[str, Any]):
         """Resolve a descriptor's tensor from the object plane; the
         local borrow is net-zero (dropped in the finally) — the
@@ -344,6 +375,13 @@ class StageActor(HostWorker):
         outlive the gang)."""
         import jax
 
+        from ray_tpu.core.config import config
+
+        spec = self._spec
+        if config.faultinject_path and spec is not None:
+            faultinject.check(
+                f"pipeline.stage.{spec['pipeline']}.{spec['stage']}"
+                f".snap")
         with self._compute_lock:
             return {
                 "stage": int(self._spec["stage"]),
@@ -524,6 +562,10 @@ class PipelinePlane:
         self._epoch = 0             # pipe-registry epoch (fencing)
         self._gang_epoch = 0        # group epoch the stages were set up under
         self._step = 0              # next optimizer step to run
+        # Stage clocks diverged from the plane on a LIVE gang (an apply
+        # reply lost after some stages applied): force a snapshot
+        # re-push before the replay. Driver-thread only.
+        self._need_resetup = False
         self._snapshot: Optional[Dict[str, Any]] = None
         self._losses: List[float] = []
         self._stage_last_event = [time.monotonic()] * self.n_stages
@@ -561,13 +603,28 @@ class PipelinePlane:
         """Register the pipeline record, set the fresh gang up, hand
         both to ``self`` (the lease local ``reg`` stays a subscript
         borrow through the fallible region; discharge lives in the
-        ``_abort_formation`` self-callee)."""
+        ``_abort_formation`` self-callee). The register RPC is itself
+        fallible (a head blip is a failure mode this codebase handles
+        everywhere else): a raise BEFORE the record exists still tears
+        the already-started gang down — there is just no record to
+        drop yet — so ``start()``'s both-acquisitions-discharged
+        contract holds on every path."""
         from ray_tpu.core.rpc_stubs import ControllerStub
 
-        stub = ControllerStub(_controller_client())
-        reg = stub.pipe_register(self.name, self.n_stages,
-                                 group.group_id,
-                                 f"pid:{os.getpid()}")
+        try:
+            stub = ControllerStub(_controller_client())
+            reg = stub.pipe_register(self.name, self.n_stages,
+                                     group.group_id,
+                                     f"pid:{os.getpid()}")
+        except BaseException:
+            try:
+                group.shutdown()
+            except Exception:
+                log_every("pipeline.abort_gang", 10.0, logger,
+                          "tearing down gang of pipeline %s after a "
+                          "failed pipe_register failed", self.name,
+                          exc_info=True)
+            raise
         try:
             self._setup_stages(group, int(reg["epoch"]))
         except BaseException:
@@ -659,7 +716,11 @@ class PipelinePlane:
         """Before (re)running a step: if the gang was reconciled under
         a new epoch since the stages were set up, wait for it to be
         ALIVE, re-register the pipeline (epoch bump fences the dead
-        incarnation's step reports) and re-push the snapshot."""
+        incarnation's step reports) and re-push the snapshot. The
+        ``_need_resetup`` drift flag (stage clocks diverged from the
+        plane on the SAME live incarnation) forces the same snapshot
+        re-push without a re-register — nothing died, so there is no
+        deposed incarnation to fence."""
         group = self._group
         if group is None:
             raise PipelineError(f"pipeline {self.name} not started")
@@ -667,7 +728,9 @@ class PipelinePlane:
         while True:
             state, epoch = group.state, group.epoch
             if state == "ALIVE" and epoch == self._gang_epoch:
-                return
+                if not self._need_resetup:
+                    return
+                break  # same gang, drifted stages: re-push snapshot
             if state == "ALIVE":
                 break  # re-formed gang: needs a fresh setup
             if state in ("DEAD", "SHUTDOWN"):
@@ -678,21 +741,24 @@ class PipelinePlane:
                 raise PipelineError(
                     f"pipeline {self.name}: gang stuck in {state}")
             time.sleep(0.05)
-        from ray_tpu.core.rpc_stubs import ControllerStub
+        if group.epoch != self._gang_epoch:
+            from ray_tpu.core.rpc_stubs import ControllerStub
 
-        stub = ControllerStub(_controller_client())
-        # Re-registration bumps the record's epoch (fencing the dead
-        # incarnation's in-flight reports); the record itself already
-        # belongs to this plane, so ownership hands off to self BEFORE
-        # the fallible setup — a failed setup keeps the registration
-        # (the next attempt re-registers and bumps again).
-        reg = stub.pipe_register(self.name, self.n_stages,
-                                 group.group_id,
-                                 f"pid:{os.getpid()}")
-        self._adopt_epoch(reg)
+            stub = ControllerStub(_controller_client())
+            # Re-registration bumps the record's epoch (fencing the
+            # dead incarnation's in-flight reports); the record itself
+            # already belongs to this plane, so ownership hands off to
+            # self BEFORE the fallible setup — a failed setup keeps the
+            # registration (the next attempt re-registers and bumps
+            # again).
+            reg = stub.pipe_register(self.name, self.n_stages,
+                                     group.group_id,
+                                     f"pid:{os.getpid()}")
+            self._adopt_epoch(reg)
         self._setup_stages(group, self._epoch)
+        self._need_resetup = False
         logger.info(
-            "pipeline %s: re-formed gang adopted (gang epoch %d, "
+            "pipeline %s: gang state re-pushed (gang epoch %d, "
             "pipeline epoch %d), resuming from step %d", self.name,
             self._gang_epoch, self._epoch, self._step)
 
@@ -780,6 +846,28 @@ class PipelinePlane:
         members = group.members
         if len(members) != self.n_stages:
             raise _GangDisrupted("gang re-forming (member list short)")
+        # Per-step stage reset + clock check. A replay on a SURVIVING
+        # gang (transient disruption — no reconcile rebuilt the stages)
+        # otherwise runs against the _g_acc/_stash the aborted attempt
+        # left behind and double-counts its completed backwards.
+        try:
+            begun = ray_tpu.get(
+                [a.begin_step.remote(self._step) for a in members],
+                timeout=30.0)
+        except Exception as e:
+            raise _GangDisrupted(
+                f"begin_step failed: {type(e).__name__}") from e
+        clocks = [int(r["step"]) for r in begun]
+        if any(c != self._step for c in clocks):
+            # A stage already applied the step this driver is about to
+            # (re)run — its apply REPLY was lost, not its update.
+            # Running against drifted (possibly mixed) clocks would
+            # double-apply; rewind every stage to a consistent step
+            # from the snapshot first.
+            self._need_resetup = True
+            raise _GangDisrupted(
+                f"stage clocks {clocks} drifted from plane step "
+                f"{self._step}; re-pushing the snapshot")
         S, n = self.n_stages, len(mbs)
         last = S - 1
         ready_fwd: List[deque] = [deque() for _ in range(S)]
@@ -902,11 +990,14 @@ class PipelinePlane:
             except Exception as e:
                 raise _GangDisrupted(
                     f"apply_update failed: {type(e).__name__}") from e
-            # Snapshot BEFORE any driver bookkeeping: if the gang dies
+            # Snapshot BEFORE any driver bookkeeping: if the gang DIES
             # during the pull, this step's effects are lost with it and
             # the replay (from the previous snapshot, with the same
             # data) is exactly right — nothing must remember a step
-            # whose state evaporated.
+            # whose state evaporated. A transient pull failure on a
+            # LIVE gang is _take_snapshot's own problem (retry, else
+            # keep the stale snapshot): the stages DID apply, so a
+            # replay would double-count the step.
             completed = self._step
             if self._snapshot_every \
                     and (completed + 1) % self._snapshot_every == 0:
@@ -966,19 +1057,44 @@ class PipelinePlane:
                 "incarnation owns the record", self.name, reply)
 
     def _take_snapshot(self, members) -> None:
+        """Pull the per-stage state the driver owns across gang deaths.
+        Gang death mid-pull raises ``_GangDisrupted`` — the applied
+        step's effects died with the gang, so replaying it (previous
+        snapshot, same data) is exactly right. A TRANSIENT pull failure
+        on a live gang must NOT replay (the stages already applied; the
+        stage clock guard would fail every attempt and a healthy gang
+        would die a fatal PipelineError): retry, and if it persists,
+        forfeit this snapshot — the step still commits, the previous
+        snapshot stays the recovery point."""
         import ray_tpu
 
-        try:
-            snaps = ray_tpu.get([a.snapshot.remote() for a in members],
-                                timeout=60.0)
-        except Exception as e:
-            raise _GangDisrupted(
-                f"snapshot failed: {type(e).__name__}") from e
-        with self._lock:
-            # The stage clocks are authoritative (they already applied
-            # the update this snapshot captures).
-            self._snapshot = {"step": int(snaps[0]["step"]),
-                              "stages": snaps}
+        group = self._group
+        for attempt in range(3):
+            try:
+                snaps = ray_tpu.get(
+                    [a.snapshot.remote() for a in members],
+                    timeout=60.0)
+            except Exception as e:
+                if group.epoch != self._gang_epoch \
+                        or group.state != "ALIVE":
+                    raise _GangDisrupted(
+                        f"snapshot failed: {type(e).__name__}") from e
+                if attempt == 2:
+                    log_every(
+                        "pipeline.snapshot", 10.0, logger,
+                        "pipeline %s: snapshot at step %d failed %d "
+                        "times on a live gang; keeping the previous "
+                        "snapshot (the step still commits)", self.name,
+                        self._step, attempt + 1, exc_info=True)
+                    return
+                time.sleep(0.2)
+                continue
+            with self._lock:
+                # The stage clocks are authoritative (they already
+                # applied the update this snapshot captures).
+                self._snapshot = {"step": int(snaps[0]["step"]),
+                                  "stages": snaps}
+            return
 
     # --------------------------------------------------------- surface
 

@@ -277,6 +277,53 @@ def test_zero1_state_bytes_and_parity():
     assert ratios[4] < ratios[2] and ratios[8] < ratios[4], ratios
 
 
+def test_zero1_rules_namespaces_split_and_guarded():
+    """Regression: build_zero1_train_step's single ``rules`` parameter
+    used to feed BOTH the step body's model-axis table AND the ZeRO-1
+    state table — a model table made ``zero1_shard`` miss and the state
+    silently replicated (no error, just 1x memory). The namespaces are
+    now split (``rules`` vs ``zero1_rules``) and the state-table
+    resolution refuses a table without the ``zero1_shard`` key. jit is
+    lazy, so none of this compiles anything."""
+    import jax
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.key(2))
+    opt = optax.adam(1e-2)
+    mesh = MeshSpec(data=2, fsdp=1).build(jax.devices()[:2])
+    state_shape = jax.eval_shape(opt.init, params)
+    model_rules = {"batch": "data"}  # model-axis table: no zero1_shard
+
+    def lf(p, b):
+        return llama.loss_fn(p, b, cfg)
+
+    # The state-table resolution refuses a model-axis table outright
+    # instead of silently replicating the state.
+    with pytest.raises(ValueError, match="zero1_shard"):
+        ts.zero1_state_shardings(mesh, state_shape, model_rules)
+    with pytest.raises(ValueError, match="zero1_shard"):
+        ts.init_zero1_opt_state(opt, params, mesh, model_rules)
+    # ...and the builder no longer routes the model table there: with
+    # the old single-parameter wiring this call would now raise (and
+    # before the guard, silently disable ZeRO-1).
+    step = ts.build_zero1_train_step(lf, opt, mesh, params,
+                                     rules=model_rules)
+    assert callable(step)
+    # The default state table shards over the data axis; an explicit
+    # zero1_rules override takes the same path.
+    for shardings in (
+            ts.zero1_state_shardings(mesh, state_shape),
+            ts.zero1_state_shardings(mesh, state_shape,
+                                     {"zero1_shard": "data"})):
+        assert any(any(ax == "data" for ax in leaf.spec)
+                   for leaf in jax.tree.leaves(shardings)), shardings
+
+
 # ------------------------------------- stage death + gang reconcile
 
 
@@ -388,3 +435,132 @@ def test_doctor_names_pipeline_stall_straggler(pipe_cluster):
                 if x["signature"] == "pipeline-stall"] == []
     finally:
         plane.stop()
+
+
+# --------------------------------- transient disruptions (no reconcile)
+
+
+@pytest.mark.chaos
+def test_transient_stage_error_replay_does_not_double_accumulate(
+        pipe_cluster):
+    """One stage RPC fails transiently mid-step (injected error at the
+    stage-forward site; every member still answers ping, so no gang
+    reconcile happens) and the step replays on the SURVIVING gang.
+    Regression: the replay used to run against the ``_g_acc``/``_stash``
+    the aborted attempt left behind — gradients from backwards that
+    completed before the disruption were accumulated a SECOND time and
+    silently applied, so later steps drifted off the baseline with no
+    error. ``begin_step`` now resets per-step stage state; the full
+    curve stays bit-exact and the gang never restarts."""
+    from ray_tpu.train.pipeline_plane import (PipelinePlane,
+                                              single_process_baseline)
+
+    cfg, params, steps = _setup(seed=11, n_steps=3)
+    stage_base, _ = single_process_baseline(cfg, params, 1e-2, steps,
+                                            n_stages=2)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, window=2, name="flake-pipe").start()
+    try:
+        with Faults(_FAULTS) as f:
+            # Stage 0's THIRD forward of the first step: by then the
+            # first microbatch's backward has already accumulated into
+            # _g_acc on both stages — exactly the state a replay must
+            # not count twice. once_global gives the cross-process
+            # marker the test asserts on (a renamed site must not turn
+            # this into a trivial pass).
+            rule = f.add("pipeline.stage.flake-pipe.0.fwd", "error",
+                         after=2, times=1, once_global=True,
+                         rule_id="flake-s0-fwd")
+            got = [plane.train_step(steps[0])]
+            assert f.marker_fired(rule)  # the disruption happened
+        got += [plane.train_step(mbs) for mbs in steps[1:]]
+        assert got == stage_base, (got, stage_base)
+        st = plane.stats()
+        # Transient: same gang incarnation end to end, nothing leaked.
+        assert st["gang_epoch"] == 1 and st["epoch"] == 1
+        assert st["group"]["restarts"] == 0
+        assert st["ledger_refs"] == 0 and st["step"] == 3
+    finally:
+        report = plane.stop()
+    assert report["ledger_refs"] == 0
+
+
+@pytest.mark.chaos
+def test_transient_snapshot_failure_commits_step_on_live_gang(
+        pipe_cluster):
+    """The post-apply snapshot pull fails transiently while the gang
+    stays ALIVE (injected error at the stage snapshot site).
+    Regression: the failure used to escape as a whole-step replay —
+    but the stages had already applied the update, so every replayed
+    ``apply_update`` failed the stage clock guard and a HEALTHY gang
+    died a fatal PipelineError after the attempt budget. The snapshot
+    is now retried on a live gang and the step commits."""
+    from ray_tpu.train.pipeline_plane import (PipelinePlane,
+                                              single_process_baseline)
+
+    cfg, params, steps = _setup(seed=13, n_steps=2)
+    stage_base, _ = single_process_baseline(cfg, params, 1e-2, steps,
+                                            n_stages=2)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, window=2, name="snap-pipe").start()
+    try:
+        with Faults(_FAULTS) as f:
+            rule = f.add("pipeline.stage.snap-pipe.1.snap", "error",
+                         times=1, once_global=True, rule_id="snap-s1")
+            got = [plane.train_step(mbs) for mbs in steps]
+            assert f.marker_fired(rule)  # the pull did fail once
+        assert got == stage_base, (got, stage_base)
+        st = plane.stats()
+        assert st["step"] == 2
+        assert st["gang_epoch"] == 1 and st["epoch"] == 1
+        assert st["group"]["restarts"] == 0
+        assert st["ledger_refs"] == 0
+        # The retried pull landed: the driver owns a current snapshot.
+        assert plane.snapshot_params() is not None
+    finally:
+        plane.stop()
+
+
+# ----------------------------------------- formation-abort discharge
+
+
+def test_register_failure_strands_neither_gang_nor_record(pipe_cluster):
+    """``pipe_register`` itself failing during formation (injected
+    error at the controller's RPC site) must discharge BOTH
+    acquisitions: the already-started gang is shut down (sub-slice
+    released, group record dropped) and no pipeline record exists.
+    Regression: the register call sat outside the cleanup guard, so its
+    failure stranded the gang actors and their reserved sub-slice."""
+    from ray_tpu.core import multihost
+    from ray_tpu.core.placement import cluster_topology
+    from ray_tpu.train.pipeline_plane import PipelinePlane
+
+    def reservations():
+        out = {}
+        for s in cluster_topology()["slices"].values():
+            out.update(s["reservations"])
+        return out
+
+    assert reservations() == {}  # clean slate from the prior tests
+    cfg, params, _steps = _setup(n_steps=1)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, name="regfail-pipe")
+    with Faults(_FAULTS) as f:
+        f.add("rpc.server.*.pipe_register", "error", times=1,
+              rule_id="regfail")
+        with pytest.raises(Exception) as ei:
+            plane.start()
+        assert "faultinject" in str(ei.value)
+    # Nothing stranded: no reservation, no group record, no pipeline
+    # record — and the chips are actually free again (a fresh gang of
+    # the same shape forms).
+    assert reservations() == {}
+    assert multihost.registry_state("regfail-pipe-gang") is None
+    assert plane.registry_state() is None
+    plane2 = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                           lr=1e-2, name="regfail-pipe").start()
+    try:
+        assert plane2.stats()["group"]["state"] == "ALIVE"
+    finally:
+        plane2.stop()
+    assert reservations() == {}
