@@ -59,9 +59,11 @@ bench-sharded:
 bench-trace:
 	python bench_decode.py --sections trace_overhead $(BENCH_ARGS)
 
-# Core-plane instrumentation overhead (ISSUE 11): RPC microbench hot
-# path + decode step loop, core_metrics_enabled on vs off (bar <2%)
-# -> BENCH_SERVE.json.
+# Core-plane instrumentation overhead (ISSUE 11 + 15): RPC microbench
+# hot path + decode step loop with core_metrics_enabled on vs off ->
+# BENCH_SERVE.json, plus the pipeline 1F1B step loop traced-vs-
+# untraced and flight-recorder-on-vs-off -> BENCH_TUNE.json (all rows
+# merge-preserving; bar <2% everywhere).
 bench-obs:
 	python bench_obs.py $(BENCH_ARGS)
 

@@ -27,6 +27,18 @@ expression containing an id-shaped terminal name (``*_id``, ``oid``,
 ``uuid``, …) or an id-producing call (``.hex()``, ``uuid4()``). Label
 values that are genuinely bounded ids (node ids: series die with the
 node) carry a pragma with the justification.
+
+**Flight-recorder events** (PR 15) go through the same two checks at
+``flightrec.record("<name>", **attrs)`` sites (import-resolved to
+``ray_tpu.util.flightrec`` — any other ``record`` is never confused):
+one event name, one ATTR-KEY SCHEMA (``doctor.post_mortem`` merges
+events by name; a site recording the same name with different keys
+silently breaks every grouping — flagged as metrics-name-collision),
+and id-shaped attr values flagged as metrics-label-cardinality —
+bounded schedule ints (``rules.FLIGHTREC_BOUNDED_ATTRS``: step, mb,
+stage, epoch, …) are exempt, and genuinely-bounded subject ids (gang
+ids die with the gang) carry the same justification pragma as metric
+labels.
 """
 
 from __future__ import annotations
@@ -165,6 +177,121 @@ def _check_cardinality(project: Project, emit_files=None) -> List[Finding]:
     return findings
 
 
+def _flightrec_aliases(tree: ast.AST) -> Tuple[set, set]:
+    """(direct names bound to flightrec.record) and (local names bound
+    to the ray_tpu.util.flightrec module itself)."""
+    direct: set = set()
+    mod_aliases: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == rules.FLIGHTREC_MODULE:
+                for a in node.names:
+                    if a.name == rules.FLIGHTREC_RECORD_FUNC:
+                        direct.add(a.asname or a.name)
+            elif node.module == "ray_tpu.util":
+                for a in node.names:
+                    if a.name == "flightrec":
+                        mod_aliases.add(a.asname or "flightrec")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == rules.FLIGHTREC_MODULE:
+                    mod_aliases.add(a.asname or "ray_tpu")
+    return direct, mod_aliases
+
+
+def _is_flightrec_record(call: ast.Call, direct: set,
+                         mod_aliases: set) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in direct
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr == rules.FLIGHTREC_RECORD_FUNC
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in mod_aliases)
+
+
+def _check_flightrec(project: Project, emit_files=None) -> List[Finding]:
+    """Flight-recorder event discipline: collect every literal-name
+    ``record()`` site package-wide (schema = sorted attr keys; the
+    first site wins), then flag schema collisions and id-shaped attr
+    values — the family-#10 checks applied to the event catalog."""
+    sites: Dict[str, List[dict]] = {}
+    card: List[Finding] = []
+    for f in sorted(project.files, key=lambda s: s.relpath):
+        direct, mod_aliases = _flightrec_aliases(f.tree)
+        if not direct and not mod_aliases:
+            continue
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            is_scope = isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))
+            if is_scope:
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+            if not (isinstance(node, ast.Call)
+                    and _is_flightrec_record(node, direct, mod_aliases)
+                    and node.args):
+                return
+            name_arg = node.args[0]
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                return
+            keys = tuple(sorted(kw.arg for kw in node.keywords
+                                if kw.arg is not None))
+            sites.setdefault(name_arg.value, []).append({
+                "relpath": f.relpath, "line": node.lineno,
+                "symbol": qualname_of(stack), "keys": keys})
+            if emit_files is not None and f.relpath not in emit_files:
+                return
+            for kw in node.keywords:
+                if (kw.arg is None
+                        or kw.arg in rules.FLIGHTREC_BOUNDED_ATTRS
+                        or isinstance(kw.value, ast.Constant)):
+                    continue
+                why = _is_id_shaped(kw.value)
+                if why is None:
+                    continue
+                card.append(Finding(
+                    rule=rules.METRICS_CARDINALITY, path=f.relpath,
+                    line=node.lineno, symbol=qualname_of(stack),
+                    message=(f"flight-recorder event "
+                             f"{name_arg.value!r} attr {kw.arg!r} "
+                             f"takes an id-shaped value ({why}): "
+                             f"per-id events are a metric trying to "
+                             f"be born — use a bounded attr, or "
+                             f"pragma with the bound's justification "
+                             f"(gang/pipeline ids die with their "
+                             f"subject)")))
+
+        visit(f.tree)
+
+    findings: List[Finding] = []
+    for name, regs in sites.items():
+        first = regs[0]
+        for site in regs[1:]:
+            if site["keys"] == first["keys"]:
+                continue
+            if (emit_files is not None
+                    and site["relpath"] not in emit_files):
+                continue
+            findings.append(Finding(
+                rule=rules.METRICS_COLLISION, path=site["relpath"],
+                line=site["line"], symbol=site["symbol"],
+                message=(f"flight-recorder event {name!r} recorded "
+                         f"with attr keys {list(site['keys'])} here "
+                         f"but {list(first['keys'])} at "
+                         f"{first['relpath']}:{first['line']} — one "
+                         f"event name, one schema (the post-mortem "
+                         f"merges events by name)")))
+    findings.extend(card)
+    return findings
+
+
 def check_project(project: Project, emit_files=None) -> List[Finding]:
     # First pass: every literal-name registration in the package, in
     # deterministic file order, so "first site wins" is stable.
@@ -227,4 +354,5 @@ def check_project(project: Project, emit_files=None) -> List[Finding]:
                 rule=rules.METRICS_COLLISION, path=site["relpath"],
                 line=site["line"], symbol=site["symbol"], message=msg))
     findings.extend(_check_cardinality(project, emit_files))
+    findings.extend(_check_flightrec(project, emit_files))
     return findings

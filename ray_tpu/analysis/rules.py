@@ -396,3 +396,22 @@ METRICS_ID_SUFFIX = "_id"
 # Calls whose result is id-shaped regardless of receiver (oid.hex(),
 # uuid.uuid4()): flagged as label values.
 METRICS_ID_CALLS = frozenset({"hex", "uuid4", "uuid1"})
+
+# ------------------------------- flight-recorder event names (#10)
+
+# Flight-recorder record() sites (import-resolved to this module) go
+# through the same literal-name discipline as metric constructors: one
+# event name, one attr-key schema (the post-mortem merges events by
+# name — a site recording the same name with different keys silently
+# breaks every downstream grouping), and id-shaped attr VALUES flagged
+# exactly like metric label values (the ring is bounded, but an event
+# whose attrs are per-request ids is a metric trying to be born).
+FLIGHTREC_MODULE = "ray_tpu.util.flightrec"
+FLIGHTREC_RECORD_FUNC = "record"
+# Attr keys whose values are bounded schedule/geometry integers by
+# construction ({step, mb, stage} and friends): exempt from the
+# id-shaped check — `step=self._step` is a clock, not a cardinality
+# hazard.
+FLIGHTREC_BOUNDED_ATTRS = frozenset({
+    "step", "mb", "stage", "epoch", "asked", "mbs", "attempt", "hosts",
+    "stages", "chips", "current", "n"})

@@ -360,6 +360,56 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "the resume point after a whole-gang restart (a snapshot owned "
         "by a stage actor would die with it). 0 disables snapshots "
         "(a gang death then restarts training from step 0)."),
+    "pipe_trace_spans": (bool, True,
+        "Train-plane tracing (train/pipeline_plane.py): the pipeline "
+        "driver opens one root span per optimizer step and every stage "
+        "actor records fwd/bwd/apply spans with {step, mb, stage} attrs "
+        "into the task-event buffer, so `python -m ray_tpu timeline "
+        "--train` renders per-stage process rows whose gaps ARE the "
+        "1F1B bubble. Spans are per stage-RPC, never per tensor "
+        "element; stage-side emission is additionally gated on an "
+        "active trace context, so an untraced step pays one contextvar "
+        "read per call."),
+    "pipe_trace_sample_every": (int, 4,
+        "Head-sampling period of the train-plane tracer: every Nth "
+        "optimizer step opens the pipe:step root span (stage/cell "
+        "spans follow the propagated context, so a sampled step is "
+        "traced END TO END and an unsampled one records nothing). A "
+        "fully-traced 1F1B step emits ~180 span events (per-cell "
+        "driver+stage spans, object put/get, actor exec) — ~5% of a "
+        "200 ms debug step on the CPU box — so sampling keeps the "
+        "always-on cost under the 2% bar while every timeline still "
+        "shows complete representative steps. 1 traces every step."),
+    "flightrec_enabled": (bool, True,
+        "Cluster flight recorder (util/flightrec.py): a bounded "
+        "per-process ring of structured control-plane events (gang "
+        "epochs/reconciles, barrier entries, pipeline stage clocks, "
+        "snapshot push/pull, faultinject fires, actor death causes) "
+        "persisted for `ray_tpu doctor --post-mortem`. Off = every "
+        "record() is one attribute read."),
+    "flightrec_ring": (int, 512,
+        "Events kept per process by the flight recorder (deque maxlen; "
+        "oldest evicted first). The ring records control-plane facts, "
+        "not data-plane traffic — 512 covers minutes of gang/pipeline "
+        "lifecycle at production cadences."),
+    "flightrec_dir": (str, f"/tmp/ray_tpu_flightrec_{os.getuid()}",
+        "Per-HOST directory the flight recorder persists per-process "
+        "rings into (fr-<pid>.json, atomic replace). fr_dump / doctor "
+        "--post-mortem merge every file here; on multi-host rigs "
+        "collect each host's dir. Per-uid default so shared dev hosts "
+        "don't collide."),
+    "flightrec_flush_s": (float, 0.5,
+        "Period of the flight recorder's background flush to "
+        "flightrec_dir while events keep arriving. A SIGKILL keeps "
+        "everything up to the last flush (faultinject die rules flush "
+        "synchronously first, so injected crashes are fully recorded)."),
+    "pipe_peak_tflops": (float, 0.0,
+        "Aggregate peak TFLOP/s of a training gang, for the pipeline "
+        "plane's MFU estimate gauge (pipeline_mfu_pct = achieved model "
+        "TFLOP/s / peak x 100; achieved is always exported as "
+        "pipeline_model_tflops). 0 (default) disables the MFU gauge — "
+        "there is no honest peak number for a time-sliced CPU host; "
+        "set it to chips x per-chip peak on a real rig."),
     "serve_adopt_timeout_s": (float, 5.0,
         "How long a restarted serve controller pings the replica/proxy "
         "handles from its checkpoint before declaring the stragglers "

@@ -222,6 +222,7 @@ class Controller:
                 "pipe_drop": self.pipelines.drop,
                 "pipe_step_complete": self.pipelines.step_complete,
                 "pipe_state": self.pipelines.state,
+                "fr_dump": self.fr_dump,
                 "autoscaler_state": self.autoscaler_state,
                 "push_metrics": self.push_metrics,
                 "list_metrics": self.list_metrics,
@@ -900,7 +901,24 @@ class Controller:
             if rec is not None:
                 rec.state = DEAD
                 rec.death_cause = reason
+                self._record_actor_death(rec, reason, restarting=False)
                 self._publish_actor(rec)
+
+    @staticmethod
+    def _record_actor_death(rec: ActorRecord, reason: str,
+                            restarting: bool) -> None:
+        """Flight-recorder witness of an actor death: the post-mortem's
+        'who died, why, was it restarted' evidence (a SIGKILLed actor's
+        own recorder can say nothing past its last flush)."""
+        from ray_tpu.util import flightrec
+
+        # Actor ids are the evidence here, not a label cardinality
+        # hazard: the recorder is a bounded ring, not a registry.
+        # graftlint: disable=metrics-label-cardinality
+        flightrec.record("actor.death", actor=rec.actor_id.hex()[:8],
+                         cls=str(rec.info.get("class_name", "")),
+                         name=str(rec.info.get("name") or ""),
+                         cause=reason, restarting=restarting)
 
     def report_actor_failure(self, actor_id_bytes: bytes,
                              reason: str = "") -> Dict[str, Any]:
@@ -925,6 +943,8 @@ class Controller:
             else:
                 rec.state = DEAD
                 rec.death_cause = reason
+            self._record_actor_death(rec, reason,
+                                     restarting=should_schedule)
             self._publish_actor(rec)
             summary = self._actor_summary(rec)
         if should_schedule:
@@ -946,6 +966,8 @@ class Controller:
             if no_restart:
                 rec.state = DEAD
                 rec.death_cause = "killed via kill()"
+                self._record_actor_death(rec, rec.death_cause,
+                                         restarting=False)
                 self._publish_actor(rec)
         if addr is not None:
             worker_addr, worker_id, node_addr = addr
@@ -1284,6 +1306,20 @@ class Controller:
     def list_task_events(self, limit: int = 1000) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._task_events[-limit:])
+
+    def fr_dump(self, max_age_s: float = 0.0) -> Dict[str, Any]:
+        """Merged flight-recorder dumps from every process on this host
+        (util/flightrec.py): the controller flushes its own ring, then
+        reads each persisted fr-<pid>.json under flightrec_dir —
+        including files left by processes that are already dead, which
+        is the whole point (`ray_tpu doctor --post-mortem` reads this).
+        ``max_age_s`` > 0 drops files whose last flush is older (stale
+        sessions on a shared dir)."""
+        from ray_tpu.util import flightrec
+
+        flightrec.flush_now()
+        return flightrec.dump_all(
+            max_age_s=max_age_s if max_age_s > 0 else None)
 
     # ----------------------------------------------------------- control
 

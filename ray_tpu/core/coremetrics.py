@@ -229,6 +229,27 @@ PIPE_DESC_BYTES = Histogram(
     "Serialized stage-RPC descriptor size (ref + metadata, never "
     "tensor bytes — the tensors ride the object plane).",
     boundaries=_DESC_BUCKETS, tag_keys=("pipeline",))
+PIPE_STEP_PHASE_S = Gauge(
+    "pipeline_step_breakdown_s",
+    "Stage-seconds of the last completed optimizer step by phase "
+    "(fwd | bwd | apply | allgather | idle): fwd/bwd sum the driver-"
+    "observed dispatch->reply occupancy, apply charges the concurrent "
+    "update fan-out to every stage, idle is the remainder of "
+    "stages x step wall — the measured 1F1B bubble. The TPU MFU "
+    "accounting discipline: every stage-second of a step has a row.",
+    tag_keys=("pipeline", "phase"))
+PIPE_MODEL_TFLOPS = Gauge(
+    "pipeline_model_tflops",
+    "Achieved model TFLOP/s of the last completed step "
+    "(~8 x params x tokens / wall: 2 fwd + 4 bwd + 2 recompute-fwd — "
+    "stage backwards recompute their forward inside jax.vjp).",
+    tag_keys=("pipeline",))
+PIPE_MFU = Gauge(
+    "pipeline_mfu_pct",
+    "Model FLOPs utilization estimate: achieved model TFLOP/s over "
+    "the gang's configured peak (config.pipe_peak_tflops) x 100. "
+    "Absent unless the peak is configured — there is no honest peak "
+    "for a time-sliced CPU host.", tag_keys=("pipeline",))
 
 
 # ----------------------------------------------------- cluster summary
@@ -342,5 +363,11 @@ def core_summary(aggregated: Dict[str, List[Dict[str, Any]]]
         "stage_idle_s": _tag_map(gauge_totals(
             aggregated, "pipeline_stage_idle_s"), "stage"),
         "desc_bytes": _merged_summary(aggregated, "pipeline_desc_bytes"),
+        "step_breakdown_s": _tag_map(gauge_totals(
+            aggregated, "pipeline_step_breakdown_s"), "phase"),
+        "model_tflops": _tag_map(gauge_totals(
+            aggregated, "pipeline_model_tflops"), "pipeline"),
+        "mfu_pct": _tag_map(gauge_totals(
+            aggregated, "pipeline_mfu_pct"), "pipeline"),
     }
     return out

@@ -64,10 +64,25 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.errors import RayTpuError
-from ray_tpu.util import faultinject
+from ray_tpu.util import faultinject, flightrec, tracing
 from ray_tpu.util.ratelimit import log_every
 
 logger = logging.getLogger(__name__)
+
+
+def _gang_span(name: str, **attrs):
+    """A gang-lifecycle tracing span (formation, election, barrier
+    entry, reconcile), gated on the train-plane tracing knob: these are
+    control-plane events at human cadence, so the span cost is noise,
+    but the knob keeps the off switch symmetric with the pipeline
+    spans. Returns a context manager."""
+    from contextlib import nullcontext
+
+    from ray_tpu.core.config import config
+
+    if not config.pipe_trace_spans:
+        return nullcontext()
+    return tracing.trace(name, **attrs)
 
 
 class MultihostError(RayTpuError):
@@ -185,6 +200,9 @@ class GroupRegistry:
                 rec.barriers.clear()
                 rec.kv.clear()
                 self._cond.notify_all()
+            # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+            flightrec.record("gang.register", group=group_id,
+                             epoch=rec.epoch, hosts=rec.num_hosts)
             return {"epoch": rec.epoch}
 
     def drop_group(self, group_id: str) -> bool:
@@ -195,6 +213,9 @@ class GroupRegistry:
             rec = self._groups.pop(group_id, None)
             self._cond.notify_all()
         if rec is not None:
+            # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+            flightrec.record("gang.drop", group=group_id,
+                             epoch=rec.epoch)
             self._zero_entered(rec)
         return rec is not None
 
@@ -208,6 +229,10 @@ class GroupRegistry:
             if rec is None:
                 return {"known": False, "fenced": True, "epoch": 0}
             if epoch < rec.epoch:
+                # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+                flightrec.record("gang.beat.fenced", group=group_id,
+                                 member=member, epoch=epoch,
+                                 current=rec.epoch)
                 return {"known": True, "fenced": True,
                         "epoch": rec.epoch}
             rec.members[member] = {"last_beat": time.monotonic(),
@@ -243,6 +268,10 @@ class GroupRegistry:
                 # Archive: waiters keep the object; the next barrier
                 # under this name starts fresh.
                 rec.barriers.pop(name, None)
+                # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+                flightrec.record("gang.barrier.done", group=group_id,
+                                 barrier=name, epoch=epoch,
+                                 hosts=rec.num_hosts)
                 self._cond.notify_all()
             while not bar.done:
                 remaining = deadline - time.monotonic()
@@ -262,6 +291,10 @@ class GroupRegistry:
                 arrived = sorted(bar.payloads)
                 absent = sorted(set(rec.expected_members())
                                 - set(arrived))
+                # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+                flightrec.record("gang.barrier.timeout", group=group_id,
+                                 barrier=name, epoch=epoch,
+                                 absent=",".join(absent))
                 result = {"ok": False, "reason": "timeout",
                           "arrived": arrived, "absent": absent}
         self._observe_wait(time.monotonic() - t0)
@@ -418,12 +451,23 @@ def enter_barrier(group_id: str, member: str, epoch: int, name: str,
         faultinject.check(f"multihost.barrier.{group_id}.{member}")
     from ray_tpu.core.rpc_stubs import ControllerStub
 
-    reply = ControllerStub(_controller_client()).mh_barrier(
-        group_id, name, member, epoch, payload, timeout_s,
-        timeout=timeout_s + 30.0)
+    # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+    flightrec.record("gang.barrier.enter", group=group_id, member=member,
+                     barrier=name, epoch=epoch)
+    # The span duration IS this member's rendezvous wait — the
+    # per-member bar `ray_tpu timeline --train` renders for a barrier.
+    with _gang_span(f"gang:barrier:{name}", group=group_id,
+                    member=member, epoch=epoch):
+        reply = ControllerStub(_controller_client()).mh_barrier(
+            group_id, name, member, epoch, payload, timeout_s,
+            timeout=timeout_s + 30.0)
     if reply.get("ok"):
         return reply["payloads"]
     reason = reply.get("reason")
+    # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+    flightrec.record("gang.barrier.refused", group=group_id,
+                     member=member, barrier=name, epoch=epoch,
+                     reason=str(reason))
     if reason == "stale_epoch":
         raise GroupEpochFenced(
             f"member {member} of group {group_id} entered barrier "
@@ -620,6 +664,10 @@ class HostWorker:
         self._ctx = dict(ctx)
         self._fenced = False
         self._stop = threading.Event()
+        # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+        flightrec.record("gang.member.up", group=ctx.get("group_id", ""),
+                         member=ctx.get("member", ""),
+                         epoch=int(ctx.get("epoch", 0)))
         self._beat = threading.Thread(target=self._beat_loop,
                                       name="mh-member-beat", daemon=True)
         self._beat.start()
@@ -658,6 +706,9 @@ class HostWorker:
             if reply.get("fenced"):
                 # Zombie: a newer group epoch exists (the gang restarted
                 # without us). Stop touching group state forever.
+                # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+                flightrec.record("gang.fenced", group=gid, member=member,
+                                 epoch=epoch)
                 with self._lock:
                     self._fenced = True
                 return
@@ -921,37 +972,51 @@ class HostGroup:
         stub = ControllerStub(_controller_client())
         cph = self._resolve_chips_per_host(stub)
         chips = self.num_hosts * cph
-        sub = stub.reserve_subslice(self._owner, chips)
-        if sub is None:
-            # The controller's refusal already fed _pending_demand (the
-            # autoscaler sees a gang that could not place).
-            raise GangPlacementError(
-                f"no contiguous {chips}-chip sub-slice for a "
-                f"{self.num_hosts}-host gang (chips_per_host={cph}); "
-                f"refusal recorded as autoscaler pending demand")
-        members = []
-        try:
-            reg = stub.mh_register_group(self.group_id, self.num_hosts,
-                                         None, self._owner)
-            stub.mh_group_put(self.group_id, "reservation",
-                              sub["reservation_id"], int(reg["epoch"]))
-            self._spawn_members_into(
-                members, int(reg["epoch"]), sub["reservation_id"],
-                sub["slice_id"], sub["nodes"], sub["origin"],
-                sub["shape"], cph)
-            self._elect(members, int(reg["epoch"]))
-        except BaseException as e:
-            # Release-once on partial-spawn failure: the half-created
-            # group record drops and the chips go back to the grid.
-            self._abort_formation(stub, sub["reservation_id"])
-            if isinstance(e, MultihostError):
-                raise
-            raise GangPlacementError(
-                f"gang spawn for group {self.group_id} failed: "
-                f"{e!r}") from e
-        # Ownership handoff: the group object now owns the reservation
-        # (release_reservation_once / shutdown discharge it from here).
+        with _gang_span("gang:form", group=self.group_id,
+                        hosts=self.num_hosts):
+            sub = stub.reserve_subslice(self._owner, chips)
+            if sub is None:
+                # The controller's refusal already fed _pending_demand
+                # (the autoscaler sees a gang that could not place).
+                # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+                flightrec.record("gang.refused", group=self.group_id,
+                                 hosts=self.num_hosts, chips=chips)
+                raise GangPlacementError(
+                    f"no contiguous {chips}-chip sub-slice for a "
+                    f"{self.num_hosts}-host gang (chips_per_host={cph});"
+                    f" refusal recorded as autoscaler pending demand")
+            members = []
+            try:
+                reg = stub.mh_register_group(self.group_id,
+                                             self.num_hosts,
+                                             None, self._owner)
+                stub.mh_group_put(self.group_id, "reservation",
+                                  sub["reservation_id"],
+                                  int(reg["epoch"]))
+                self._spawn_members_into(
+                    members, int(reg["epoch"]), sub["reservation_id"],
+                    sub["slice_id"], sub["nodes"], sub["origin"],
+                    sub["shape"], cph)
+                self._elect(members, int(reg["epoch"]))
+            except BaseException as e:
+                # Release-once on partial-spawn failure: the
+                # half-created group record drops and the chips go back
+                # to the grid.
+                self._abort_formation(stub, sub["reservation_id"])
+                if isinstance(e, MultihostError):
+                    raise
+                raise GangPlacementError(
+                    f"gang spawn for group {self.group_id} failed: "
+                    f"{e!r}") from e
+        # Ownership handoff FIRST: the group object owns the
+        # reservation from here (release_reservation_once / shutdown
+        # discharge it), so the record below can never strand it.
         self._commit_formation(sub, reg, members)
+        # Gang ids are bounded by live gangs (the recorder ring is
+        # bounded regardless); the id IS the evidence.
+        # graftlint: disable=metrics-label-cardinality
+        flightrec.record("gang.form", group=self.group_id,
+                         epoch=int(reg["epoch"]), hosts=self.num_hosts)
 
     def _abort_formation(self, stub, reservation_id: str) -> None:
         """Partial-spawn cleanup: hand the chips back and drop the
@@ -1039,18 +1104,23 @@ class HostGroup:
         from ray_tpu.core.rpc_stubs import ControllerStub
 
         coordinator = member_name(0)
-        coord_addr = ray_tpu.get(
-            members[0].reserve_coordinator.remote(0), timeout=60.0)
-        put = ControllerStub(_controller_client()).mh_group_put(
-            self.group_id, "coordinator",
-            {"member": coordinator, "address": coord_addr,
-             "epoch": epoch}, epoch)
-        if not put.get("ok"):
-            raise GroupEpochFenced(
-                f"election write for group {self.group_id} epoch "
-                f"{epoch} rejected: {put!r}")
-        ray_tpu.get([m.configure.remote(coord_addr, coordinator, epoch)
-                     for m in members], timeout=60.0)
+        with _gang_span("gang:elect", group=self.group_id, epoch=epoch):
+            coord_addr = ray_tpu.get(
+                members[0].reserve_coordinator.remote(0), timeout=60.0)
+            put = ControllerStub(_controller_client()).mh_group_put(
+                self.group_id, "coordinator",
+                {"member": coordinator, "address": coord_addr,
+                 "epoch": epoch}, epoch)
+            if not put.get("ok"):
+                raise GroupEpochFenced(
+                    f"election write for group {self.group_id} epoch "
+                    f"{epoch} rejected: {put!r}")
+            ray_tpu.get([m.configure.remote(coord_addr, coordinator,
+                                            epoch)
+                         for m in members], timeout=60.0)
+        # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+        flightrec.record("gang.elect", group=self.group_id, epoch=epoch,
+                         coordinator=coordinator)
         with self._lock:
             self._coordinator = coordinator
             self._coordinator_address = coord_addr
@@ -1101,44 +1171,64 @@ class HostGroup:
                      + (" (coordinator — re-electing)"
                         if coordinator_died else ""))
             self._death_cause = cause
+            old_epoch = self._epoch
         logger.info("host group %s: %s; reconciling the whole gang",
                     self.group_id, cause)
-        self._kill_members(members)
-        self.release_reservation_once()
-        restart = False
-        with self._lock:
-            if self._restarts < self.max_group_restarts:
-                self._restarts += 1
-                restart = True
-        if restart:
-            try:
-                self._form()
-            except Exception as e:
-                with self._lock:
-                    self._state = _DEAD
-                    self._death_cause = (
-                        f"{self._death_cause}; restart failed: {e!r}")
-                return
+        # The monitor names the dead IN DETECTION ORDER: dead[0] is the
+        # post-mortem's "first-dying member" (corroborated by the
+        # victim's own recorder going silent / a fault.fired die event).
+        # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+        flightrec.record("gang.reconcile", group=self.group_id,
+                         epoch=old_epoch, dead=",".join(dead_members),
+                         coordinator_died=coordinator_died)
+        with _gang_span("gang:reconcile", group=self.group_id,
+                        epoch=old_epoch, dead=",".join(dead_members)):
+            self._kill_members(members)
+            self.release_reservation_once()
+            restart = False
             with self._lock:
-                # shutdown() may have run while the fresh gang was
-                # forming (it found nothing to tear down then): the
-                # re-formed gang must not outlive the group object.
-                stale = self._stopped.is_set()
+                if self._restarts < self.max_group_restarts:
+                    self._restarts += 1
+                    restart = True
+            if restart:
+                try:
+                    self._form()
+                except Exception as e:
+                    # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+                    flightrec.record("gang.dead", group=self.group_id,
+                                     epoch=old_epoch,
+                                     cause=f"restart failed: {e!r}")
+                    with self._lock:
+                        self._state = _DEAD
+                        self._death_cause = (
+                            f"{self._death_cause}; restart failed: "
+                            f"{e!r}")
+                    return
+                with self._lock:
+                    # shutdown() may have run while the fresh gang was
+                    # forming (it found nothing to tear down then): the
+                    # re-formed gang must not outlive the group object.
+                    stale = self._stopped.is_set()
+                    if stale:
+                        members = self._members
+                        self._members = []
+                    else:
+                        # death_cause stays as the last-reconciliation
+                        # record (status() history), state returns to
+                        # life.
+                        self._state = _ALIVE
                 if stale:
-                    members = self._members
-                    self._members = []
-                else:
-                    # death_cause stays as the last-reconciliation
-                    # record (status() history), state returns to life.
-                    self._state = _ALIVE
-            if stale:
-                self._kill_members(members)
-                self.release_reservation_once()
-                drop_gang(self.group_id)
-            return
-        drop_gang(self.group_id)
-        with self._lock:
-            self._state = _DEAD
+                    self._kill_members(members)
+                    self.release_reservation_once()
+                    drop_gang(self.group_id)
+                return
+            # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+            flightrec.record("gang.dead", group=self.group_id,
+                             epoch=old_epoch,
+                             cause="restart budget exhausted")
+            drop_gang(self.group_id)
+            with self._lock:
+                self._state = _DEAD
 
     def _kill_members(self, members: List[Any]) -> None:
         import ray_tpu
@@ -1181,6 +1271,10 @@ class HostGroup:
             self._state = _SHUTDOWN
             members = self._members
             self._members = []
+            epoch = self._epoch
+        # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
+        flightrec.record("gang.shutdown", group=self.group_id,
+                         epoch=epoch)
         self._kill_members(members)
         self.release_reservation_once()
         drop_gang(self.group_id)

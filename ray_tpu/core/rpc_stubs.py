@@ -104,6 +104,9 @@ class ControllerStub(_StubBase):
     def finish_job(self, job_id, state=_UNSET, *, timeout=_UNSET):
         return self._call('finish_job', job_id, state=state, timeout=timeout)
 
+    def fr_dump(self, max_age_s=_UNSET, *, timeout=_UNSET):
+        return self._call('fr_dump', max_age_s=max_age_s, timeout=timeout)
+
     def get_actor(self, actor_id_bytes, *, timeout=_UNSET):
         return self._call('get_actor', actor_id_bytes, timeout=timeout)
 

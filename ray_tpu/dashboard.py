@@ -37,7 +37,8 @@ _PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <p><a href="/api/nodes">/api/nodes</a> <a href="/api/actors">/api/actors</a>
 <a href="/api/jobs">/api/jobs</a> <a href="/api/tasks">/api/tasks</a>
 <a href="/api/memory">/api/memory</a> <a href="/api/logs">/api/logs</a>
-<a href="/api/history">/api/history</a> <a href="/logs">logs</a>
+<a href="/api/history">/api/history</a> <a href="/api/train">/api/train</a>
+<a href="/logs">logs</a>
 <a href="/metrics">/metrics</a></p></body></html>"""
 
 
@@ -192,6 +193,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(json.dumps(self._serve_slo()).encode())
             elif path == "/api/core":
                 self._send(json.dumps(self._core_summary()).encode())
+            elif path == "/api/train":
+                self._send(json.dumps(self._train_summary()).encode())
             elif path == "/metrics":
                 self._send(self.client.call("metrics_text").encode(),
                            "text/plain")
@@ -402,6 +405,64 @@ class _Handler(BaseHTTPRequestHandler):
 
         return core_summary(self.client.call("list_metrics", timeout=5.0))
 
+    def _train_summary(self) -> Dict:
+        """Train panel data: the ``core_summary.pipeline``/``multihost``
+        sections (the SAME read path as ``ray_tpu metrics``) plus the
+        controller's pipeline registry records — geometry, epoch and
+        last completed step per live pipeline."""
+        core = self._core_summary()
+        out = {"pipeline": core.get("pipeline", {}),
+               "multihost": core.get("multihost", {})}
+        try:
+            out["pipelines"] = self.client.call("pipe_state",
+                                                timeout=5.0) or {}
+        except Exception:
+            out["pipelines"] = {}
+        return out
+
+    def _render_train_panel(self) -> str:
+        """Train panel: one row per registered pipeline (geometry,
+        epoch, progress) + the cluster-wide step-phase split and MFU
+        estimate off the same gauges `ray_tpu metrics` prints."""
+        try:
+            train = self._train_summary()
+        except Exception:
+            return ""
+        pipes = train.get("pipelines") or {}
+        pl = train.get("pipeline", {})
+        breakdown = pl.get("step_breakdown_s") or {}
+        if not pipes and not breakdown:
+            return ""
+        rows = []
+        tflops = pl.get("model_tflops") or {}
+        mfu = pl.get("mfu_pct") or {}
+        for name, rec in sorted(pipes.items()):
+            rows.append({
+                "pipeline": _esc(name),
+                "stages": rec.get("num_stages", ""),
+                "epoch": rec.get("epoch", ""),
+                "last_step": rec.get("last_step", ""),
+                "tflops": (f"{tflops[name]:.3f}"
+                           if name in tflops else ""),
+                "mfu": (f"{mfu[name]:.1f}%" if name in mfu else ""),
+            })
+        html = "<h2>train plane</h2>"
+        if rows:
+            html += _table(rows, ["pipeline", "stages", "epoch",
+                                  "last_step", "tflops", "mfu"])
+        if breakdown:
+            total = sum(breakdown.values()) or 1.0
+            html += ("<p>last step phase split (stage-seconds): "
+                     + ", ".join(
+                         f"{k}={v:.3f}s ({100 * v / total:.0f}%)"
+                         for k, v in sorted(breakdown.items()))
+                     + "</p>")
+        html += ("<p><a href='/api/train'>/api/train</a> · "
+                 "`ray_tpu timeline --train` renders the per-stage "
+                 "rows · `ray_tpu doctor --post-mortem` explains "
+                 "crashes</p>")
+        return html
+
     def _render_core_panel(self) -> str:
         """Core-plane panel: RPC write path, object plane, pubsub and
         control-plane health at a glance."""
@@ -564,6 +625,7 @@ class _Handler(BaseHTTPRequestHandler):
         html += "<h2>object store</h2>" + _table(
             mem, ["node_id", "store", "spilled", "workers", "oom_kills"])
         html += self._render_serve_panel()
+        html += self._render_train_panel()
         html += self._render_core_panel()
         # Recent tasks with drill-down links.
         events = self.client.call("list_task_events", 20)

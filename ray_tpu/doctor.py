@@ -46,6 +46,19 @@ historically became hangs:
 ``diagnose`` is a pure function over snapshots so tests inject each
 fault into the REAL components and assert the doctor names it; the CLI
 (``python -m ray_tpu doctor``) wires it to a live controller.
+
+The second half (PR 15) is :func:`post_mortem`: where ``diagnose``
+needs a LIVE cluster, the post-mortem explains a death that already
+happened — a pure function over merged flight-recorder dumps
+(``util/flightrec.py``; ``--post-mortem`` on the CLI, via the
+controller's ``fr_dump`` RPC or ``--fr-dir`` with no cluster at all).
+Findings: **gang-death** (first-dying member in detection order,
+injected-kill corroboration, the stage it hosted, the surviving
+epoch), **stage-clock-stop** (the stage whose clock stopped, and
+when), **double-apply-guard** (a replay was about to double-apply and
+the snapshot re-push saved it — the loss curve is certifiably
+intact), **fault-injection** (every fired rule: chaos runs are
+self-documenting).
 """
 
 from __future__ import annotations
@@ -440,6 +453,234 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
     findings.sort(key=lambda f: (order.get(f["severity"], 9),
                                  f["signature"], f["source"]))
     return findings
+
+
+# ===================================================================
+# Post-mortem: forensics over flight-recorder dumps (util/flightrec.py)
+# ===================================================================
+#
+# ``diagnose`` needs a LIVE cluster (two metric snapshots). A gang
+# death or a SIGKILLed stage leaves no live gauges to read — but every
+# process's flight recorder persisted its last events. ``post_mortem``
+# is the pure function over those merged dumps: no cluster queries, no
+# metrics — evidence only. Input shape is ``flightrec.dump_all()``
+# (``{source: {"pid", "role", "events"}}``); events carry
+# ``{"ev", "ts", ...attrs}`` per the catalog in docs/OBSERVABILITY.md.
+
+
+def _merged_events(dumps: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Every dump's events tagged with their source, merged by
+    (wall-clock, source) — the one ordering forensics reasons over."""
+    out: List[Dict[str, Any]] = []
+    for source, doc in (dumps or {}).items():
+        for e in doc.get("events") or []:
+            if isinstance(e, dict) and "ev" in e:
+                out.append({**e, "source": source})
+    out.sort(key=lambda e: (float(e.get("ts", 0.0)), e.get("source", "")))
+    return out
+
+
+def _die_site_member(events: List[Dict[str, Any]], group: str
+                     ) -> Optional[Dict[str, Any]]:
+    """The fault-injection SIGKILL aimed at a member of ``group``
+    (site ``multihost.member.<group>.<member>.beat``), if one fired."""
+    for e in events:
+        if e.get("ev") != "fault.fired" or e.get("action") != "die":
+            continue
+        site = str(e.get("site", ""))
+        prefix = f"multihost.member.{group}."
+        if site.startswith(prefix) and site.endswith(".beat"):
+            member = site[len(prefix):-len(".beat")]
+            return {"member": member, "ts": e.get("ts"),
+                    "source": e.get("source")}
+    return None
+
+
+def post_mortem(dumps: Dict[str, Any],
+                stall_gap_s: float = 2.0) -> List[Dict[str, Any]]:
+    """Explain gang deaths and pipeline stalls from flight-recorder
+    dumps alone. Returns findings in the ``diagnose`` shape (severity /
+    signature / source / summary / evidence / remedy), most severe
+    first; empty = the dumps show an orderly history."""
+    events = _merged_events(dumps)
+    findings: List[Dict[str, Any]] = []
+
+    # Member -> recorder source (a member's own file goes silent when
+    # it dies; its last event timestamp is independent evidence).
+    member_source: Dict[Tuple[str, str], str] = {}
+    last_ts_by_source: Dict[str, float] = {}
+    for e in events:
+        last_ts_by_source[e["source"]] = float(e.get("ts", 0.0))
+        if e.get("ev") == "gang.member.up":
+            member_source[(str(e.get("group")), str(e.get("member")))] \
+                = e["source"]
+
+    # ------------------------------------------------------ gang death
+    groups = sorted({str(e.get("group")) for e in events
+                     if e.get("ev") == "gang.reconcile"})
+    for group in groups:
+        recs = [e for e in events if e.get("ev") == "gang.reconcile"
+                and str(e.get("group")) == group]
+        rec = recs[-1]
+        dead = [m for m in str(rec.get("dead", "")).split(",") if m]
+        first_dying = dead[0] if dead else "?"
+        kill = _die_site_member(events, group)
+        # Epoch the SURVIVING gang runs under: the newest registration
+        # after the reconcile (re-formation bumps it); a gang.dead
+        # event instead means nothing survived.
+        after = [e for e in events if float(e.get("ts", 0)) >=
+                 float(rec.get("ts", 0)) and str(e.get("group")) == group]
+        survived = [e for e in after
+                    if e.get("ev") in ("gang.register", "gang.form")]
+        died = [e for e in after if e.get("ev") == "gang.dead"]
+        new_epoch = max((int(e.get("epoch", 0)) for e in survived),
+                        default=None)
+        src = member_source.get((group, first_dying))
+        silent = (f"; its recorder went silent at "
+                  f"{last_ts_by_source[src]:.3f}" if src else "")
+        cause = (f"faultinject SIGKILL at its beat site "
+                 f"(fault.fired die in {kill['source']})"
+                 if kill and kill["member"] == first_dying
+                 else str(rec.get("cause", "member death")))
+        outcome = (f"the gang re-formed and survives under epoch "
+                   f"{new_epoch}" if new_epoch is not None else
+                   (f"the gang is DEAD ({died[-1].get('cause')})"
+                    if died else "no re-formation on record"))
+        # Pipeline gangs place stage k on member host-k: name the stage
+        # too when the group hosts a pipeline on record.
+        stage_note = ""
+        if group.endswith("-gang"):
+            pipe_name = group[:-len("-gang")]
+            if any(str(e.get("pipeline")) == pipe_name for e in events
+                   if str(e.get("ev", "")).startswith("pipe.stage.")) \
+                    and first_dying.startswith("host-"):
+                stage_note = (f" (pipeline {pipe_name!r} stage "
+                              f"s{first_dying[len('host-'):]})")
+        findings.append({
+            "signature": "gang-death", "severity": "critical",
+            "source": f"group:{group}",
+            "summary": (f"group {group!r}: member {first_dying}"
+                        f"{stage_note} died first ({cause}){silent}; "
+                        f"the monitor reconciled the whole gang of "
+                        f"epoch {int(rec.get('epoch', 0))} "
+                        f"(dead: {', '.join(dead)}); {outcome}"),
+            "evidence": {"first_dying": first_dying, "dead": dead,
+                         "old_epoch": int(rec.get("epoch", 0)),
+                         "surviving_epoch": new_epoch,
+                         "injected": bool(kill),
+                         "stage": (stage_note.strip(" ()") or None)},
+            "remedy": ("read the victim's worker log; if the death was "
+                       "not injected, check the host (OOM killer, "
+                       "preemption). Replays are safe: see the "
+                       "double-apply-guard finding if one fired"),
+        })
+
+    # ------------------------------------------------ stage clock stop
+    pipes = sorted({str(e.get("pipeline")) for e in events
+                    if str(e.get("ev", "")).startswith("pipe.stage.")})
+    for pipe in pipes:
+        by_stage: Dict[int, Dict[str, Any]] = {}
+        for e in events:
+            if not str(e.get("ev", "")).startswith("pipe.stage."):
+                continue
+            if str(e.get("pipeline")) != pipe or e.get("stage") is None:
+                continue
+            s = int(e["stage"])
+            cur = by_stage.setdefault(s, {"last_ts": 0.0, "step": -1})
+            cur["last_ts"] = max(cur["last_ts"], float(e.get("ts", 0)))
+            if e.get("ev") in ("pipe.stage.begin", "pipe.stage.apply"):
+                cur["step"] = max(cur["step"], int(e.get("step", -1)))
+        if len(by_stage) < 2:
+            continue
+        live_ts = max(v["last_ts"] for v in by_stage.values())
+        max_step = max(v["step"] for v in by_stage.values())
+        stopped = sorted(
+            s for s, v in by_stage.items()
+            if live_ts - v["last_ts"] >= stall_gap_s
+            or v["step"] < max_step - 1)
+        if not stopped:
+            continue
+        worst = stopped[0]
+        v = by_stage[worst]
+        findings.append({
+            "signature": "stage-clock-stop", "severity": "critical",
+            "source": f"pipeline:{pipe}",
+            "summary": (f"pipeline {pipe!r}: stage "
+                        f"{', '.join(f's{s}' for s in stopped)} "
+                        f"stopped — s{worst}'s clock last moved at "
+                        f"step {v['step']} "
+                        f"({live_ts - v['last_ts']:.1f}s before the "
+                        f"rest of the pipeline went quiet, max step "
+                        f"{max_step}) — the stage whose clock stopped "
+                        f"is where the step died"),
+            "evidence": {"stopped_stages": [f"s{s}" for s in stopped],
+                         "stage_clocks": {f"s{s}": v["step"]
+                                          for s, v in by_stage.items()},
+                         "max_step": max_step},
+            "remedy": ("if a gang-death finding names the matching "
+                       "member (stage k = host-k), this is its stage-"
+                       "side shadow; otherwise the stage process "
+                       "wedged without dying — its worker log and "
+                       "`ray_tpu stacks` are next"),
+        })
+
+    # ------------------------------------------- double-apply guard
+    for e in events:
+        if e.get("ev") != "pipe.clock.drift":
+            continue
+        findings.append({
+            "signature": "double-apply-guard", "severity": "warning",
+            "source": f"pipeline:{e.get('pipeline')}",
+            "summary": (f"pipeline {e.get('pipeline')!r}: the replay "
+                        f"double-apply guard FIRED at step "
+                        f"{int(e.get('step', -1))} (stage clocks "
+                        f"{e.get('clocks')}) — an apply reply was "
+                        f"lost AFTER stages applied, and the plane "
+                        f"re-pushed the snapshot instead of double-"
+                        f"applying; the loss curve is intact"),
+            "evidence": {"step": int(e.get("step", -1)),
+                         "clocks": str(e.get("clocks", ""))},
+            "remedy": ("none needed — this is the guard working; "
+                       "repeated fires point at a lossy link between "
+                       "driver and stages"),
+        })
+
+    # ----------------------------------------------- injected faults
+    fires = [e for e in events if e.get("ev") == "fault.fired"]
+    if fires:
+        findings.append({
+            "signature": "fault-injection", "severity": "warning",
+            "source": "faultinject",
+            "summary": (f"{len(fires)} fault-injection rule(s) fired "
+                        f"during this history: "
+                        + "; ".join(f"{e.get('action')}@{e.get('site')}"
+                                    for e in fires[:6])
+                        + ("…" if len(fires) > 6 else "")),
+            "evidence": {"fires": [
+                {"site": e.get("site"), "action": e.get("action"),
+                 "ts": e.get("ts"), "source": e.get("source")}
+                for e in fires]},
+            "remedy": ("expected under chaos testing; in production "
+                       "this means a rules file is configured — check "
+                       "RAY_TPU_FAULTINJECT_PATH"),
+        })
+
+    order = {"critical": 0, "warning": 1}
+    findings.sort(key=lambda f: (order.get(f["severity"], 9),
+                                 f["signature"], f["source"]))
+    return findings
+
+
+def render_post_mortem(findings: List[Dict[str, Any]],
+                       dumps: Dict[str, Any]) -> str:
+    head = (f"post-mortem over {len(dumps)} recorder dump(s), "
+            f"{sum(len(d.get('events') or []) for d in dumps.values())} "
+            f"events")
+    if not findings:
+        return (f"{head}\nno deaths or stalls on record (checked: "
+                f"gang-death, stage-clock-stop, double-apply-guard, "
+                f"fault-injection)")
+    return f"{head}\n{render(findings)}"
 
 
 def collect(client, interval_s: float = 2.0

@@ -209,10 +209,31 @@ def build_zero1_train_step(
     opt_shardings = zero1_state_shardings(mesh, state_shape, zero1_rules)
     replicated_sh = NamedSharding(mesh, P())
     param_shardings = jax.tree.map(lambda _: replicated_sh, params)
-    return build_train_step(
+    step = build_train_step(
         loss_fn, optimizer, mesh, rules=rules,
         extra_metrics=extra_metrics, accum_steps=accum_steps,
         out_shardings=(param_shardings, opt_shardings, None))
+
+    def traced_step(params, opt_state, batch):
+        """One span per ZeRO-1 step when a trace is active (the
+        params all-gather is the out_shardings pin INSIDE the jitted
+        program, so the span covers update+gather as one unit —
+        `ray_tpu timeline --train` renders it on the learner's row).
+        Untraced callers pay one contextvar read."""
+        from ray_tpu.util import tracing
+
+        if not tracing.traced():
+            return step(params, opt_state, batch)
+        import time as _time
+
+        t0 = _time.time()
+        out = step(params, opt_state, batch)
+        jax.block_until_ready(out[0])
+        tracing.record_span("zero1:step", t0, _time.time(),
+                            allgather="params", zero1=True)
+        return out
+
+    return traced_step
 
 
 def per_replica_state_bytes(opt_state) -> int:

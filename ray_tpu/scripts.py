@@ -151,13 +151,24 @@ def cmd_list(args) -> None:
         raise SystemExit(f"unknown kind {kind!r}")
 
 
-def _fmt_summary(s: Optional[Dict[str, Any]]) -> str:
+def _fmt_summary(s: Optional[Dict[str, Any]],
+                 unit: str = "ms") -> str:
     if not s or not s.get("count"):
         return "-"
-    def ms(v):
-        return f"{v * 1e3:.2f}ms" if v is not None else "-"
-    return (f"n={s['count']} mean={ms(s.get('mean'))} "
-            f"p50={ms(s.get('p50'))} p99={ms(s.get('p99'))}")
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if unit == "B":  # byte histograms (e.g. pipeline_desc_bytes)
+            return f"{v:.0f}B"
+        return f"{v * 1e3:.2f}ms"
+
+    return (f"n={s['count']} mean={fmt(s.get('mean'))} "
+            f"p50={fmt(s.get('p50'))} p99={fmt(s.get('p99'))}")
+
+
+def _summary_unit(name: str) -> str:
+    return "B" if "bytes" in name else "ms"
 
 
 def cmd_metrics(args) -> None:
@@ -177,14 +188,16 @@ def cmd_metrics(args) -> None:
     summary = core_summary(agg)
     print(f"sources: {len(agg)} "
           f"({', '.join(sorted(agg)[:8])}{'…' if len(agg) > 8 else ''})")
-    for plane in ("rpc", "objects", "pubsub", "control"):
+    for plane in ("rpc", "objects", "pubsub", "control", "multihost",
+                  "pipeline"):
         print(f"\n[{plane}]")
         for field, value in summary[plane].items():
+            unit = _summary_unit(field)
             if isinstance(value, dict) and {"count", "p50"} <= set(value):
-                print(f"  {field:28s} {_fmt_summary(value)}")
+                print(f"  {field:28s} {_fmt_summary(value, unit)}")
             elif isinstance(value, dict):
                 for label, inner in sorted(value.items()):
-                    text = (_fmt_summary(inner)
+                    text = (_fmt_summary(inner, unit)
                             if isinstance(inner, dict) else f"{inner:g}")
                     print(f"  {field:28s} {label}: {text}")
             else:
@@ -199,14 +212,36 @@ def cmd_metrics(args) -> None:
                 tags = ",".join(f"{k}={v}" for k, v in key)
                 label = f"{name}{{{tags}}}" if tags else name
                 print(f"  {label:44s} "
-                      f"{_fmt_summary(histogram_summary(entry))}")
+                      f"{_fmt_summary(histogram_summary(entry), _summary_unit(name))}")
 
 
 def cmd_doctor(args) -> int:
     """Diagnose cluster failure signatures from two metric snapshots a
-    window apart (see ray_tpu/doctor.py for the signature catalog)."""
+    window apart (see ray_tpu/doctor.py for the signature catalog).
+    With ``--post-mortem``, skip the live snapshots entirely and
+    explain a gang death / pipeline stall from flight-recorder dumps
+    (``--fr-dir`` reads persisted fr-<pid>.json files directly — no
+    cluster needed, the crashed-cluster case; otherwise the
+    controller's ``fr_dump`` RPC merges its host's dumps)."""
     from ray_tpu import doctor
 
+    if getattr(args, "post_mortem", False):
+        if args.fr_dir:
+            from ray_tpu.util import flightrec
+
+            dumps = flightrec.dump_all(args.fr_dir)
+        else:
+            from ray_tpu.core.rpc_stubs import ControllerStub
+
+            dumps = ControllerStub(_client(args)).fr_dump()
+        findings = doctor.post_mortem(dumps)
+        if args.json:
+            print(json.dumps(findings, indent=2, default=str))
+        else:
+            print(doctor.render_post_mortem(findings, dumps))
+        if findings and args.fail_on_findings:
+            return 2
+        return 0
     client = _client(args)
     before, after, nodes, interval = doctor.collect(client, args.interval)
     findings = doctor.diagnose(before, after, interval, nodes=nodes)
@@ -277,7 +312,86 @@ def build_chrome_trace(events: List[Dict[str, Any]],
             trace.append({"name": "process_name", "ph": "M", "pid": pid,
                           "args": {"name": f"engine {replica_id}"}})
             trace.extend(timeline_chrome_events(dump, pid=pid))
+    # Train-plane stage rows: every process that emitted 1F1B cell
+    # spans (fwd/bwd/apply with a stage attr) is one pipeline stage —
+    # name its row so the bubble structure reads as a GPipe diagram,
+    # not a pile of anonymous worker addresses.
+    stage_pids: Dict[str, int] = {}
+    for e in events:
+        attrs = e.get("attrs") or {}
+        if (e.get("state") == "SPAN" and "stage" in attrs
+                and e.get("desc") in ("fwd", "bwd", "apply", "snap")
+                and attrs.get("stage") is not None):
+            stage_pids.setdefault(str(e.get("owner", "driver")),
+                                  int(attrs["stage"]))
+    for pid, stage in stage_pids.items():
+        trace.append({"name": "process_name", "ph": "M", "pid": pid,
+                      "args": {"name": f"stage s{stage}"}})
+        trace.append({"name": "process_sort_index", "ph": "M",
+                      "pid": pid, "args": {"sort_index": stage}})
     return trace
+
+
+def train_trace_summary(events: List[Dict[str, Any]]
+                        ) -> Dict[str, Dict[str, Any]]:
+    """Per-pipeline occupancy summary from the train-plane spans. Two
+    families feed it: the DRIVER's ``cell:fwd``/``cell:bwd`` spans
+    (dispatch->reply per 1F1B cell — exactly the clocks
+    ``bench_pipeline.py``'s bubble rows are computed from) give the
+    per-stage busy seconds, and the ``pipe:step`` root spans give the
+    step window; the derived bubble fraction
+    ``1 - sum(busy) / (stages * window)`` therefore matches the
+    bench's ``(S-1)/(m+S-1)`` rows by construction (tests pin the two
+    within 10%). ``compute_s`` separately sums the STAGE-side
+    fwd/bwd spans — pure stage compute occupancy, which on a
+    time-sliced CPU host is much smaller than dispatch->reply."""
+    # The step root span carries the pipeline name; its trace_id links
+    # every cell to it across processes.
+    pipeline_of: Dict[str, str] = {}
+    windows: Dict[str, float] = {}
+    for e in events:
+        attrs = e.get("attrs") or {}
+        if (e.get("state") == "SPAN" and e.get("desc") == "pipe:step"
+                and e.get("trace_id") and attrs.get("pipeline")
+                and e.get("lease_ts") and e.get("end_ts")):
+            pipe = str(attrs["pipeline"])
+            pipeline_of[e["trace_id"]] = pipe
+            windows[pipe] = (windows.get(pipe, 0.0)
+                             + (e["end_ts"] - e["lease_ts"]))
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        attrs = e.get("attrs") or {}
+        desc = e.get("desc", "")
+        if (e.get("state") != "SPAN" or attrs.get("stage") is None
+                or not e.get("lease_ts") or not e.get("end_ts")):
+            continue
+        is_cell = desc in ("cell:fwd", "cell:bwd")
+        is_compute = desc in ("fwd", "bwd", "apply")
+        if not (is_cell or is_compute):
+            continue
+        pipe = pipeline_of.get(e.get("trace_id"))
+        if pipe is None:
+            continue
+        rec = out.setdefault(pipe, {"stages": {}, "compute_s": {},
+                                    "cells": 0})
+        key = f"s{int(attrs['stage'])}"
+        dur = e["end_ts"] - e["lease_ts"]
+        if is_cell:
+            rec["stages"][key] = rec["stages"].get(key, 0.0) + dur
+            rec["cells"] += 1
+        else:
+            rec["compute_s"][key] = (rec["compute_s"].get(key, 0.0)
+                                     + dur)
+    for pipe, rec in out.items():
+        window = max(windows.get(pipe, 0.0), 1e-9)
+        busy = sum(rec["stages"].values())
+        n_stages = max(len(rec["stages"]) or len(rec["compute_s"]), 1)
+        rec["n_stages"] = n_stages
+        rec["window_s"] = window
+        rec["busy_s"] = busy
+        rec["bubble_fraction"] = max(
+            0.0, 1.0 - busy / (n_stages * window))
+    return out
 
 
 def cmd_timeline(args) -> None:
@@ -308,6 +422,21 @@ def cmd_timeline(args) -> None:
     n_engine = sum(1 for t in trace if t.get("cat") == "engine-step")
     print(f"wrote {len(trace)} events ({n_spans} spans, {n_engine} "
           f"engine-step slices) to {args.output}")
+    if getattr(args, "train", False):
+        # The train-plane read of the same trace: per-stage occupancy
+        # + the measured bubble fraction (compare against the
+        # bench_pipeline (S-1)/(m+S-1) rows).
+        summary = train_trace_summary(events)
+        if not summary:
+            print("no train-plane spans in the window (is "
+                  "pipe_trace_spans on, and did a pipeline step run?)")
+        for pipe, rec in sorted(summary.items()):
+            busy = ", ".join(f"{s}={v:.3f}s" for s, v in
+                             sorted(rec["stages"].items()))
+            print(f"pipeline {pipe}: {rec['n_stages']} stages, "
+                  f"{rec['cells']} cells over {rec['window_s']:.3f}s — "
+                  f"bubble fraction {rec['bubble_fraction']:.3f} "
+                  f"({busy})")
 
 
 def cmd_start(args) -> int:
@@ -633,6 +762,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_doc.add_argument("--json", action="store_true")
     p_doc.add_argument("--fail-on-findings", action="store_true",
                        help="exit 2 when any signature is detected")
+    p_doc.add_argument("--post-mortem", action="store_true",
+                       help="explain a gang death / pipeline stall "
+                            "from flight-recorder dumps instead of "
+                            "live metric snapshots")
+    p_doc.add_argument("--fr-dir", default=None,
+                       help="post-mortem: read persisted fr-<pid>.json "
+                            "dumps from this directory directly (no "
+                            "cluster needed); default asks the "
+                            "controller's fr_dump RPC")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
@@ -640,6 +778,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                       help="merge every serve replica's engine step "
                            "timeline into the trace (joins the cluster "
                            "to reach the serve controller)")
+    p_tl.add_argument("--train", action="store_true",
+                      help="print the train-plane per-stage occupancy "
+                           "summary (trace-derived 1F1B bubble "
+                           "fraction) for the pipeline spans in the "
+                           "window")
     sub.add_parser("stacks")
     p_prof = sub.add_parser("profile")
     p_prof.add_argument("worker", help="worker id (hex prefix ok)")

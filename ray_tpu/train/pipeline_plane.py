@@ -71,16 +71,34 @@ import os
 import threading
 import time
 from collections import deque
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.core.config import config as _cfg
 from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.multihost import HostGroup, HostWorker
-from ray_tpu.util import faultinject
+from ray_tpu.util import faultinject, flightrec, tracing
 from ray_tpu.util.ratelimit import log_every
 
 logger = logging.getLogger(__name__)
+
+_NULL_CTX = nullcontext()
+
+
+def _stage_span(name: str, **attrs):
+    """A stage-side tracing span for one 1F1B cell (fwd/bwd/apply with
+    ``{step, mb, stage}`` attrs). Emitted only when the driver's step
+    span was propagated into this call (``tracing.traced()``) AND the
+    train-plane knob is on — an untraced step pays one contextvar read
+    plus one config attribute read per stage call. Each stage actor is
+    its own process, so these spans ARE the per-stage rows
+    ``ray_tpu timeline --train`` renders: the gaps between them are the
+    1F1B bubble, visible instead of inferred."""
+    if not (_cfg.pipe_trace_spans and tracing.traced()):
+        return _NULL_CTX
+    return tracing.trace(name, **attrs)
 
 # A stage RPC is metadata-only by contract; anything close to this many
 # serialized bytes means tensor bytes leaked into the control path
@@ -213,6 +231,9 @@ class StageActor(HostWorker):
             self._losses.clear()
             self._g_acc = None
             self._step = int(state.get("step", 0))
+            flightrec.record("pipe.stage.setup",
+                             pipeline=str(spec["pipeline"]), stage=stage,
+                             step=self._step, epoch=int(spec["epoch"]))
             return {"stage": stage, "step": self._step}
 
     def _make_apply(self):
@@ -248,6 +269,14 @@ class StageActor(HostWorker):
             self._stash.clear()
             self._losses.clear()
             self._g_acc = None
+            # The stage CLOCK, on the record: the post-mortem's "which
+            # stage's clock stopped / drifted" evidence survives this
+            # process (``asked`` is the driver's step — a mismatch here
+            # is the double-apply guard's trigger).
+            flightrec.record("pipe.stage.begin",
+                             pipeline=str(self._spec["pipeline"]),
+                             stage=int(self._spec["stage"]),
+                             step=self._step, asked=int(step))
             return {"stage": int(self._spec["stage"]),
                     "step": self._step}
 
@@ -293,22 +322,27 @@ class StageActor(HostWorker):
             faultinject.check(
                 f"pipeline.stage.{spec['pipeline']}.{spec['stage']}.fwd")
         last = int(spec["stage"]) == int(spec["n_stages"]) - 1
-        # Pulls stay OUTSIDE the compute lock: the object-plane read
-        # must never serialize behind a running jit program (or vice
-        # versa — gang control traffic shares this actor).
-        x = self._pull(in_desc)
-        targets = self._pull(tgt_desc) if last else None
-        with self._compute_lock:
-            if last:
-                self._stash[int(mb)] = (x, targets)
-                loss = self._fwd(self._params, x, targets)
-                self._losses[int(mb)] = float(loss)
-                return {"kind": "loss", "mb": int(mb),
-                        "stage": int(spec["stage"]),
-                        "loss": float(loss)}
-            self._stash[int(mb)] = x
-            out = self._fwd(self._params, x)
-            return self._ship("act", mb, out)
+        # One span per 1F1B forward cell; the object-plane pulls inside
+        # nest their object:get spans under it (flow arrows in the
+        # timeline show the activation handoff between stage rows).
+        with _stage_span("fwd", step=self._step, mb=int(mb),
+                         stage=int(spec["stage"])):
+            # Pulls stay OUTSIDE the compute lock: the object-plane
+            # read must never serialize behind a running jit program
+            # (or vice versa — gang control traffic shares this actor).
+            x = self._pull(in_desc)
+            targets = self._pull(tgt_desc) if last else None
+            with self._compute_lock:
+                if last:
+                    self._stash[int(mb)] = (x, targets)
+                    loss = self._fwd(self._params, x, targets)
+                    self._losses[int(mb)] = float(loss)
+                    return {"kind": "loss", "mb": int(mb),
+                            "stage": int(spec["stage"]),
+                            "loss": float(loss)}
+                self._stash[int(mb)] = x
+                out = self._fwd(self._params, x)
+                return self._ship("act", mb, out)
 
     def backward(self, mb: int,
                  g_desc: Optional[Dict[str, Any]] = None
@@ -323,32 +357,38 @@ class StageActor(HostWorker):
         spec = self._spec
         first = int(spec["stage"]) == 0
         last = int(spec["stage"]) == int(spec["n_stages"]) - 1
-        g_out = None if last else self._pull(g_desc)
-        with self._compute_lock:
-            residual = self._stash.pop(int(mb))
-            if last:
-                x, targets = residual
-                _loss, g_params, g_x = self._bwd(self._params, x,
-                                                 targets)
-            else:
-                g_params, g_x = self._bwd(self._params, residual, g_out)
-            if self._g_acc is None:
-                self._g_acc = jax.tree.map(
-                    lambda g: g.astype("float32"), g_params)
-            else:
-                self._g_acc = jax.tree.map(
-                    lambda a, g: a + g.astype(a.dtype), self._g_acc,
-                    g_params)
-            if first:
-                return {"kind": "bwd0", "mb": int(mb), "stage": 0}
-            return self._ship("grad", mb, g_x)
+        with _stage_span("bwd", step=self._step, mb=int(mb),
+                         stage=int(spec["stage"])):
+            g_out = None if last else self._pull(g_desc)
+            with self._compute_lock:
+                residual = self._stash.pop(int(mb))
+                if last:
+                    x, targets = residual
+                    _loss, g_params, g_x = self._bwd(self._params, x,
+                                                     targets)
+                else:
+                    g_params, g_x = self._bwd(self._params, residual,
+                                              g_out)
+                if self._g_acc is None:
+                    self._g_acc = jax.tree.map(
+                        lambda g: g.astype("float32"), g_params)
+                else:
+                    self._g_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), self._g_acc,
+                        g_params)
+                if first:
+                    return {"kind": "bwd0", "mb": int(mb), "stage": 0}
+                return self._ship("grad", mb, g_x)
 
     def apply_update(self, n_micro: int, step: int) -> Dict[str, Any]:
         """One optimizer update from the accumulated gradients (mean
         over microbatches). ``step`` must match this stage's clock —
         a re-formed gang resuming from a snapshot must never double-
         apply."""
-        with self._compute_lock:
+        with _stage_span("apply", step=int(step),
+                         stage=(None if self._spec is None
+                                else int(self._spec["stage"]))), \
+                self._compute_lock:
             if step != self._step:
                 raise PipelineError(
                     f"stage {self._spec['stage']} asked to apply step "
@@ -364,6 +404,13 @@ class StageActor(HostWorker):
             self._g_acc = None
             losses, self._losses = self._losses, {}
             self._step += 1
+            # The clock ADVANCED: with this on the record, a lost apply
+            # reply is distinguishable post-mortem from an apply that
+            # never ran (the double-apply guard's two cases).
+            flightrec.record("pipe.stage.apply",
+                             pipeline=str(self._spec["pipeline"]),
+                             stage=int(self._spec["stage"]),
+                             step=self._step)
             return {"stage": int(self._spec["stage"]),
                     "step": self._step, "grad_norm": float(gnorm),
                     "losses": losses}
@@ -382,7 +429,15 @@ class StageActor(HostWorker):
             faultinject.check(
                 f"pipeline.stage.{spec['pipeline']}.{spec['stage']}"
                 f".snap")
-        with self._compute_lock:
+        with _stage_span("snap",
+                         stage=(None if spec is None
+                                else int(spec["stage"])),
+                         step=self._step), self._compute_lock:
+            if spec is not None:
+                flightrec.record("pipe.stage.snap",
+                                 pipeline=str(spec["pipeline"]),
+                                 stage=int(spec["stage"]),
+                                 step=self._step)
             return {
                 "stage": int(self._spec["stage"]),
                 "step": self._step,
@@ -578,6 +633,14 @@ class PipelinePlane:
         # input-gradients backward) moved through the object plane.
         self._tensor_bytes_moved = 0
         self._inflight_mbs = 0
+        # Last completed step's phase split (driver-observed stage-
+        # seconds: fwd/bwd summed over dispatch->reply, apply = fan-out
+        # wall x stages, idle = the remainder of stages x step wall) +
+        # the achieved-FLOPs estimate behind the MFU gauge.
+        self._last_breakdown: Optional[Dict[str, float]] = None
+        self._n_params = int(sum(
+            np.asarray(x).size
+            for x in _tree_leaves(self._init_params)))
         from ray_tpu.util import metrics as um
 
         um.add_collector(self._collect)
@@ -702,6 +765,9 @@ class PipelinePlane:
                 raise PipelineError(
                     f"stage {i} resumed at step {rep['step']}, plane "
                     f"expected {resume_step}")
+        flightrec.record("pipe.snapshot.push", pipeline=self.name,
+                         step=resume_step, stages=self.n_stages,
+                         epoch=epoch)
         with self._lock:
             self._step = resume_step
             self._gang_epoch = group.epoch
@@ -755,6 +821,9 @@ class PipelinePlane:
                                      group.group_id,
                                      f"pid:{os.getpid()}")
             self._adopt_epoch(reg)
+        flightrec.record("pipe.resetup", pipeline=self.name,
+                         step=self._step, epoch=self._epoch,
+                         drift=self._need_resetup)
         self._setup_stages(group, self._epoch)
         self._need_resetup = False
         logger.info(
@@ -812,12 +881,35 @@ class PipelinePlane:
         if len(mbs) != self.n_microbatches:
             raise ValueError(f"expected {self.n_microbatches} "
                              f"microbatches, got {len(mbs)}")
+        from contextlib import nullcontext
+
+        from ray_tpu.core.config import config as rt_config
+
         attempts = self._max_group_restarts + 1
         for attempt in range(attempts):
             self._ensure_gang()
             try:
-                return self._run_step_once(mbs)
+                # The root span of the train-plane trace: every stage's
+                # fwd/bwd/apply span parents under it through the task
+                # specs, so one optimizer step is one causally-linked
+                # tree across the stage processes. Head-sampled: only
+                # every pipe_trace_sample_every'th step opens the root,
+                # and the ~180 downstream span events of an unsampled
+                # step never exist (stage/cell emission gates on the
+                # propagated context).
+                sample = max(1, rt_config.pipe_trace_sample_every)
+                span = (tracing.trace("pipe:step", pipeline=self.name,
+                                      step=self._step, mbs=len(mbs),
+                                      attempt=attempt)
+                        if (rt_config.pipe_trace_spans
+                            and self._step % sample == 0)
+                        else nullcontext())
+                with span:
+                    return self._run_step_once(mbs)
             except _GangDisrupted as e:
+                flightrec.record("pipe.disrupted", pipeline=self.name,
+                                 step=self._step, reason=str(e),
+                                 attempt=attempt)
                 dropped = self._drop_inflight()
                 logger.warning(
                     "pipeline %s: step %d disrupted (%s); dropped %d "
@@ -863,11 +955,22 @@ class PipelinePlane:
             # (re)run — its apply REPLY was lost, not its update.
             # Running against drifted (possibly mixed) clocks would
             # double-apply; rewind every stage to a consistent step
-            # from the snapshot first.
+            # from the snapshot first. On the record: this is the
+            # replay DOUBLE-APPLY GUARD firing — the post-mortem
+            # reports it so a resumed-run loss curve can be trusted
+            # (or not) from evidence.
+            flightrec.record("pipe.clock.drift", pipeline=self.name,
+                             step=self._step,
+                             clocks=",".join(str(c) for c in clocks))
             self._need_resetup = True
             raise _GangDisrupted(
                 f"stage clocks {clocks} drifted from plane step "
                 f"{self._step}; re-pushing the snapshot")
+        flightrec.record("pipe.step.start", pipeline=self.name,
+                         step=self._step, mbs=len(mbs))
+        t_step0 = time.monotonic()
+        phase_s = {"fwd": 0.0, "bwd": 0.0}
+        tokens = int(sum(np.asarray(mb["inputs"]).size for mb in mbs))
         S, n = self.n_stages, len(mbs)
         last = S - 1
         ready_fwd: List[deque] = [deque() for _ in range(S)]
@@ -905,12 +1008,14 @@ class PipelinePlane:
                 if ready_bwd[s]:
                     m, gdesc = ready_bwd[s].popleft()
                     ref = members[s].backward.remote(m, gdesc)
-                    task_by_ref[ref] = ("bwd", m, s, gdesc)
+                    task_by_ref[ref] = ("bwd", m, s, gdesc,
+                                        time.time())
                 elif ready_fwd[s]:
                     m, in_desc = ready_fwd[s].popleft()
                     tgt = tgt_descs[m] if s == last else None
                     ref = members[s].forward.remote(m, in_desc, tgt)
-                    task_by_ref[ref] = ("fwd", m, s, in_desc)
+                    task_by_ref[ref] = ("fwd", m, s, in_desc,
+                                        time.time())
                 else:
                     return
                 with self._lock:
@@ -942,7 +1047,7 @@ class PipelinePlane:
                         raise _GangDisrupted("gang epoch moved")
                     continue
                 for ref in done:
-                    kind, m, s, consumed = task_by_ref.pop(ref)
+                    kind, m, s, consumed, t_disp = task_by_ref.pop(ref)
                     try:
                         reply = ray_tpu.get(ref, timeout=30.0)
                     except Exception as e:
@@ -951,6 +1056,18 @@ class PipelinePlane:
                             f"{type(e).__name__}") from e
                     self._observe_desc(serialized_size(reply))
                     now = time.monotonic()
+                    if rt_config.pipe_trace_spans and tracing.traced():
+                        # The DRIVER's view of the same cell
+                        # (dispatch -> reply) — exactly the clocks the
+                        # bench's bubble fraction is computed from, so
+                        # the trace-derived bubble matches it by
+                        # construction (the stage-side fwd/bwd spans
+                        # show pure compute occupancy, which on a
+                        # time-sliced host is much smaller).
+                        tracing.record_span(f"cell:{kind}", t_disp,
+                                            time.time(), step=self._step,
+                                            mb=m, stage=s)
+                    phase_s[kind] += now - self._stage_busy_since[s]
                     with self._lock:
                         self._stage_busy[s] = None
                         self._stage_busy_s[s] += \
@@ -983,6 +1100,7 @@ class PipelinePlane:
                         dispatch(st)
 
             # ---- all microbatches backpropagated: one update per stage
+            t_apply0 = time.monotonic()
             refs = [a.apply_update.remote(n, self._step)
                     for a in members]
             try:
@@ -990,6 +1108,7 @@ class PipelinePlane:
             except Exception as e:
                 raise _GangDisrupted(
                     f"apply_update failed: {type(e).__name__}") from e
+            apply_wall = time.monotonic() - t_apply0
             # Snapshot BEFORE any driver bookkeeping: if the gang DIES
             # during the pull, this step's effects are lost with it and
             # the replay (from the previous snapshot, with the same
@@ -1020,10 +1139,35 @@ class PipelinePlane:
                 f"bug)")
         step_loss = float(np.mean(np.asarray(
             [losses[m] for m in range(n)], np.float32)))
+        wall = time.monotonic() - t_step0
+        # Per-step phase split in STAGE-SECONDS (the Gemma-on-TPU MFU
+        # accounting discipline: know where every stage-second of the
+        # step went). fwd/bwd sum driver-observed dispatch->reply
+        # occupancy; apply is the concurrent fan-out charged to every
+        # stage; idle is the remainder — the measured 1F1B bubble plus
+        # control-plane overhead. allgather stays 0 here: ZeRO-1
+        # composed inside a pipelined stage's data mesh is a real-rig
+        # item (ROADMAP #5); the zero1 data-parallel step exports its
+        # own span instead.
+        apply_s = apply_wall * S
+        idle_s = max(0.0, S * wall - phase_s["fwd"] - phase_s["bwd"]
+                     - apply_s)
+        # Achieved model FLOP/s: ~8 * params * tokens per step (2 fwd
+        # + 4 bwd + 2 recompute-fwd — the stage backward recomputes its
+        # forward inside jax.vjp).
+        tflops = (8.0 * self._n_params * tokens) / max(wall, 1e-9) / 1e12
         with self._lock:
             self._step = completed + 1
             self._losses.append(step_loss)
             self._inflight_mbs = 0
+            self._last_breakdown = {
+                "fwd_s": phase_s["fwd"], "bwd_s": phase_s["bwd"],
+                "apply_s": apply_s, "allgather_s": 0.0,
+                "idle_s": idle_s, "wall_s": wall,
+                "tokens": float(tokens), "model_tflops": tflops,
+            }
+        flightrec.record("pipe.step.commit", pipeline=self.name,
+                         step=completed)
         self._report_step(completed)
         return step_loss
 
@@ -1080,6 +1224,9 @@ class PipelinePlane:
                     raise _GangDisrupted(
                         f"snapshot failed: {type(e).__name__}") from e
                 if attempt == 2:
+                    flightrec.record("pipe.snapshot.forfeit",
+                                     pipeline=self.name,
+                                     step=self._step)
                     log_every(
                         "pipeline.snapshot", 10.0, logger,
                         "pipeline %s: snapshot at step %d failed %d "
@@ -1094,6 +1241,8 @@ class PipelinePlane:
                 # applied the update this snapshot captures).
                 self._snapshot = {"step": int(snaps[0]["step"]),
                                   "stages": snaps}
+            flightrec.record("pipe.snapshot.pull", pipeline=self.name,
+                             step=int(snaps[0]["step"]))
             return
 
     # --------------------------------------------------------- surface
@@ -1126,6 +1275,8 @@ class PipelinePlane:
                 "stage_busy": busy,
                 "stage_busy_s": list(self._stage_busy_s),
                 "tensor_bytes_moved": self._tensor_bytes_moved,
+                "step_breakdown": (dict(self._last_breakdown)
+                                   if self._last_breakdown else None),
             }
         out["group"] = None if self._group is None \
             else self._group.status()
@@ -1157,6 +1308,8 @@ class PipelinePlane:
                     for i in range(self.n_stages)]
             inflight = float(self._inflight_mbs)
             act_bytes = float(self._ledger.live_bytes())
+            breakdown = (dict(self._last_breakdown)
+                         if self._last_breakdown else None)
         # Pipeline names and stage indexes are bounded by live planes
         # (a handful per driver), not request volume.
         # graftlint: disable=metrics-label-cardinality
@@ -1168,6 +1321,21 @@ class PipelinePlane:
             # graftlint: disable=metrics-label-cardinality
             cm.PIPE_STAGE_IDLE_S.set(idle, tags={"pipeline": self.name,
                                                  "stage": stage})
+        if breakdown is not None:
+            for phase in ("fwd", "bwd", "apply", "allgather", "idle"):
+                # graftlint: disable=metrics-label-cardinality
+                cm.PIPE_STEP_PHASE_S.set(
+                    breakdown[f"{phase}_s"],
+                    tags={"pipeline": self.name, "phase": phase})
+            # graftlint: disable=metrics-label-cardinality
+            cm.PIPE_MODEL_TFLOPS.set(breakdown["model_tflops"],
+                                     tags={"pipeline": self.name})
+            peak = rt_config.pipe_peak_tflops
+            if peak > 0:
+                # graftlint: disable=metrics-label-cardinality
+                cm.PIPE_MFU.set(
+                    100.0 * breakdown["model_tflops"] / peak,
+                    tags={"pipeline": self.name})
 
     def stop(self) -> Dict[str, Any]:
         """Deterministic teardown: drop every in-flight ref, flatten
@@ -1210,6 +1378,15 @@ class PipelinePlane:
             # graftlint: disable=metrics-label-cardinality
             cm.PIPE_STAGE_IDLE_S.set(0.0, tags={"pipeline": self.name,
                                                 "stage": f"s{i}"})
+        for phase in ("fwd", "bwd", "apply", "allgather", "idle"):
+            # graftlint: disable=metrics-label-cardinality
+            cm.PIPE_STEP_PHASE_S.set(0.0, tags={"pipeline": self.name,
+                                                "phase": phase})
+        # graftlint: disable=metrics-label-cardinality
+        cm.PIPE_MODEL_TFLOPS.set(0.0, tags={"pipeline": self.name})
+        if rt_config.pipe_peak_tflops > 0:
+            # graftlint: disable=metrics-label-cardinality
+            cm.PIPE_MFU.set(0.0, tags={"pipeline": self.name})
 
 
 # ---------------------------------------------------------------- misc
@@ -1226,3 +1403,9 @@ def jax_to_numpy(tree):
     import jax
 
     return jax.tree.map(np.asarray, tree)
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)

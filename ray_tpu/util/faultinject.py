@@ -163,9 +163,18 @@ def check(site: str) -> None:
 
 def _fire(rule: Dict[str, Any], site: str) -> None:
     action = rule.get("action", "error")
+    from ray_tpu.util import flightrec
+
+    # The flight recorder is the one witness an injected crash leaves
+    # behind: record the fire, and for `die` flush synchronously — the
+    # SIGKILL gives the background flusher no chance.
+    flightrec.record("fault.fired", site=site, action=action)
     if action == "die":
-        # SIGKILL self: no cleanup, no atexit, no flush — the honest
-        # crash the control plane must tolerate.
+        # SIGKILL self: no cleanup, no atexit, no further flush — the
+        # honest crash the control plane must tolerate (the recorder
+        # file written above is evidence, not cleanup: the process
+        # state it describes still evaporates).
+        flightrec.flush_now()
         os.kill(os.getpid(), signal.SIGKILL)
     elif action == "delay":
         time.sleep(float(rule.get("delay_s", 0.1)))

@@ -39,6 +39,15 @@ def current() -> Optional[tuple]:
     return _ctx.get()
 
 
+def traced() -> bool:
+    """True when a trace context is active in this task/thread — the
+    one-contextvar-read gate hot-ish paths use before building span
+    names/attrs (the pipeline stage actors emit fwd/bwd/apply spans
+    only while the driver's step span is propagated to them; an
+    untraced step pays exactly this read per stage call)."""
+    return _ctx.get() is not None
+
+
 def _new_id() -> str:
     return os.urandom(8).hex()
 

@@ -551,3 +551,66 @@ def test_cardinality_lint_repo_is_clean():
     findings, _stats = run_analysis(
         root=repo_root(), select=["metrics-label-cardinality"], jobs=1)
     assert findings == [], [f.render() for f in findings]
+
+
+# ------------------------------------- flight-recorder event lint
+
+
+def test_flightrec_lint_flags_schema_collision():
+    """One event name, one attr-key schema: the post-mortem merges by
+    name, so a second site recording 'gang.form' with different keys
+    silently breaks every grouping — family #10's name-collision
+    check, applied to the event catalog."""
+    project = _lint_project(fr_a="""
+        from ray_tpu.util import flightrec
+        def one(group, epoch):
+            flightrec.record("fr.formed", group=group, epoch=epoch)
+        def two(group):
+            flightrec.record("fr.formed", group=group, hosts=2)
+        """)
+    findings = _run_metrics_lint(project)
+    assert len(findings) == 1
+    assert findings[0].rule == "metrics-name-collision"
+    assert "one event name, one schema" in findings[0].message
+    assert "fr.formed" in findings[0].message
+
+
+def test_flightrec_lint_flags_id_shaped_attr_values():
+    """Id-shaped attr VALUES flagged exactly like metric labels — and
+    the bounded schedule ints ({step, mb, stage, epoch}) are exempt
+    even through the same expressions; direct-import spelling and a
+    foreign record() are resolved correctly."""
+    project = _lint_project(fr_b="""
+        from ray_tpu.util.flightrec import record
+        def bad(req, step):
+            record("fr.req", owner=req.request_id, step=step)
+        def exempt(self, mb, stage):
+            record("fr.cell", step=self._step, mb=mb, stage=stage)
+        def foreign(recorder, req):
+            recorder.record("fr.other", owner=req.request_id)
+        """)
+    findings = _run_metrics_lint(project)
+    assert len(findings) == 1
+    assert findings[0].rule == "metrics-label-cardinality"
+    assert "flight-recorder event" in findings[0].message
+    assert "request_id" in findings[0].message
+
+
+def test_flightrec_lint_true_negatives_and_pragma():
+    project = _lint_project(fr_c="""
+        from ray_tpu.util import flightrec
+        def ok(self, reason, member):
+            flightrec.record("fr.ok", cause=reason, member=member)
+            flightrec.record("fr.ok2", site="literal")
+            # graftlint: disable=metrics-label-cardinality
+            flightrec.record("fr.death", actor=self.actor_id.hex())
+        """)
+    assert _run_metrics_lint(project) == []
+
+
+def test_flightrec_collision_lint_repo_is_clean():
+    from ray_tpu.analysis import repo_root, run_analysis
+
+    findings, _stats = run_analysis(
+        root=repo_root(), select=["metrics-name-collision"], jobs=1)
+    assert findings == [], [f.render() for f in findings]

@@ -1,0 +1,215 @@
+"""Flight recorder (util/flightrec.py) + doctor post-mortem (ISSUE 15).
+
+Cluster-free contract:
+
+* the ring is bounded (oldest events evicted), always-on recording is
+  a deque append, and ``record`` never raises even with an unwritable
+  spill dir;
+* ``flush_now``/``dump_all`` round-trip events through the per-process
+  file (atomic replace; torn/foreign files skipped; ``max_age_s``
+  drops stale sessions);
+* ``doctor.post_mortem`` is a PURE function over merged dumps: given a
+  synthetic crash history it names the first-dying member, the stage
+  whose clock stopped, the surviving epoch, and whether the replay
+  double-apply guard fired — no cluster, no metrics, evidence only.
+
+The injection-tested halves (a REAL SIGKILLed stage actor / gang
+coordinator) live with their module clusters in
+tests/test_pipeline_plane.py and tests/test_multihost_group.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from ray_tpu import doctor
+from ray_tpu.core.config import config
+from ray_tpu.util import flightrec
+
+
+@pytest.fixture()
+def fr_dir(tmp_path):
+    saved_dir = config.flightrec_dir
+    saved_ring = config.flightrec_ring
+    config.flightrec_dir = str(tmp_path)
+    flightrec.reset()
+    yield str(tmp_path)
+    flightrec.reset()
+    config.flightrec_dir = saved_dir
+    config.flightrec_ring = saved_ring
+
+
+def test_ring_is_bounded_and_ordered(fr_dir):
+    config.flightrec_ring = 16
+    flightrec.reset()
+    for i in range(100):
+        flightrec.record("t.tick", n=i)
+    events = flightrec.dump()
+    assert len(events) == 16
+    assert [e["n"] for e in events] == list(range(84, 100))
+    assert all(e["ev"] == "t.tick" and "ts" in e for e in events)
+
+
+def test_disabled_recorder_is_a_noop(fr_dir):
+    config.flightrec_enabled = False
+    try:
+        flightrec.reset()
+        flightrec.record("t.tick", n=1)
+        assert flightrec.dump() == []
+    finally:
+        config.flightrec_enabled = True
+
+
+def test_flush_and_dump_all_roundtrip(fr_dir):
+    flightrec.record("t.alpha", n=1)
+    flightrec.record("t.beta", n=2)
+    path = flightrec.flush_now()
+    assert path and os.path.exists(path)
+    # Torn/foreign files are skipped, not fatal.
+    with open(os.path.join(fr_dir, "fr-99999.json"), "w") as f:
+        f.write("{not json")
+    with open(os.path.join(fr_dir, "unrelated.txt"), "w") as f:
+        f.write("hi")
+    dumps = flightrec.dump_all(fr_dir)
+    assert len(dumps) == 1
+    (source, doc), = dumps.items()
+    assert doc["pid"] == os.getpid()
+    assert [e["ev"] for e in doc["events"]] == ["t.alpha", "t.beta"]
+    assert f"pid{os.getpid()}" in source
+    # max_age_s drops stale sessions (this one is fresh).
+    assert flightrec.dump_all(fr_dir, max_age_s=60.0)
+    assert flightrec.dump_all(fr_dir, max_age_s=-1.0) == {}
+
+
+def test_record_survives_unwritable_dir(fr_dir):
+    config.flightrec_dir = "/proc/definitely/not/writable"
+    flightrec.record("t.alpha", n=1)
+    assert flightrec.flush_now() is None  # refused, not raised
+    assert [e["ev"] for e in flightrec.dump()] == ["t.alpha"]
+
+
+def test_cluster_dump_includes_own_ring(fr_dir):
+    flightrec.record("t.alpha", n=1)
+    dumps = flightrec.cluster_dump()
+    assert any(e["ev"] == "t.alpha"
+               for doc in dumps.values() for e in doc["events"])
+
+
+# ------------------------------------------------------- post-mortem
+
+
+def _gang_death_dumps(t0=1000.0):
+    """A synthetic crash history: host-1 of pipe 'pm' is SIGKILLed by
+    a faultinject die rule at its beat site; the monitor reconciles
+    the epoch-1 gang and a fresh one forms under epoch 2."""
+    return {
+        "driver-pid1": {"pid": 1, "role": "driver", "events": [
+            {"ev": "gang.register", "ts": t0, "group": "pm-gang",
+             "epoch": 1, "hosts": 2},
+            {"ev": "gang.form", "ts": t0 + 0.2, "group": "pm-gang",
+             "epoch": 1, "hosts": 2},
+            {"ev": "pipe.step.start", "ts": t0 + 1.0, "pipeline": "pm",
+             "step": 0, "mbs": 4},
+            {"ev": "pipe.clock.drift", "ts": t0 + 2.0, "pipeline": "pm",
+             "step": 1, "clocks": "2,1"},
+            {"ev": "gang.reconcile", "ts": t0 + 3.0, "group": "pm-gang",
+             "epoch": 1, "dead": "host-1", "coordinator_died": False},
+            {"ev": "gang.register", "ts": t0 + 3.5, "group": "pm-gang",
+             "epoch": 2, "hosts": 2},
+            {"ev": "gang.form", "ts": t0 + 4.0, "group": "pm-gang",
+             "epoch": 2, "hosts": 2},
+        ]},
+        "worker-pid2": {"pid": 2, "role": "worker", "events": [
+            {"ev": "gang.member.up", "ts": t0 + 0.1, "group": "pm-gang",
+             "member": "host-0", "epoch": 1},
+            {"ev": "pipe.stage.begin", "ts": t0 + 1.1, "pipeline": "pm",
+             "stage": 0, "step": 0, "asked": 0},
+            {"ev": "pipe.stage.apply", "ts": t0 + 2.5, "pipeline": "pm",
+             "stage": 0, "step": 1},
+            {"ev": "pipe.stage.begin", "ts": t0 + 6.0, "pipeline": "pm",
+             "stage": 0, "step": 1, "asked": 1},
+        ]},
+        "worker-pid3": {"pid": 3, "role": "worker", "events": [
+            {"ev": "gang.member.up", "ts": t0 + 0.1, "group": "pm-gang",
+             "member": "host-1", "epoch": 1},
+            {"ev": "pipe.stage.begin", "ts": t0 + 1.1, "pipeline": "pm",
+             "stage": 1, "step": 0, "asked": 0},
+            {"ev": "fault.fired", "ts": t0 + 2.8,
+             "site": "multihost.member.pm-gang.host-1.beat",
+             "action": "die"},
+        ]},
+    }
+
+
+def test_post_mortem_names_first_dying_member_and_surviving_epoch():
+    findings = doctor.post_mortem(_gang_death_dumps())
+    deaths = [f for f in findings if f["signature"] == "gang-death"]
+    assert len(deaths) == 1
+    d = deaths[0]
+    assert d["evidence"]["first_dying"] == "host-1"
+    assert d["evidence"]["surviving_epoch"] == 2
+    assert d["evidence"]["injected"] is True
+    assert "host-1" in d["summary"]
+    assert "epoch 2" in d["summary"]
+    # Member <-> stage correlation: host-1 hosts stage s1 of 'pm'.
+    assert "s1" in d["summary"]
+    assert "SIGKILL" in d["summary"]
+
+
+def test_post_mortem_names_stopped_stage_clock():
+    findings = doctor.post_mortem(_gang_death_dumps())
+    stops = [f for f in findings
+             if f["signature"] == "stage-clock-stop"]
+    assert len(stops) == 1
+    s = stops[0]
+    # Stage 1's last event is ~3.2s before stage 0 went quiet and its
+    # clock never reached step 1.
+    assert s["evidence"]["stopped_stages"] == ["s1"]
+    assert s["evidence"]["stage_clocks"] == {"s0": 1, "s1": 0}
+    assert "s1" in s["summary"]
+
+
+def test_post_mortem_reports_double_apply_guard_and_faults():
+    findings = doctor.post_mortem(_gang_death_dumps())
+    guards = [f for f in findings
+              if f["signature"] == "double-apply-guard"]
+    assert len(guards) == 1
+    assert guards[0]["evidence"] == {"step": 1, "clocks": "2,1"}
+    assert "double-apply guard FIRED" in guards[0]["summary"]
+    faults = [f for f in findings
+              if f["signature"] == "fault-injection"]
+    assert len(faults) == 1
+    assert faults[0]["evidence"]["fires"][0]["action"] == "die"
+
+
+def test_post_mortem_quiet_on_orderly_history():
+    dumps = {"driver-pid1": {"pid": 1, "role": "driver", "events": [
+        {"ev": "gang.register", "ts": 1.0, "group": "g", "epoch": 1,
+         "hosts": 2},
+        {"ev": "pipe.step.commit", "ts": 2.0, "pipeline": "p",
+         "step": 0},
+        {"ev": "gang.shutdown", "ts": 3.0, "group": "g", "epoch": 1},
+    ]}}
+    assert doctor.post_mortem(dumps) == []
+    text = doctor.render_post_mortem([], dumps)
+    assert "no deaths or stalls" in text
+
+
+def test_post_mortem_render_and_gang_dead_outcome():
+    dumps = _gang_death_dumps()
+    # No re-formation on record past the reconcile: the budget-
+    # exhausted ending instead.
+    dumps["driver-pid1"]["events"] = [
+        e for e in dumps["driver-pid1"]["events"]
+        if not (e["ts"] > 1003.0 and e["ev"] in ("gang.register",
+                                                 "gang.form"))
+    ] + [{"ev": "gang.dead", "ts": 1003.6, "group": "pm-gang",
+          "epoch": 1, "cause": "restart budget exhausted"}]
+    findings = doctor.post_mortem(dumps)
+    d = [f for f in findings if f["signature"] == "gang-death"][0]
+    assert d["evidence"]["surviving_epoch"] is None
+    assert "DEAD" in d["summary"]
+    text = doctor.render_post_mortem(findings, dumps)
+    assert "gang-death" in text and "post-mortem over 3" in text
+    json.dumps(findings)  # --json path stays serializable

@@ -38,21 +38,29 @@ _FAULTS = "/tmp/ray_tpu_mh_faults.json"
 
 
 @pytest.fixture(scope="module")
-def mh_cluster():
+def mh_cluster(tmp_path_factory):
     """One cluster for the whole module: a virtual 4-host slice (4x4
-    grid / 4 chips per host) with fault injection plumbed into every
-    process (env set BEFORE init so workers inherit it)."""
+    grid / 4 chips per host) with fault injection AND the flight
+    recorder plumbed into every process (env set BEFORE init so
+    workers inherit both; a per-run recorder dir keeps stale fr-<pid>
+    files from other sessions out of the post-mortem)."""
+    fr_dir = str(tmp_path_factory.mktemp("flightrec"))
     saved = {k: os.environ.get(k)
-             for k in ("RAY_TPU_VIRTUAL_SLICE", "RAY_TPU_FAULTINJECT_PATH")}
+             for k in ("RAY_TPU_VIRTUAL_SLICE", "RAY_TPU_FAULTINJECT_PATH",
+                       "RAY_TPU_FLIGHTREC_DIR")}
     os.environ["RAY_TPU_VIRTUAL_SLICE"] = "4x4/4"
     os.environ["RAY_TPU_FAULTINJECT_PATH"] = _FAULTS
+    os.environ["RAY_TPU_FLIGHTREC_DIR"] = fr_dir
     old_path = config.faultinject_path
+    old_fr = config.flightrec_dir
     config.faultinject_path = _FAULTS
+    config.flightrec_dir = fr_dir
     faultinject.reset_counters()
     core = ray_tpu.init(num_cpus=8)
     yield core
     ray_tpu.shutdown()
     config.faultinject_path = old_path
+    config.flightrec_dir = old_fr
     faultinject.reset_counters()
     for k, v in saved.items():
         if v is None:
@@ -233,6 +241,25 @@ def test_coordinator_failover_and_stale_epoch_fence(mh_cluster):
         bar = stub.mh_barrier("coord-gang", "zombie-step", "host-0", 1,
                               "h", 5.0)
         assert bar == {"ok": False, "reason": "stale_epoch", "epoch": 2}
+        # ISSUE 15: the SAME death explained post-mortem, from flight-
+        # recorder dumps alone (doctor.post_mortem is a pure function
+        # over the merge — no cluster queries): the killed coordinator
+        # is named as the first-dying member (its own recorder file
+        # carries the fault.fired die, flushed synchronously before
+        # the SIGKILL) and the surviving gang's epoch is on record.
+        from ray_tpu import doctor
+        from ray_tpu.util import flightrec
+
+        deaths = [x for x in doctor.post_mortem(flightrec.cluster_dump())
+                  if x["signature"] == "gang-death"
+                  and x["source"] == "group:coord-gang"]
+        assert deaths
+        d = deaths[0]
+        assert d["evidence"]["first_dying"] == "host-0"
+        assert d["evidence"]["surviving_epoch"] == 2
+        assert d["evidence"]["injected"] is True
+        assert "host-0" in d["summary"] and "epoch 2" in d["summary"]
+        assert "SIGKILL" in d["summary"]
     finally:
         g.shutdown()
 

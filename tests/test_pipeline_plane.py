@@ -43,21 +43,30 @@ _FAULTS = "/tmp/ray_tpu_pipe_faults.json"
 
 
 @pytest.fixture(scope="module")
-def pipe_cluster():
+def pipe_cluster(tmp_path_factory):
     """One cluster for the whole module: a virtual 4-host slice (4
-    chips per host) with fault injection plumbed into every process."""
+    chips per host) with fault injection AND the flight recorder
+    plumbed into every process (env set BEFORE init so spawned stage
+    workers inherit both; a per-run recorder dir keeps stale fr-<pid>
+    files from other sessions out of the post-mortem)."""
+    fr_dir = str(tmp_path_factory.mktemp("flightrec"))
     saved = {k: os.environ.get(k)
              for k in ("RAY_TPU_VIRTUAL_SLICE",
-                       "RAY_TPU_FAULTINJECT_PATH")}
+                       "RAY_TPU_FAULTINJECT_PATH",
+                       "RAY_TPU_FLIGHTREC_DIR")}
     os.environ["RAY_TPU_VIRTUAL_SLICE"] = "4x4/4"
     os.environ["RAY_TPU_FAULTINJECT_PATH"] = _FAULTS
+    os.environ["RAY_TPU_FLIGHTREC_DIR"] = fr_dir
     old_path = config.faultinject_path
+    old_fr = config.flightrec_dir
     config.faultinject_path = _FAULTS
+    config.flightrec_dir = fr_dir
     faultinject.reset_counters()
     core = ray_tpu.init(num_cpus=8)
     yield core
     ray_tpu.shutdown()
     config.faultinject_path = old_path
+    config.flightrec_dir = old_fr
     faultinject.reset_counters()
     for k, v in saved.items():
         if v is None:
@@ -517,6 +526,163 @@ def test_transient_snapshot_failure_commits_step_on_live_gang(
         assert st["ledger_refs"] == 0
         # The retried pull landed: the driver owns a current snapshot.
         assert plane.snapshot_params() is not None
+    finally:
+        plane.stop()
+
+
+# ------------------------------- train-plane trace + step breakdown
+
+
+def test_train_trace_rows_bubble_and_step_breakdown(pipe_cluster):
+    """ISSUE 15 acceptance: a traced 4-stage step renders per-stage
+    process rows whose spans carry {step, mb, stage} attrs, and the
+    TRACE-derived bubble fraction (train_trace_summary — what
+    `ray_tpu timeline --train` prints) matches the driver-clock
+    bubble (bench_pipeline.py's method) within 10%. Plus the per-step
+    phase breakdown: stage-seconds split across fwd/bwd/apply/
+    allgather/idle that adds up to stages x wall, surfaced through
+    core_summary.pipeline with the MFU estimate gauge."""
+    from ray_tpu.scripts import build_chrome_trace, train_trace_summary
+    from ray_tpu.train.pipeline_plane import PipelinePlane
+
+    cfg, params, steps = _setup(n_steps=2, n_micro=8, batch=16)
+    plane = PipelinePlane(cfg, params, n_stages=4, n_microbatches=8,
+                          lr=1e-2, window=4, name="trace-pipe",
+                          snapshot_every=0).start()
+    old_trace = config.pipe_trace_spans
+    old_peak = config.pipe_peak_tflops
+    old_sample = config.pipe_trace_sample_every
+    try:
+        # Warm the stage jits UNTRACED: compile time is not schedule
+        # shape, and the trace window must cover exactly one warm step.
+        config.pipe_trace_spans = False
+        plane.train_step(steps[0])
+        config.pipe_trace_spans = True
+        config.pipe_trace_sample_every = 1  # trace THIS step (index 1)
+        busy0 = plane.stats()["stage_busy_s"]
+        t0 = time.monotonic()
+        plane.train_step(steps[1])
+        wall = time.monotonic() - t0
+        busy = [b - a for a, b in
+                zip(busy0, plane.stats()["stage_busy_s"])]
+        bubble_stats = 1.0 - sum(busy) / (4 * wall)
+
+        # ---- step breakdown: every stage-second of the step has a row
+        bd = plane.stats()["step_breakdown"]
+        assert bd["fwd_s"] > 0 and bd["bwd_s"] > 0 and bd["apply_s"] > 0
+        assert bd["allgather_s"] == 0.0  # ZeRO-1-in-stage: real rig
+        total = (bd["fwd_s"] + bd["bwd_s"] + bd["apply_s"]
+                 + bd["allgather_s"] + bd["idle_s"])
+        assert abs(total - 4 * bd["wall_s"]) <= 0.02 * 4 * bd["wall_s"]
+        assert bd["tokens"] == 256  # 8 mbs x 2 rows x 16 tokens
+        assert bd["model_tflops"] > 0
+
+        # ---- the shared read path: breakdown + MFU through
+        # core_summary (the dashboard train panel and `ray_tpu
+        # metrics` read exactly this).
+        config.pipe_peak_tflops = 0.001
+        snap = {"local": _Registry.get().snapshot()}
+        summary = coremetrics.core_summary(snap)["pipeline"]
+        for phase in ("fwd", "bwd", "apply", "allgather", "idle"):
+            assert phase in summary["step_breakdown_s"]
+        assert summary["step_breakdown_s"]["fwd"] > 0
+        assert summary["model_tflops"]["trace-pipe"] > 0
+        assert summary["mfu_pct"]["trace-pipe"] > 0
+
+        # ---- spans reached the controller: per-stage rows + attrs
+        ctl = get_core_worker().controller
+        deadline = time.monotonic() + 15.0
+        summ = {}
+        while time.monotonic() < deadline:
+            events = ctl.call("list_task_events", 20000)
+            summ = train_trace_summary(events).get("trace-pipe", {})
+            # 8 fwd + 8 bwd driver cells per stage = 64 cells
+            if summ.get("cells", 0) >= 64:
+                break
+            time.sleep(0.25)
+        assert summ.get("cells", 0) >= 64, summ
+        assert summ["n_stages"] == 4
+        trace = build_chrome_trace(events)
+        row_names = {t["args"]["name"] for t in trace
+                     if t.get("ph") == "M"
+                     and t["name"] == "process_name"}
+        assert {"stage s0", "stage s1", "stage s2",
+                "stage s3"} <= row_names
+        fwd = [t for t in trace if t.get("cat") == "span"
+               and t["name"] == "fwd"]
+        assert fwd and {"step", "mb", "stage"} <= set(fwd[0]["args"])
+
+        # ---- trace-derived bubble tracks the driver-clock bubble
+        bubble_trace = summ["bubble_fraction"]
+        assert abs(bubble_trace - bubble_stats) \
+            <= 0.10 * max(bubble_stats, bubble_trace), \
+            (bubble_trace, bubble_stats)
+    finally:
+        config.pipe_trace_spans = old_trace
+        config.pipe_peak_tflops = old_peak
+        config.pipe_trace_sample_every = old_sample
+        plane.stop()
+
+
+# --------------------------------------- crash forensics: post-mortem
+
+
+@pytest.mark.chaos
+def test_post_mortem_names_killed_stage_from_dumps(pipe_cluster):
+    """ISSUE 15 acceptance: SIGKILL a StageActor (faultinject die at
+    its member beat site), let the gang reconcile and training resume —
+    then `doctor.post_mortem` must name the killed stage/member and the
+    surviving gang's epoch FROM DUMPS ALONE (a pure function over the
+    fr_dump merge; no live cluster queries)."""
+    from ray_tpu import doctor
+    from ray_tpu.train.pipeline_plane import PipelinePlane
+
+    cfg, params, steps = _setup(seed=17, n_steps=3)
+    plane = PipelinePlane(cfg, params, n_stages=2, n_microbatches=4,
+                          lr=1e-2, window=2, name="pm-pipe").start()
+    try:
+        got = []
+        for i, mbs in enumerate(steps):
+            if i == 1:
+                with Faults(_FAULTS) as f:
+                    rule = f.add(
+                        "multihost.member.pm-pipe-gang.host-1.beat",
+                        "die", once_global=True, rule_id="pm-kill-s1")
+                    deadline = time.monotonic() + 30.0
+                    while (not f.marker_fired(rule)
+                           and time.monotonic() < deadline):
+                        time.sleep(0.02)
+                    assert f.marker_fired(rule)
+                    got.append(plane.train_step(mbs))
+            else:
+                got.append(plane.train_step(mbs))
+        assert plane.stats()["gang_epoch"] == 2  # resumed under epoch 2
+        # Let the surviving stages' background flush land their rings.
+        time.sleep(1.5)
+        stub = ControllerStub(get_core_worker().controller)
+        dumps = stub.fr_dump()
+        # The analysis is a PURE function of the dumps dict — nothing
+        # else from the live cluster goes in.
+        findings = doctor.post_mortem(dumps)
+        deaths = [x for x in findings if x["signature"] == "gang-death"
+                  and x["source"] == "group:pm-pipe-gang"]
+        assert deaths, findings
+        d = deaths[0]
+        assert d["evidence"]["first_dying"] == "host-1"
+        assert d["evidence"]["surviving_epoch"] == 2
+        assert d["evidence"]["injected"] is True
+        assert "host-1" in d["summary"] and "epoch 2" in d["summary"]
+        assert "s1" in d["summary"]  # the killed STAGE, by name
+        # The same story must be tellable with the cluster GONE:
+        # dump_all reads the persisted files directly.
+        from ray_tpu.util import flightrec
+
+        offline = doctor.post_mortem(
+            flightrec.dump_all(config.flightrec_dir))
+        assert any(x["signature"] == "gang-death"
+                   and x["source"] == "group:pm-pipe-gang"
+                   and x["evidence"]["first_dying"] == "host-1"
+                   for x in offline)
     finally:
         plane.stop()
 
