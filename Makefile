@@ -4,7 +4,7 @@
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
 	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist \
-	bench-obs bench-chaos bench-gang bench-pipeline
+	bench-obs bench-chaos bench-gang bench-pipeline bench-spec
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -53,6 +53,13 @@ bench-paged:
 # virtual one; logits bit-exactness is pinned by tests, not here.
 bench-sharded:
 	python bench_decode.py --sections sharded $(BENCH_ARGS)
+
+# Speculative-decoding rows (ISSUE 16): accept-rate x tokens/s per
+# prompt mix at the self-draft / tiny-draft brackets, the sampled
+# (device-sampler) fallback, and the host-vs-device sampler step
+# delta -> BENCH_SERVE.json. CPU-host caveats: BENCH_NOTES.md.
+bench-spec:
+	python bench_decode.py --sections spec $(BENCH_ARGS)
 
 # Tracing/metrics overhead on the decode step loop (instrumented vs
 # stripped engine; acceptance bar <2%) -> BENCH_SERVE.json.

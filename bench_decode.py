@@ -505,6 +505,122 @@ def paged_rows(quick: bool, platform: str):
     return rows
 
 
+def spec_rows(quick: bool, platform: str):
+    """Speculative-decoding rows (ISSUE 16): accept-rate x tokens/s per
+    prompt mix, bracketed by the two draft extremes reachable with
+    random weights — a SELF-draft (the target proposes for itself, so
+    acceptance ~= 1.0 and the row isolates the verify-batching /
+    dispatch-amortization ceiling) and a tiny independent draft
+    (acceptance ~= 0 on random weights: the pure-overhead floor). A
+    trained draft lands between the brackets. Sampled (temp 0.8) rows
+    measure the documented fallback: spec disengages (argmax acceptance
+    rule) and the fused device sampler carries the batch. Plus the
+    donated-buffer / device-sampler step-time delta row. CPU-host
+    caveats: BENCH_NOTES.md."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.decode import DecodeEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq_len=2048 if quick else 8192)
+    params = llama.init_params(cfg, jax.random.key(0))
+    draft_cfg = llama.LlamaConfig(
+        vocab_size=256, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+        mlp_dim=64, max_seq_len=cfg.max_seq_len)
+    draft_params = llama.init_params(draft_cfg, jax.random.key(1))
+    rng = np.random.default_rng(0)
+    T, slots, k = 64, 4, 4
+    gen = 12 if quick else 32
+    mixes = [("64", 64), ("512", 512)]
+    if not quick:
+        mixes.append(("4k", 4096))
+    rows = []
+
+    def run(mix_len, temperature=0.0, draft=None, sampler=False):
+        capacity = 1 << (mix_len + gen + k + 1).bit_length()
+        capacity = min(capacity, cfg.max_seq_len)
+        kw = dict(page_tokens=T,
+                  pool_pages=slots * (capacity // T) + 1,
+                  prefix_pool_entries=0, device_sampler=sampler)
+        if draft is not None:
+            kw.update(spec_draft_params=draft[0],
+                      spec_draft_config=draft[1], spec_k=k)
+        eng = DecodeEngine(params, cfg, slots=slots,
+                           capacity=capacity, **kw)
+        prompts = [rng.integers(0, cfg.vocab_size, mix_len).tolist()
+                   for _ in range(slots)]
+        warm = [eng.submit(p, max_new_tokens=2,
+                           temperature=temperature) for p in prompts]
+        while not all(w.done.is_set() for w in warm):
+            eng.step()
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, max_new_tokens=gen,
+                           temperature=temperature) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        wall = time.monotonic() - t0
+        st = eng.stats()
+        eng.shutdown()
+        total = sum(len(r.output) for r in reqs)
+        sp = st.get("spec") or {}
+        return total / wall, sp.get("accept_rate")
+
+    for name, mix_len in mixes:
+        base_tps, _ = run(mix_len)
+        self_tps, self_ar = run(mix_len, draft=(params, cfg))
+        tiny_tps, tiny_ar = run(mix_len, draft=(draft_params, draft_cfg))
+        rows.append({
+            "metric": f"decode_spec_accept_rate_{name}",
+            "value": round(self_ar or 0.0, 3),
+            "unit": "accepted/proposed",
+            "note": (f"greedy, k={k}, self-draft bracket (tiny random "
+                     f"draft floor: {tiny_ar}); prompt {mix_len} + "
+                     f"{gen} new x {slots} slots; {platform}"),
+        })
+        rows.append({
+            "metric": f"decode_spec_tokens_per_s_{name}",
+            "value": round(self_tps, 2),
+            "unit": "tokens/s",
+            "note": (f"greedy spec engine tokens/s at the self-draft "
+                     f"bracket ({self_tps / base_tps:.2f}x plain "
+                     f"{base_tps:.1f}; tiny-draft floor "
+                     f"{tiny_tps:.1f} = {tiny_tps / base_tps:.2f}x); "
+                     f"k={k}; {platform}"),
+        })
+        samp_tps, _ = run(mix_len, temperature=0.8,
+                          draft=(params, cfg), sampler=True)
+        rows.append({
+            "metric": f"decode_spec_sampled_tokens_per_s_{name}",
+            "value": round(samp_tps, 2),
+            "unit": "tokens/s",
+            "note": (f"temp 0.8 mix on the SAME spec-configured "
+                     f"engine: spec disengages (argmax acceptance "
+                     f"rule), fused device sampler carries the batch "
+                     f"({samp_tps / base_tps:.2f}x the greedy plain "
+                     f"path); {platform}"),
+        })
+
+    # ---- donated-buffer + device-sampler step-time delta (512 mix)
+    host_tps, _ = run(512, temperature=0.8, sampler=False)
+    dev_tps, _ = run(512, temperature=0.8, sampler=True)
+    rows.append({
+        "metric": "decode_device_sampler_step_delta",
+        "value": round((1e3 * slots / host_tps)
+                       - (1e3 * slots / dev_tps), 3),
+        "unit": "ms/step",
+        "note": (f"host-sampler minus device-sampler mean step time at "
+                 f"temp 0.8 (host {1e3 * slots / host_tps:.2f} ms, "
+                 f"device {1e3 * slots / dev_tps:.2f} ms; device path "
+                 f"keeps logits on-device and feeds the donated token "
+                 f"buffer back without a host round-trip); 512-token "
+                 f"prompts x {slots} slots; {platform}"),
+    })
+    return rows
+
+
 def trace_overhead_rows(params, cfg, quick: bool, platform: str = ""):
     """Tracing+metrics overhead on the decode STEP LOOP: the same
     steady full-batch decode measured with the observability layer
@@ -760,11 +876,11 @@ def main() -> None:
     parser.add_argument(
         "--sections",
         default="engine,serve,shared_prefix,overload,paged,sharded,"
-                "trace_overhead",
+                "spec,trace_overhead",
         help="comma-set of row groups to (re)measure: engine, serve, "
-             "shared_prefix, overload, paged, sharded, trace_overhead. "
-             "Only the selected groups' rows are replaced in "
-             "BENCH_SERVE.json; the rest are preserved.")
+             "shared_prefix, overload, paged, sharded, spec, "
+             "trace_overhead. Only the selected groups' rows are "
+             "replaced in BENCH_SERVE.json; the rest are preserved.")
     parser.add_argument(
         "--model", default=None,
         help="llama preset override (default: debug if --quick else "
@@ -814,6 +930,8 @@ def main() -> None:
         rows += paged_rows(args.quick, f"{platform} backend")
     if "sharded" in sections:
         rows += sharded_rows(args.quick, f"{platform} backend")
+    if "spec" in sections:
+        rows += spec_rows(args.quick, f"{platform} backend")
     if "trace_overhead" in sections:
         rows += trace_overhead_rows(params, cfg, args.quick, plat_note)
     if "serve" in sections:

@@ -539,6 +539,128 @@ def paged_decode_chunk(params: Dict[str, Any], pool: Cache,
     return toks, pool, lengths
 
 
+# --------------------------------------------- speculative decoding
+#
+# Two programs on top of the paged machinery: ``paged_verify`` scores a
+# (k+1)-token suffix per row in ONE target forward (the ragged-position
+# scatter/gather of ``paged_prefill_suffix``, but emitting logits at
+# EVERY query position instead of the last real one — the per-position
+# argmaxes are what the engine compares draft proposals against), and
+# ``paged_spec_draft`` runs the small draft model: ingest up to two
+# catch-up tokens (the tokens the target accepted since the draft's
+# last committed position — bounded at 2 by the acceptance protocol),
+# then greedily propose ``k`` tokens via a scanned decode. Greedy
+# acceptance of the longest matching prefix makes spec-mode output
+# provably identical to sequential greedy decode: position ``j``'s
+# verify logits condition on exactly the tokens sequential decode would
+# have conditioned on whenever proposals ``1..j`` were accepted.
+
+
+def paged_verify(params: Dict[str, Any], tokens: jax.Array, pool: Cache,
+                 block_tables: jax.Array, config: LlamaConfig,
+                 prefix_lens: jax.Array) -> Tuple[jax.Array, Cache]:
+    """Target-model verify forward: process right-padded rows ``tokens``
+    (B, S = spec_k + 1) from ``pos = prefix_lens`` against the paged
+    context and return logits at ALL ``S`` positions, shape (B, S, V).
+    Row layout is ``[last_emitted, draft_1, .., draft_k]``; K/V for
+    every position scatters into the row's pages (positions past the
+    page window go to the scratch page), so the accepted prefix is
+    committed by the same program that scores it — rejected tails are
+    plain junk past the rolled-back ``length`` cursor, masked exactly
+    like pad writes and overwritten by the next round's scatter before
+    any gather can see them."""
+    c = config
+    B, S = tokens.shape
+    T = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    C = W * T
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_embed"].astype(c.dtype)[tokens]          # (B, S, E)
+    abs_pos = prefix_lens[:, None] + jnp.arange(S)[None, :]  # (B, S)
+    kv_groups = c.n_heads // c.n_kv_heads
+    scale = c.head_dim ** -0.5
+    rows = jnp.arange(B)
+    pages = jnp.where(
+        abs_pos < C,
+        block_tables[rows[:, None], jnp.minimum(abs_pos // T, W - 1)], 0)
+    offs = abs_pos % T
+    valid = (jnp.arange(C)[None, None, :]
+             <= abs_pos[:, :, None])                         # (B, S, C)
+
+    def body(x, inp):
+        layer, k_p, v_p = inp               # pool slices (P+1, T, KV, D)
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q, k_new, v_new = _qkv(layer, h, c)  # (B, S, H/KV, D)
+        q = apply_rope(q, cos, sin, positions=abs_pos)
+        k_new = apply_rope(k_new, cos, sin, positions=abs_pos)
+        q = constrain(q, ("batch", "length", "heads", "head_dim"))
+        k_new = constrain(k_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
+        v_new = constrain(v_new,
+                          ("batch", "length", "kv_heads", "head_dim"))
+        k_p = k_p.at[pages, offs].set(k_new.astype(k_p.dtype))
+        v_p = v_p.at[pages, offs].set(v_new.astype(v_p.dtype))
+        k_c = k_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
+        v_c = v_p[block_tables].reshape(B, C, c.n_kv_heads, c.head_dim)
+        qg = q.reshape(B, S, c.n_kv_heads, kv_groups, c.head_dim)
+        scores = jnp.einsum("bskgd,bckd->bkgsc", qg, k_c,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bkgsc,bckd->bkgsd", probs.astype(v_c.dtype), v_c)
+        att = att.transpose(0, 3, 1, 2, 4).reshape(
+            B, S, c.n_heads, c.head_dim).astype(x.dtype)
+        att = constrain(att, ("batch", "length", "attn_heads", "head_dim"))
+        out = jnp.einsum("bshd,hde->bse", att, layer["wo"].astype(x.dtype))
+        x = x + out
+        x = _mlp(layer, x, c)
+        return x, (k_p, v_p)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bse,ev->bsv", x,
+                        params["lm_head"].astype(c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def paged_spec_draft(params: Dict[str, Any], pool: Cache,
+                     block_tables: jax.Array, lengths: jax.Array,
+                     catchup: jax.Array, catchup_lens: jax.Array,
+                     config: LlamaConfig, k: int
+                     ) -> Tuple[jax.Array, Cache]:
+    """Draft-model propose step: ingest the ragged ``catchup`` rows
+    (B, 2) — the true tokens the draft has not yet committed, 1 normally
+    or 2 after a fully-accepted round — writing their K/V at positions
+    ``lengths..lengths+catchup_lens-1``, then greedily roll ``k``
+    proposals. Returns ``(proposals (B, k) int32, pool)``. The caller
+    owns the draft ``length`` cursors (host-side rollback after
+    acceptance); pages must cover ``lengths + catchup_lens + k - 1``
+    positions. A 1-long catch-up row's pad slot writes junk one past
+    the real token — the first proposal's decode step rewrites that
+    exact position before anything gathers it."""
+    logits, pool = paged_verify(params, catchup, pool, block_tables,
+                                config, lengths)
+    last = jnp.take_along_axis(
+        logits, (catchup_lens - 1)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]                                        # (B, V)
+    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    lens = lengths + catchup_lens
+
+    def body(carry, _):
+        pool, lens, tok = carry
+        logits, pool, lens = paged_decode_step(params, pool, block_tables,
+                                               lens, tok, config)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (pool, lens, nxt), nxt
+
+    (pool, _, _), rest = jax.lax.scan(
+        body, (pool, lens, tok), None, length=k - 1)
+    toks = jnp.concatenate([tok[None], rest], axis=0).T      # (B, k)
+    return toks, pool
+
+
 # ------------------------------------------------- GSPMD serving (mesh)
 #
 # One replica spanning a pod (sub-)slice instead of one chip: weights,
@@ -600,6 +722,21 @@ def _sample(logits: jax.Array, temperature: float, key) -> jax.Array:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(
         key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def sample_batch(logits: jax.Array, temperatures: jax.Array,
+                 key) -> jax.Array:
+    """Per-row sampling fused into decode programs: greedy argmax where
+    ``temperatures[b] <= 0`` else categorical at that row's temperature.
+    The greedy lane is bit-identical to host ``np.argmax`` (both take
+    the first maximum); the sampled lane draws from the device RNG
+    stream, which intentionally differs from the host sampler's numpy
+    stream — callers opt in via the ``decode_device_sampler`` knob."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temps = jnp.maximum(temperatures, 1e-6)[:, None]
+    sampled = jax.random.categorical(
+        key, logits / temps, axis=-1).astype(jnp.int32)
+    return jnp.where(temperatures <= 0.0, greedy, sampled)
 
 
 @partial(jax.jit, static_argnames=("config", "max_new_tokens",

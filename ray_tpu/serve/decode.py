@@ -29,8 +29,9 @@ import logging
 import queue
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +41,32 @@ from ray_tpu.core.errors import (DeadlineExceededError, OverloadedError,
 logger = logging.getLogger(__name__)
 
 _req_ids = itertools.count(1)
+
+
+@contextmanager
+def _no_persistent_cache(jax_mod):
+    """Fresh-compile guard: run the body with the persistent XLA
+    compilation cache detached (config dir -> None + live cache handle
+    reset), restoring both afterwards. jaxlib 0.4.37 reloads of DONATED
+    executables from the disk cache segfault or return wrong numbers
+    (pinned by PR 14's pipeline tests); every donated program this
+    module compiles while a cache dir is configured routes its FIRST
+    dispatch through here so it can only ever compile fresh. Resetting
+    the handle matters: ``config.update(None)`` alone does not detach
+    an already-initialized cache."""
+    old = jax_mod.config.jax_compilation_cache_dir
+    if old is None:
+        yield
+        return
+    from jax._src import compilation_cache as _cc
+
+    jax_mod.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()
+    try:
+        yield
+    finally:
+        jax_mod.config.update("jax_compilation_cache_dir", old)
+        _cc.reset_cache()
 
 
 @dataclass(eq=False)  # identity semantics: the generated __eq__ would
@@ -74,6 +101,10 @@ class _Request:
     admitted: bool = False                 # left the pending queue
     status: str = "pending"                # terminal: completed |
     #   cancelled | deadline_exceeded | error
+    # --------------------------------------------------- speculative mode
+    spec_proposed: int = 0                 # draft tokens proposed for this
+    #   request across its spec rounds
+    spec_accepted: int = 0                 # of those, verified-accepted
     # ------------------------------------------------------ observability
     trace: Optional[tuple] = None          # (trace_id, span_id) captured
     #   at submit: the engine's loop thread attributes queue-wait /
@@ -119,7 +150,11 @@ class DecodeEngine:
                  step_timeline: Optional[int] = None,
                  metrics_enabled: Optional[bool] = None,
                  trace_spans: Optional[bool] = None,
-                 metrics_deployment: Optional[str] = None):
+                 metrics_deployment: Optional[str] = None,
+                 spec_draft_params=None, spec_draft_config=None,
+                 spec_k: Optional[int] = None,
+                 spec_draft_pool_pages: Optional[int] = None,
+                 device_sampler: Optional[bool] = None):
         import jax
 
         from ray_tpu.core.config import config as rt_config
@@ -291,6 +326,75 @@ class DecodeEngine:
             if self.mesh is not None:
                 self._pool = jax.device_put(
                     self._pool, self._shardings["prefix_pool"])
+        # ------------------------------------------- speculative decoding
+        # A draft model proposes spec_k tokens per active slot per step;
+        # the target verifies all k+1 positions in ONE batched forward
+        # (models.llama_decode.paged_verify) and the engine accepts the
+        # longest prefix whose proposals match the target's per-position
+        # argmax — greedy output is provably identical to sequential
+        # decode, a step just emits 1..k+1 tokens per slot. Draft KV
+        # lives in its OWN (smaller-bytes) page pool with its own block
+        # tables; rejected tails roll the page cursors back on the host
+        # (junk K/V past the cursor is masked and rewritten before any
+        # gather, exactly like pad writes).
+        sk = rt_config.spec_k if spec_k is None else spec_k
+        self.spec_k = int(sk)
+        self.spec = self.spec_k > 0 and spec_draft_params is not None
+        if self.spec:
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding requires paged KV "
+                    "(kv_page_tokens > 0): the verify forward and the "
+                    "rollback cursor are page-table operations")
+            from ray_tpu.serve.paging import PageAllocator
+            self._draft_config = spec_draft_config
+            dpp = (rt_config.spec_draft_pool_pages
+                   if spec_draft_pool_pages is None
+                   else spec_draft_pool_pages)
+            self.draft_pool_pages = int(dpp) or self.pool_pages
+            self._draft_pages = PageAllocator(self.draft_pool_pages)
+            dpool = ld.init_page_pool(spec_draft_config,
+                                      self.draft_pool_pages,
+                                      self.page_tokens)
+            self._draft_cache = {
+                "k": dpool["k"], "v": dpool["v"],
+                "length": jax.numpy.zeros((slots,), jax.numpy.int32)}
+            self._draft_bt = np.zeros((slots, self.slot_pages_max),
+                                      np.int32)
+            self._draft_slot_pages: List[List[int]] = [
+                [] for _ in range(slots)]
+            # Host-side committed draft length per slot; -1 = draftless
+            # (the draft pool could not seat it — the slot rides spec
+            # rounds with junk proposals that simply get rejected).
+            self._draft_committed = [0] * slots
+            self._draft_params = spec_draft_params
+            self._draft_rules = None
+            self._draft_cache_sharding = None
+            if self.mesh is not None:
+                self._draft_params, dsh = ld.shard_decode_state(
+                    spec_draft_params, spec_draft_config, mesh)
+                self._draft_rules = dsh["rules"]
+                self._draft_cache_sharding = dict(dsh["pool"])
+                self._draft_cache = jax.device_put(
+                    self._draft_cache, self._draft_cache_sharding)
+            self.spec_rounds = 0
+            self.spec_proposed = 0
+            self.spec_accepted = 0
+        else:
+            self.spec = False
+        # Device-side sampling: the decode program returns token ids
+        # (argmax / per-row categorical fused under out_shardings)
+        # instead of (slots, vocab) logits — the host stops paying a
+        # full-vocab transfer per step. Opt-in: greedy rows are
+        # bit-identical either way, sampled rows move to the device RNG
+        # stream.
+        self._device_sampler = bool(
+            rt_config.decode_device_sampler if device_sampler is None
+            else device_sampler)
+        self._tokens_dev = None  # device-resident next-token vector:
+        #   valid between consecutive device-sampled steps (the program's
+        #   output feeds the next call without a host->device upload);
+        #   ANY host-side token write invalidates it.
         # Suffix prefills bucket on a finer grid than full prefills: the
         # whole point is that the suffix is short, so padding it back up
         # to prefill_bucket would refund most of the win.
@@ -359,6 +463,42 @@ class DecodeEngine:
             self._paged_decode_chunk_impl if self.paged
             else self._decode_chunk_impl,
             static_argnames=("k",), donate_argnums=(1,), **cache_out))
+        # Speculative programs: target verify (all-position argmax over
+        # the slot's pages, donated KV) and the draft's own prefill +
+        # catch-up/propose programs against the draft pool. Both sample
+        # on device — a round moves (slots, k+1) int32 to the host, not
+        # logits.
+        if self.spec:
+            if self.mesh is not None:
+                draft_out = {"out_shardings": (
+                    rep, self._draft_cache_sharding)}
+                # _draft_prefill returns ONLY the draft cache (its
+                # logits are discarded in-program).
+                draft_cache_only = {
+                    "out_shardings": self._draft_cache_sharding}
+            else:
+                draft_out = {}
+                draft_cache_only = {}
+            self._spec_verify = self._mesh_scoped(jax.jit(
+                self._spec_verify_impl, donate_argnums=(1,),
+                **cache_out))
+            self._spec_draft = self._mesh_scoped(jax.jit(
+                self._spec_draft_impl, static_argnames=("k",),
+                donate_argnums=(1,), **draft_out),
+                rules=self._draft_rules)
+            self._draft_prefill = self._mesh_scoped(jax.jit(
+                self._draft_prefill_impl,
+                static_argnames=("n", "bucket"), donate_argnums=(1,),
+                **draft_cache_only), rules=self._draft_rules)
+        # Fused device sampler (paged and contiguous flavors): one
+        # program returning sampled token ids; per-row temperatures pick
+        # argmax vs categorical, the PRNG key derives from the step
+        # counter in-program.
+        if self._device_sampler:
+            self._decode_sampled = self._mesh_scoped(jax.jit(
+                self._paged_decode_sampled_impl if self.paged
+                else self._decode_sampled_impl, donate_argnums=(1,),
+                **cache_out))
         self.steps = 0
         self.tokens_out = 0
         # ---------------------------------------------- observability
@@ -384,16 +524,21 @@ class DecodeEngine:
         self._compiled: set = set()  # program keys dispatched once
         self._prefill_waves = 0      # prefill programs dispatched
 
-    def _mesh_scoped(self, fn):
+    def _mesh_scoped(self, fn, rules=None):
         """Mesh engines trace every program inside the decode axis-rules
         context (``constrain`` sites in the model resolve against it);
-        single-chip engines get the callable back untouched."""
+        single-chip engines get the callable back untouched. ``rules``
+        overrides the table for programs of a DIFFERENT config than the
+        target — the spec draft model resolves its own divisibility
+        specialization of DECODE_RULES."""
         if self.mesh is None:
             return fn
         from ray_tpu.parallel.sharding import axis_rules
 
+        table = self._rules if rules is None else rules
+
         def scoped(*args, **kwargs):
-            with axis_rules(self.mesh, self._rules):
+            with axis_rules(self.mesh, table):
                 return fn(*args, **kwargs)
 
         return scoped
@@ -509,6 +654,85 @@ class DecodeEngine:
             params, pool, bt, cache["length"], tokens, self.config, k)
         return toks, {"k": pool["k"], "v": pool["v"], "length": lens}
 
+    # ------------------------------------------- speculative jitted bodies
+
+    def _spec_verify_impl(self, params, cache, rows, bt):
+        """Target verify forward: rows (slots, k+1) laid out as
+        ``[last_emitted, draft_1..draft_k]`` per slot, scored from
+        ``pos = length`` against the slot's pages, argmax fused on
+        device — the host receives (slots, k+1) token ids, never
+        logits. ``length`` is returned UNCHANGED: the host owns the
+        cursor and rolls it forward only over the accepted run."""
+        import jax.numpy as jnp
+
+        pool = {"k": cache["k"], "v": cache["v"]}
+        logits, pool = self._ld.paged_verify(
+            params, rows, pool, bt, self.config, cache["length"])
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return toks, {"k": pool["k"], "v": pool["v"],
+                      "length": cache["length"]}
+
+    def _spec_draft_impl(self, params, cache, catchup, catchup_lens,
+                         bt, k):
+        """Draft propose: ingest each slot's 1-2 catch-up tokens from
+        ``pos = length`` and greedily roll ``k`` proposals against the
+        draft pool. ``length`` is host-owned (rolled back with the
+        target's cursor after acceptance) — returned unchanged."""
+        pool = {"k": cache["k"], "v": cache["v"]}
+        toks, pool = self._ld.paged_spec_draft(
+            params, pool, bt, cache["length"], catchup, catchup_lens,
+            self._draft_config, k)
+        return toks, {"k": pool["k"], "v": pool["v"],
+                      "length": cache["length"]}
+
+    def _draft_prefill_impl(self, params, cache, tokens_rows, lengths,
+                            bt, slot_ids, n, bucket):
+        """Draft-pool prompt prefill at admission: the draft must hold
+        K/V for the WHOLE prompt (target prefix-cache hits don't help
+        it — the draft pool has no prefix index), which is fine because
+        the draft is the model chosen to be cheap."""
+        ld = self._ld
+        pool = {"k": cache["k"], "v": cache["v"]}
+        _, pool = ld.paged_prefill(params, tokens_rows[:, :bucket],
+                                   pool, bt, self._draft_config,
+                                   lengths=lengths)
+        return {"k": pool["k"], "v": pool["v"],
+                "length": cache["length"].at[slot_ids].set(lengths)}
+
+    def _paged_decode_sampled_impl(self, params, cache, tokens, bt,
+                                   temps, step):
+        import jax
+
+        pool = {"k": cache["k"], "v": cache["v"]}
+        logits, pool, lens = self._ld.paged_decode_step(
+            params, pool, bt, cache["length"], tokens, self.config)
+        key = jax.random.fold_in(jax.random.key(0), step)
+        toks = self._ld.sample_batch(logits, temps, key)
+        return toks, {"k": pool["k"], "v": pool["v"], "length": lens}
+
+    def _decode_sampled_impl(self, params, cache, tokens, temps, step):
+        import jax
+
+        logits, cache = self._ld.decode_step(params, cache, tokens,
+                                             self.config)
+        key = jax.random.fold_in(jax.random.key(0), step)
+        toks = self._ld.sample_batch(logits, temps, key)
+        return toks, cache
+
+    def _dispatch_fresh(self, key: tuple, call):
+        """First dispatch of one of this PR's donated programs compiles
+        with the persistent XLA compilation cache DETACHED (jaxlib
+        0.4.37 pin, PR 14: a donated executable reloaded from the disk
+        cache segfaults or returns wrong numbers — the tier-1 conftest
+        only dodges it because sub-second debug-model compiles never
+        persist). Later dispatches hit the live in-process jit cache
+        and never touch disk."""
+        if key in self._compiled:
+            return call()
+        self._mark_compile(key)
+        with _no_persistent_cache(self._jax):
+            return call()
+
     # --------------------------------------------- paged page accounting
 
     def _alloc_pages(self, n: int) -> Optional[List[int]]:
@@ -566,6 +790,102 @@ class DecodeEngine:
                     break
                 if not self._preempt_one():
                     break  # nothing left to preempt: caller's slot only
+
+    # ------------------------------------------- draft-pool accounting
+    #
+    # The draft pool mirrors the target's block-table discipline at the
+    # draft model's (smaller) K/V width: same page size, its own
+    # allocator and tables, no prefix index. Freeing a slot frees both
+    # pools. Draft-pool pressure NEVER touches the target plane: a
+    # draft seat is opportunistic (it only buys speedup), so a dry
+    # draft pool evicts the youngest DRAFT seat — never preempts a
+    # request, which would requeue it through the suffix-continuation
+    # prefill and perturb greedy near-ties.
+
+    def _draft_grow_slot(self, slot: int, pages: List[int]) -> None:
+        have = self._draft_slot_pages[slot]
+        self._draft_bt[slot, len(have):len(have) + len(pages)] = pages
+        self._draft_slot_pages[slot] = have + pages
+
+    def _ensure_draft_pages(self, k: int) -> None:
+        """Every drafted active slot's draft can write catch-up + k-1
+        proposal positions (through ``L + k - 1``). Draftless slots (-1)
+        are skipped: their rows route to the scratch page and their junk
+        proposals are simply rejected by verification. A slot the pool
+        cannot cover even after evicting younger draft seats is demoted
+        to draftless the same way — spec rounds stay correct
+        (verification guarantees the output), the slot just stops
+        speculating usefully."""
+        for slot in sorted(self._active,
+                           key=lambda s: self._active[s].submitted_at):
+            req = self._active[slot]
+            while True:
+                if self._draft_committed[slot] < 0:
+                    break
+                need = self._seq_pages(req.prompt_len + req.generated
+                                       - 1 + k) \
+                    - len(self._draft_slot_pages[slot])
+                if need <= 0:
+                    break
+                got = self._draft_pages.alloc(need)
+                if got is not None:
+                    self._draft_grow_slot(slot, got)
+                    break
+                if not self._draft_evict_one(slot):
+                    self._draft_demote(slot, req)
+                    break
+
+    def _draft_demote(self, slot: int, req: _Request) -> None:
+        """Drop a slot's draft seat (freeing its draft pages): it keeps
+        riding spec rounds with junk proposals that verification
+        rejects — output stays correct, the slot just stops
+        contributing speedup until re-admission reseats it."""
+        self._draft_pages.free(self._draft_slot_pages[slot])
+        self._draft_slot_pages[slot] = []
+        self._draft_bt[slot, :] = 0
+        self._draft_committed[slot] = -1
+        if self.steplog.enabled:
+            self.steplog.event("spec-draftless",
+                               request=req.request_id)
+
+    def _draft_evict_one(self, keep: int) -> bool:
+        """Make room in the draft pool by demoting the youngest OTHER
+        drafted slot. Never touches the target plane — preempting a
+        request over draft pressure would requeue it through the
+        suffix-continuation prefill and perturb greedy near-ties,
+        breaking the spec-mode bit-exactness contract for pure
+        speedup bookkeeping."""
+        cands = [s for s in self._active
+                 if s != keep and self._draft_committed[s] >= 0
+                 and self._draft_slot_pages[s]]
+        if not cands:
+            return False
+        victim = max(cands, key=lambda s: self._active[s].submitted_at)
+        self._draft_demote(victim, self._active[victim])
+        return True
+
+    def _rollback_pages(self, slot: int, committed: int) -> None:
+        """Roll a slot's page cursors back to ``committed`` tokens after
+        a spec round: tail pages past the accepted run free in BOTH
+        pools (their junk K/V is provably dead — nothing attends past
+        the rolled-back ``length``, and a later owner's scatter runs
+        before its gather). Leading pages — including shared prefix
+        splices — are never touched: ``committed >= prefix_len``
+        always."""
+        keep = self._seq_pages(committed)
+        tail = self._slot_pages[slot][keep:]
+        if tail:
+            self._block_tables[slot, keep:keep + len(tail)] = 0
+            self._slot_pages[slot] = self._slot_pages[slot][:keep]
+            self._pages.free(tail)
+        keep_d = self._seq_pages(min(committed,
+                                     self._draft_committed[slot]))
+        dtail = self._draft_slot_pages[slot][keep_d:]
+        if dtail:
+            self._draft_bt[slot, keep_d:keep_d + len(dtail)] = 0
+            self._draft_slot_pages[slot] = \
+                self._draft_slot_pages[slot][:keep_d]
+            self._draft_pages.free(dtail)
 
     def _preempt_one(self) -> bool:
         """Requeue the youngest admitted request to free its pages
@@ -630,6 +950,9 @@ class DecodeEngine:
                 f"prompt ({len(req.tokens)}) + max_new_tokens "
                 f"({req.max_new_tokens}) needs more pages than the pool "
                 f"holds ({self.pool_pages} x {self.page_tokens} tokens)")
+        # The spec draft pool is deliberately NOT an admission limit: a
+        # request the draft pool cannot seat decodes draftless (junk
+        # proposals, all rejected) — correct output, no speedup.
         if len(req.tokens) >= self.capacity:
             raise ValueError(
                 f"prompt ({len(req.tokens)}) must be shorter than the "
@@ -790,6 +1113,16 @@ class DecodeEngine:
                     smetrics.INTER_TOKEN.observe(
                         (req.finished_at - req.first_token_at)
                         / (req.generated - 1), self._mtags)
+            if req.spec_proposed > 0:
+                # Acceptance per REQUEST (not per round): one histogram
+                # observation at terminal keeps the doctrine — nothing
+                # observability-side runs per token or per step.
+                smetrics.SPEC_PROPOSED.inc(float(req.spec_proposed),
+                                           self._mtags)
+                smetrics.SPEC_ACCEPTED.inc(float(req.spec_accepted),
+                                           self._mtags)
+                smetrics.SPEC_ACCEPT.observe(
+                    req.spec_accepted / req.spec_proposed, self._mtags)
         if self._obs_spans and req.trace is not None:
             from ray_tpu.util import tracing
 
@@ -1248,6 +1581,8 @@ class DecodeEngine:
                 self.prefix.insert(req.tokens, self._slot_pages[slot],
                                    matched_len=req.prefix_len)
         now = time.monotonic()
+        self._tokens_dev = None  # host writes below invalidate the
+        #   device-resident token vector (sampled-path feedback)
         for i, req in enumerate(group):
             tok = self._sample_host(logits[i], req)
             req.slot = slots[i]
@@ -1272,6 +1607,84 @@ class DecodeEngine:
                     self._pool["k"], self._pool["v"] = self._pool_insert(
                         self.cache, self._pool["k"], self._pool["v"],
                         slot, row)
+        if self.spec:
+            self._draft_seat([r for r in group if not r.done.is_set()])
+
+    def _draft_seat(self, reqs: List[_Request]) -> None:
+        """Give each freshly-admitted slot its draft-side state: draft
+        pages covering the prompt and a full draft prefill (prefix-hit
+        target admissions still draft-prefill the WHOLE prompt — the
+        draft pool has no prefix index, and the draft is cheap by
+        construction). A slot the draft pool cannot seat even after
+        evicting younger draft seats is marked draftless (-1): its spec
+        rounds run with junk proposals the verify forward simply
+        rejects — correct, just not faster — instead of wedging the
+        batch."""
+        for req in reqs:
+            self._draft_prefill_slot(req.slot, req,
+                                     np.asarray(req.tokens, np.int32))
+
+    def _draft_prefill_slot(self, slot: int, req: _Request,
+                            seq: np.ndarray) -> bool:
+        """Allocate draft pages covering ``seq`` and prefill it into the
+        slot's draft state; ``seq`` is the true committed token stream
+        (the whole prompt at admission, prompt+output on resync). False
+        = slot no longer owns the seat, or pool dry even after evicting
+        younger draft seats (slot demoted to draftless)."""
+        import jax.numpy as jnp
+
+        if self._active.get(slot) is not req:
+            return False  # finished/preempted inside this admission
+        got = self._draft_pages.alloc(self._seq_pages(len(seq)))
+        while got is None and self._draft_evict_one(slot):
+            got = self._draft_pages.alloc(self._seq_pages(len(seq)))
+        if got is None:
+            self._draft_demote(slot, req)
+            return False
+        self._draft_bt[slot, :] = 0
+        self._draft_bt[slot, :len(got)] = got
+        self._draft_slot_pages[slot] = got
+        bucket = min(self._ld.cache_bucket(len(seq),
+                                           self.prefill_bucket),
+                     self.capacity)
+        wp = max(1, -(-bucket // self.page_tokens))
+        rows = np.zeros((1, bucket), np.int32)
+        rows[0, :len(seq)] = seq
+        bt = self._draft_bt[slot:slot + 1, :wp]
+        t0 = time.time()
+        self._draft_cache = self._dispatch_fresh(
+            ("draft_prefill", 1, bucket),
+            lambda: self._draft_prefill(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(rows),
+                jnp.asarray([len(seq)], np.int32),
+                jnp.asarray(bt), jnp.asarray([slot], np.int32),
+                n=1, bucket=bucket))
+        self._draft_committed[slot] = len(seq)
+        self._wave_span("draft-prefill", t0, [req], tokens=len(seq))
+        return True
+
+    def _draft_resync(self, slot: int, req: _Request) -> bool:
+        """Plain-decode interludes (mixed-temperature batches, chunked
+        greedy runs, draftless neighbours) advance the target while the
+        draft idles; once the draft is more than one round behind, its
+        bounded catch-up row can't close the gap — rebuild the slot's
+        draft state with one full draft prefill of the true sequence."""
+        L = req.prompt_len + req.generated - 1
+        self._draft_pages.free(self._draft_slot_pages[slot])
+        self._draft_slot_pages[slot] = []
+        self._draft_bt[slot, :] = 0
+        seq = np.asarray([self._token_at(req, p) for p in range(L)],
+                         np.int32)
+        return self._draft_prefill_slot(slot, req, seq)
+
+    @staticmethod
+    def _token_at(req: _Request, p: int) -> int:
+        """True committed token at absolute position p (prompt, then
+        generated output — valid for reabsorbed requests too, whose
+        prompt_len stays the ORIGINAL admission length)."""
+        return (int(req.tokens[p]) if p < req.prompt_len
+                else int(req.output[p - req.prompt_len]))
 
     def _sample_host(self, logits: np.ndarray, req: _Request) -> int:
         if req.temperature <= 0.0:
@@ -1321,11 +1734,20 @@ class DecodeEngine:
             if pages and self.steplog.enabled:
                 self.steplog.event("page-free", n=len(pages),
                                    free=self._pages.free_count)
+        if self.spec:
+            dpages = self._draft_slot_pages[slot]
+            self._draft_slot_pages[slot] = []
+            self._draft_bt[slot, :] = 0
+            self._draft_pages.free(dpages)
+            self._draft_committed[slot] = 0
+            self._draft_cache["length"] = \
+                self._draft_cache["length"].at[slot].set(0)
         self._free.append(slot)
         # Park the freed slot at length 0 so idle slots don't walk their
         # cursor toward the capacity edge while others decode.
         self.cache["length"] = self.cache["length"].at[slot].set(0)
         self._tokens[slot] = 0
+        self._tokens_dev = None
 
     def _finish(self, slot: int, status: str = "completed") -> None:
         req = self._active.pop(slot, None)
@@ -1436,6 +1858,18 @@ class DecodeEngine:
         if not self._active:
             self._steplog_row(t_step0, phases)
             return 0
+        if self._spec_ready():
+            # Page both pools for the round up front (block tables are
+            # static across the draft/verify calls). The target ensure
+            # may preempt the youngest request; the draft ensure only
+            # ever demotes draft seats.
+            self._ensure_decode_pages(self.spec_k + 1)
+            if not self._active:
+                self._steplog_row(t_step0, phases)
+                return 0
+            self._ensure_draft_pages(self.spec_k)
+            if self._spec_ready():
+                return self._spec_step(t_step0, phases, rec)
         chunk = self._pick_chunk()
         if self.paged:
             # Page the next k tokens in BEFORE the program runs: the
@@ -1477,6 +1911,8 @@ class DecodeEngine:
                         break
             self._steplog_row(t_step0, phases)
             return stepped
+        if self._device_sampler:
+            return self._sampled_step(t_step0, phases, rec)
         self._mark_compile(("decode",))
         t_d0 = time.time() if rec else 0.0
         if self.paged:
@@ -1502,6 +1938,185 @@ class DecodeEngine:
         self._steplog_row(t_step0, phases)
         return stepped
 
+    def _spec_ready(self) -> bool:
+        """Spec rounds engage only when every active request is greedy
+        (the acceptance rule compares ARGMAX tokens, which is exactly
+        the sequential greedy choice — sampled requests must take the
+        plain path, host or device sampler, to keep their RNG stream
+        intact) AND at least one slot still holds a draft seat: an
+        all-draftless batch would pay the k+1-wide verify forward for
+        guaranteed-rejected junk, so it takes the plain path instead."""
+        return (self.spec and bool(self._active)
+                and all(r.temperature <= 0.0
+                        for r in self._active.values())
+                and any(self._draft_committed[s] >= 0
+                        for s in self._active))
+
+    def _spec_step(self, t_step0: float, phases: List[Dict[str, Any]],
+                   rec: bool) -> int:
+        """One speculative round: the draft proposes k tokens per active
+        slot (catching up on last round's accepted run first), the
+        target verifies all k+1 positions in ONE batched forward, the
+        longest proposal prefix matching the target's own argmax emits —
+        plus the target's correction token — and page cursors roll back
+        over the rejected tail. Emits 1..k+1 tokens per slot per round;
+        greedy output is bit-identical to sequential decode because
+        position j's verify logits condition on exactly the tokens
+        sequential decode would have committed whenever proposals 1..j
+        all accepted, and nothing past the first mismatch is used."""
+        import jax.numpy as jnp
+
+        k = self.spec_k
+        stepped = len(self._active)
+        # ---- draft: bounded catch-up rows + k proposals per slot
+        catchup = np.zeros((self.slots, 2), np.int32)
+        clens = np.ones((self.slots,), np.int32)
+        for slot, req in list(self._active.items()):
+            D = self._draft_committed[slot]
+            if D < 0:
+                continue  # draftless: junk proposals, still verified
+            L = req.prompt_len + req.generated - 1
+            if L - D + 1 > 2:
+                # _draft_resync may evict younger draft seats or demote
+                # this slot to draftless; both leave the round correct,
+                # so just re-read the state it settled on.
+                if not self._draft_resync(slot, req):
+                    continue
+                D = self._draft_committed[slot]
+            cl = L - D + 1
+            for j in range(cl):
+                catchup[slot, j] = self._token_at(req, D + j)
+            clens[slot] = cl
+        t_d0 = time.time() if rec else 0.0
+        toks_d, self._draft_cache = self._dispatch_fresh(
+            ("spec_draft", k),
+            lambda: self._spec_draft(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(catchup), jnp.asarray(clens),
+                jnp.asarray(self._draft_bt), k=k))
+        # np.array (never asarray): the next donated dispatch must not
+        # clobber an aliased host view of these tokens (PR 14 pin).
+        toks_d = np.array(toks_d)                          # (slots, k)
+        if rec:
+            phases.append({"phase": "draft", "t0": t_d0,
+                           "t1": time.time(), "batch": stepped, "k": k})
+        # ---- target: verify all k+1 positions in one batched forward
+        rows = np.zeros((self.slots, k + 1), np.int32)
+        for slot in self._active:
+            rows[slot, 0] = self._tokens[slot]
+            rows[slot, 1:] = toks_d[slot]
+        t_v0 = time.time() if rec else 0.0
+        toks_v, self.cache = self._dispatch_fresh(
+            ("spec_verify", k),
+            lambda: self._spec_verify(
+                self.params, self.cache, jnp.asarray(rows),
+                jnp.asarray(self._block_tables)))
+        toks_v = np.array(toks_v)                          # (slots, k+1)
+        # ---- host: longest-matching-prefix acceptance + rollback
+        self.steps += 1
+        self.spec_rounds += 1
+        self._tokens_dev = None
+        round_accepted = 0
+        upd: List[Tuple[int, int, int]] = []   # (slot, L', D')
+        for slot in list(self._active):
+            req = self._active[slot]
+            g = toks_v[slot]
+            n_acc = 0
+            while n_acc < k and rows[slot, n_acc + 1] == g[n_acc]:
+                n_acc += 1
+            if self._draft_committed[slot] >= 0:
+                req.spec_proposed += k
+                req.spec_accepted += n_acc
+                self.spec_proposed += k
+                self.spec_accepted += n_acc
+                round_accepted += n_acc
+            L = req.prompt_len + req.generated - 1
+            emitted = 0
+            finished = False
+            for j in range(n_acc + 1):
+                tok = int(g[j])
+                self._emit(req, tok)
+                self._tokens[slot] = tok
+                emitted += 1
+                if req.generated >= req.max_new_tokens or (
+                        req.eos_id is not None and tok == req.eos_id):
+                    finished = True
+                    break
+            if finished:
+                self._finish(slot)  # frees both pools' tails wholesale
+                continue
+            committed = L + emitted
+            if self._draft_committed[slot] >= 0:
+                # Draft K/V is valid through L + k (catch-up + its own
+                # proposals); past-the-acceptance junk rolls back with
+                # the pages below and the next catch-up row rewrites it.
+                self._draft_committed[slot] = L + min(emitted, k)
+            self._rollback_pages(slot, committed)
+            upd.append((slot, committed,
+                        max(0, self._draft_committed[slot])))
+        if upd:
+            ids = jnp.asarray([u[0] for u in upd], jnp.int32)
+            self.cache["length"] = self.cache["length"].at[ids].set(
+                jnp.asarray([u[1] for u in upd], jnp.int32))
+            self._draft_cache["length"] = \
+                self._draft_cache["length"].at[ids].set(
+                    jnp.asarray([u[2] for u in upd], jnp.int32))
+        if rec:
+            phases.append({"phase": "verify", "t0": t_v0,
+                           "t1": time.time(), "batch": stepped, "k": k,
+                           "accepted": round_accepted})
+        self._steplog_row(t_step0, phases)
+        return stepped
+
+    def _sampled_step(self, t_step0: float,
+                      phases: List[Dict[str, Any]], rec: bool) -> int:
+        """Single decode step with sampling fused into the device
+        program: the (slots, vocab) logits never cross the host
+        boundary — only (slots,) token ids do — and consecutive sampled
+        steps feed the device-resident token vector straight back in.
+        Greedy rows are bit-identical to the host sampler (both argmax
+        with first-max tiebreak); sampled rows draw from the program's
+        counter-based RNG stream instead of the host generator."""
+        import jax.numpy as jnp
+
+        stepped = len(self._active)
+        temps = np.zeros((self.slots,), np.float32)
+        for slot, req in self._active.items():
+            temps[slot] = max(0.0, req.temperature)
+        tin = (self._tokens_dev if self._tokens_dev is not None
+               else jnp.asarray(self._tokens))
+        t_d0 = time.time() if rec else 0.0
+        if self.paged:
+            toks_dev, self.cache = self._dispatch_fresh(
+                ("decode_sampled",),
+                lambda: self._decode_sampled(
+                    self.params, self.cache, tin,
+                    jnp.asarray(self._block_tables), jnp.asarray(temps),
+                    jnp.asarray(self.steps, jnp.int32)))
+        else:
+            toks_dev, self.cache = self._dispatch_fresh(
+                ("decode_sampled",),
+                lambda: self._decode_sampled(
+                    self.params, self.cache, tin, jnp.asarray(temps),
+                    jnp.asarray(self.steps, jnp.int32)))
+        toks = np.array(toks_dev)  # np.array: next dispatch donates
+        self._tokens_dev = toks_dev
+        if rec:
+            phases.append({"phase": "decode", "t0": t_d0,
+                           "t1": time.time(), "batch": stepped, "k": 1,
+                           "sampler": "device"})
+        self.steps += 1
+        for slot in list(self._active):
+            req = self._active[slot]
+            tok = int(toks[slot])
+            self._emit(req, tok)
+            self._tokens[slot] = tok
+            if req.generated >= req.max_new_tokens or (
+                    req.eos_id is not None and tok == req.eos_id):
+                self._finish(slot)
+        self._steplog_row(t_step0, phases)
+        return stepped
+
     def _steplog_row(self, t0: float, phases: List[Dict[str, Any]]
                      ) -> None:
         """Close the step's timeline row; idle steps with no phases and
@@ -1516,6 +2131,80 @@ class DecodeEngine:
             queued=max(0, self._pending.qsize() + len(self._requeue)
                        - self._queued_cancelled),
             pages_free=self._pages.free_count if self.paged else None)
+
+    def warmup(self) -> None:
+        """Pre-dispatch the step-loop programs (decode, the chunk grid,
+        the fused sampler, the spec round, one admission bucket) so the
+        first real request never pays their jit compiles. Safe on an
+        idle engine: paged writes route to the scratch page (idle block
+        tables are all zeros), contiguous junk lands on idle rows the
+        next admission overwrites, and the parked KV lengths are
+        restored afterwards. Donated programs take their first dispatch
+        HERE through the fresh-compile guard, so the jaxlib 0.4.37
+        donated-reload footgun is burned off before traffic."""
+        import jax.numpy as jnp
+
+        toks = jnp.asarray(self._tokens)
+        zero_t = jnp.zeros((self.slots,), jnp.float32)
+        step0 = jnp.asarray(0, jnp.int32)
+        if self.paged:
+            bt = jnp.asarray(self._block_tables)
+            bucket = self.prefill_bucket
+            wp = max(1, -(-bucket // self.page_tokens))
+            self._mark_compile(("paged_prefill", 1, bucket))
+            _, self.cache = self._paged_prefill(
+                self.params, self.cache,
+                jnp.zeros((1, bucket), jnp.int32),
+                jnp.asarray([0], jnp.int32),
+                jnp.asarray(self._block_tables[:1, :wp]),
+                jnp.asarray([0], jnp.int32), n=1, bucket=bucket)
+            self._mark_compile(("decode",))
+            _, self.cache = self._decode(self.params, self.cache, toks,
+                                         bt)
+            c = 2
+            while c <= self.decode_chunk:
+                self._mark_compile(("decode_k", c))
+                _, self.cache = self._decode_k(self.params, self.cache,
+                                               toks, bt, k=c)
+                c *= 2
+            if self._device_sampler:
+                _, self.cache = self._dispatch_fresh(
+                    ("decode_sampled",),
+                    lambda: self._decode_sampled(
+                        self.params, self.cache, toks, bt, zero_t,
+                        step0))
+            if self.spec:
+                k = self.spec_k
+                _, self._draft_cache = self._dispatch_fresh(
+                    ("spec_draft", k),
+                    lambda: self._spec_draft(
+                        self._draft_params, self._draft_cache,
+                        jnp.zeros((self.slots, 2), jnp.int32),
+                        jnp.ones((self.slots,), jnp.int32),
+                        jnp.asarray(self._draft_bt), k=k))
+                _, self.cache = self._dispatch_fresh(
+                    ("spec_verify", k),
+                    lambda: self._spec_verify(
+                        self.params, self.cache,
+                        jnp.zeros((self.slots, k + 1), jnp.int32), bt))
+                self._draft_cache["length"] = \
+                    self._draft_cache["length"].at[:].set(0)
+        else:
+            self._mark_compile(("decode",))
+            _, self.cache = self._decode(self.params, self.cache, toks)
+            c = 2
+            while c <= self.decode_chunk:
+                self._mark_compile(("decode_k", c))
+                _, self.cache = self._decode_k(self.params, self.cache,
+                                               toks, k=c)
+                c *= 2
+            if self._device_sampler:
+                _, self.cache = self._dispatch_fresh(
+                    ("decode_sampled",),
+                    lambda: self._decode_sampled(
+                        self.params, self.cache, toks, zero_t, step0))
+        self.cache["length"] = self.cache["length"].at[:].set(0)
+        self._tokens_dev = None
 
     def serve_forever(self, idle_wait_s: float = 0.05) -> None:
         """Decode loop for a replica thread: steps while work exists,
@@ -1598,6 +2287,21 @@ class DecodeEngine:
             out["pages_pinned"] = (self.prefix.pinned_pages
                                    if self.prefix is not None else 0)
             out["kv_fragmentation"] = self._fragmentation()
+        if self.spec:
+            # Fleet-visible acceptance: proposed/accepted feed the same
+            # counters Prometheus sees; accept_rate is the cumulative
+            # ratio (per-request distribution lives in the histogram).
+            out["spec"] = {
+                "k": self.spec_k,
+                "rounds": self.spec_rounds,
+                "proposed_tokens": self.spec_proposed,
+                "accepted_tokens": self.spec_accepted,
+                "accept_rate": (
+                    round(self.spec_accepted / self.spec_proposed, 4)
+                    if self.spec_proposed else None),
+                "draft_pages_total": self._draft_pages.pages,
+                "draft_pages_free": self._draft_pages.free_count,
+            }
         if self.prefix is not None:
             out["prefix"] = self.prefix.stats()
         if self.steplog.enabled:
@@ -1620,6 +2324,7 @@ class DecodeEngine:
         out["replica_id"] = self._replica_id
         out["paged"] = self.paged
         out["slots"] = self.slots
+        out["spec_k"] = self.spec_k if self.spec else 0
         return out
 
     def _fragmentation(self) -> float:
@@ -1666,15 +2371,38 @@ class LlamaDecodeDeployment:
                  kv_page_tokens: Optional[int] = None,
                  kv_pool_pages: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 mesh_shape=None):
+                 mesh_shape=None,
+                 spec_draft_model: Optional[str] = None,
+                 spec_k: Optional[int] = None,
+                 spec_draft_pool_pages: Optional[int] = None,
+                 device_sampler: Optional[bool] = None,
+                 warmup: Optional[bool] = None):
         import jax
 
+        from ray_tpu.core.config import config as rt_config
         from ray_tpu.models import llama
 
         cfg = config or llama.PRESETS[preset]
         self.cfg = cfg
         self._sub_slice: Optional[Dict[str, Any]] = None
         params = llama.init_params(cfg, jax.random.key(seed))
+        # Draft model for speculative decoding: a (smaller) preset named
+        # by knob. Seeded independently of the target — the contract
+        # never depends on draft quality, only on verification.
+        draft_name = (rt_config.spec_draft_model
+                      if spec_draft_model is None else spec_draft_model)
+        sk = rt_config.spec_k if spec_k is None else int(spec_k)
+        draft_params = draft_cfg = None
+        if draft_name and sk > 0:
+            draft_cfg = llama.PRESETS[draft_name]
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"spec_draft_model {draft_name!r} vocab "
+                    f"({draft_cfg.vocab_size}) != target vocab "
+                    f"({cfg.vocab_size}) — proposals must share the "
+                    f"token space the target verifies")
+            draft_params = llama.init_params(draft_cfg,
+                                             jax.random.key(seed + 1))
         self.engine = DecodeEngine(
             params, cfg, slots=slots, capacity=capacity,
             decode_chunk=decode_chunk,
@@ -1684,7 +2412,13 @@ class LlamaDecodeDeployment:
             queue_max=queue_max,
             page_tokens=kv_page_tokens, pool_pages=kv_pool_pages,
             prefill_chunk_tokens=prefill_chunk_tokens,
-            mesh_shape=mesh_shape)
+            mesh_shape=mesh_shape,
+            spec_draft_params=draft_params, spec_draft_config=draft_cfg,
+            spec_k=sk if draft_params is not None else 0,
+            spec_draft_pool_pages=spec_draft_pool_pages,
+            device_sampler=device_sampler)
+        if (rt_config.decode_warmup if warmup is None else warmup):
+            self.engine.warmup()
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()
@@ -1726,6 +2460,8 @@ class LlamaDecodeDeployment:
             for key in ("pages_total", "pages_free", "pages_in_use",
                         "pages_pinned", "kv_fragmentation", "preempted"):
                 out[key] = s[key]
+        if self.engine.spec:
+            out["spec"] = s["spec"]
         if self.engine.prefix is not None:
             out["prefix"] = s.get("prefix", {})
             out["prefixes"] = self.engine.prefix.hashes()

@@ -79,6 +79,27 @@ PREEMPTIONS = Counter(
     "Engine recompute-preemptions under page pressure.",
     tag_keys=("deployment",))
 
+# Speculative decoding: proposal volume + acceptance. Counters give the
+# fleet-wide accepted/proposed ratio (the speedup predictor); the
+# histogram gives the per-request distribution (a bimodal accept rate
+# means one traffic class defeats the draft). Observed once per request
+# at its terminal step, per the per-REQUEST doctrine above.
+SPEC_PROPOSED = Counter(
+    "serve_spec_proposed_tokens_total",
+    "Draft tokens proposed to the verify forward.",
+    tag_keys=("deployment",))
+
+SPEC_ACCEPTED = Counter(
+    "serve_spec_accepted_tokens_total",
+    "Draft tokens accepted by target verification.",
+    tag_keys=("deployment",))
+
+SPEC_ACCEPT = Histogram(
+    "serve_spec_accept_rate",
+    "Per-request draft acceptance rate (accepted / proposed).",
+    boundaries=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
+    tag_keys=("deployment",))
+
 PENDING_RELEASES = Gauge(
     "serve_pending_subslice_releases",
     "Sub-slice release RPCs awaiting retry after a head blip "
@@ -109,6 +130,7 @@ _HISTOGRAMS = {
     "inter_token_s": "serve_inter_token_s",
     "queue_wait_s": "serve_queue_wait_s",
     "http_request_s": "serve_http_request_s",
+    "spec_accept_rate": "serve_spec_accept_rate",
 }
 
 
@@ -137,6 +159,10 @@ def slo_summary(aggregated: Dict[str, List[Dict[str, Any]]]
             tags.get("outcome", "?")] = int(total)
     for name, field in (("serve_router_retries_total", "retries"),
                         ("serve_preemptions_total", "preempted"),
+                        ("serve_spec_proposed_tokens_total",
+                         "spec_proposed_tokens"),
+                        ("serve_spec_accepted_tokens_total",
+                         "spec_accepted_tokens"),
                         ("serve_http_requests_total", "http_responses")):
         for key, total in counter_totals(aggregated, name).items():
             tags = dict(key)

@@ -732,6 +732,8 @@ def test_callgraph_self_attr_type_resolution():
 
 # ------------------------------------------------------- CLI plumbing
 
+@pytest.mark.slow  # 18s: two full repo runs; serial CLI runs stay in
+# tier-1 (PR 16 rebudget)
 def test_cli_jobs_parallel_matches_serial():
     serial, _ = run_analysis(jobs=1)
     parallel, _ = run_analysis(jobs=4)
@@ -751,6 +753,7 @@ def test_cli_diff_mode(tmp_path, capsys):
     assert rc == 2
 
 
+@pytest.mark.slow  # 7s: full-repo stats run; PR 16 rebudget
 def test_cli_stats_json_artifact(tmp_path, capsys):
     from ray_tpu.analysis.__main__ import main
 

@@ -371,6 +371,53 @@ def test_mutation_dropped_anchor_caught():
         [f.render() for f in found]
 
 
+def test_mutation_verify_rules_partition_caught():
+    """Acceptance: sharding the wo-contraction axis in the REAL
+    DECODE_RULES is caught at the speculative verify forward too — the
+    spec-mode verify program sits under the same bit-exactness
+    contract as the decode steps."""
+    project = repo_project_with(
+        "ray_tpu/parallel/sharding.py",
+        '"attn_heads": None,', '"attn_heads": "model",')
+    found = run_checker(sharding_safety.check, project)
+    hits = [f for f in found if f.rule == rules.SHARDING_CONTRACTION]
+    assert hits, [f.render() for f in found]
+    assert any(f.symbol == "paged_verify.body" for f in hits), \
+        sorted({f.symbol for f in hits})
+
+
+def test_mutation_verify_dropped_anchor_caught():
+    """The S-shaped attention anchor line is shared verbatim by the
+    contiguous suffix, paged suffix and spec verify forwards: dropping
+    it loses the pre-wo anchor in all three."""
+    project = repo_project_with(
+        "ray_tpu/models/llama_decode.py",
+        '        att = att.transpose(0, 3, 1, 2, 4).reshape(\n'
+        '            B, S, c.n_heads, c.head_dim).astype(x.dtype)\n'
+        '        att = constrain(att, ("batch", "length", "attn_heads",'
+        ' "head_dim"))',
+        '        att = att.transpose(0, 3, 1, 2, 4).reshape(\n'
+        '            B, S, c.n_heads, c.head_dim).astype(x.dtype)')
+    found = run_checker(sharding_safety.check, project)
+    hits = [f for f in found if f.rule == rules.SHARDING_ANCHOR]
+    assert sorted({f.symbol for f in hits}) == [
+        "paged_prefill_suffix.body", "paged_verify.body",
+        "prefill_suffix.body"], [f.render() for f in found]
+
+
+def test_spec_programs_clean_under_decode_rules():
+    """TN: the unmutated verify / draft / device-sampler programs carry
+    their anchors and contract only unsharded axes — no sharding
+    findings anywhere in the decode model module."""
+    found = run_checker(sharding_safety.check,
+                        Project.load(repo_root()))
+    bad = [f for f in found
+           if f.path == "ray_tpu/models/llama_decode.py"
+           and f.rule in (rules.SHARDING_CONTRACTION,
+                          rules.SHARDING_ANCHOR)]
+    assert bad == [], "\n".join(f.render() for f in bad)
+
+
 def test_mutation_dropped_lease_release_caught():
     """Acceptance: removing _add_replica's exception-path release is a
     repo-blocking finding (the reserve-then-spawn leak)."""

@@ -77,6 +77,7 @@ def test_compiled_pipeline_overlaps_stages(ray_start_regular):
         cdag.teardown()
 
 
+@pytest.mark.slow  # 22 s: pipeline-parallel vs dense parity
 @pytest.mark.timeout_s(300)
 def test_llama_pipeline_parallel_matches_dense(ray_start_regular):
     """PP end to end: the debug Llama split into 2 pipeline stages hosted

@@ -208,6 +208,7 @@ def _make_wheel(path, name, version, source):
 
 
 @pytest.mark.timeout_s(240)
+@pytest.mark.slow  # 9s: real pip wheel build; PR 16 rebudget
 def test_runtime_env_pip_wheel_isolated(ray_start_regular, tmp_path):
     """A task whose runtime_env pips in a wheel ABSENT from the base env
     imports it; a plain task on the same cluster cannot (isolation), and

@@ -147,6 +147,7 @@ def test_window_invariance_and_parity_2_stages(pipe_cluster):
     assert plane.registry_state() is None  # record dropped
 
 
+@pytest.mark.slow  # 25 s: 4-stage parity sweep
 def test_loss_parity_4_stages(pipe_cluster):
     """Four 1-layer stages, 8 microbatches: bit-exact vs the local
     4-stage chain, tolerance-parity vs the full model."""
@@ -533,6 +534,7 @@ def test_transient_snapshot_failure_commits_step_on_live_gang(
 # ------------------------------- train-plane trace + step breakdown
 
 
+@pytest.mark.slow  # 19.5s: traced 4-stage run; PR 16 tier-1 rebudget
 def test_train_trace_rows_bubble_and_step_breakdown(pipe_cluster):
     """ISSUE 15 acceptance: a traced 4-stage step renders per-stage
     process rows whose spans carry {step, mb, stage} attrs, and the
@@ -628,6 +630,7 @@ def test_train_trace_rows_bubble_and_step_breakdown(pipe_cluster):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow  # 26 s: SIGKILL + dump collection
 def test_post_mortem_names_killed_stage_from_dumps(pipe_cluster):
     """ISSUE 15 acceptance: SIGKILL a StageActor (faultinject die at
     its member beat site), let the gang reconcile and training resume —

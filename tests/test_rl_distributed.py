@@ -187,6 +187,7 @@ def test_policy_inference_coalesces_requests(ray_start_regular):
 
 
 @pytest.mark.timeout_s(420)
+@pytest.mark.slow  # 21s: full IMPALA learning run; PR 16 rebudget
 def test_distributed_impala_learns_cartpole(ray_start_regular):
     """The on-policy half of the ISSUE 10 acceptance e2e: 4
     RolloutActors sampling continuously (measured policy lag ~5 updates
@@ -224,6 +225,7 @@ def test_distributed_impala_learns_cartpole(ray_start_regular):
 
 
 @pytest.mark.timeout_s(240)
+@pytest.mark.slow  # 10s: shutdown leak soak; PR 16 rebudget
 def test_distributed_shutdown_frees_objects():
     """Zero leaked ObjectRefs: after stop(), the published weights
     object is freed from the driver-side store (the hub's pinned handle

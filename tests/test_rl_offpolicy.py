@@ -87,6 +87,7 @@ def test_dqn_single_iteration(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow  # 38 s: DQN replay-buffer convergence soak
 @pytest.mark.timeout_s(420)
 def test_dqn_learns_cartpole(ray_start_regular):
     """Run-to-reward, UN-SKIPPED in PR 10: the PR 3 triage was right

@@ -71,6 +71,7 @@ def test_control_plane_500_nodes_heartbeat_storm():
 
 
 @pytest.mark.timeout_s(170)
+@pytest.mark.slow  # 8s: 50-raylet storm soak; PR 16 rebudget
 def test_50_raylets_task_pg_storms(ray_start_cluster):
     """50 live raylets: 600-task storm completes with sane scheduling
     latency; 120 simultaneous placement groups all reserve and release."""

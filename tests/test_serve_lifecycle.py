@@ -249,6 +249,7 @@ def test_queue_default_cap_is_slots_x8():
 # ------------------------------------------------- through the serve stack
 
 
+@pytest.mark.slow  # 18.5s: full proxy+handle sweep; PR 16 rebudget
 @pytest.mark.timeout_s(240)
 def test_deadline_and_overload_through_handle_and_proxy(serve_cluster):
     """Deadline + shedding end to end: handle timeout_s propagates into
@@ -354,6 +355,7 @@ def test_deadline_and_overload_through_handle_and_proxy(serve_cluster):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow  # 24 s: replica kill + reroute + heal
 @pytest.mark.timeout_s(300)
 def test_kill_replica_mid_decode_requests_reroute_and_heal(serve_cluster):
     """SIGKILL one of two decode replicas while non-streaming requests

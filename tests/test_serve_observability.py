@@ -422,6 +422,7 @@ def test_trace_context_survives_router_retry(ray_start_regular):
 # ------------------------------- proxy /metrics + status slo (e2e)
 
 
+@pytest.mark.slow  # 7s: full proxy metrics sweep; PR 16 rebudget
 def test_proxy_metrics_route_and_status_slo(ray_start_regular):
     """One decode deployment behind the real HTTP proxy: /metrics
     serves Prometheus text with per-deployment TTFT and inter-token
