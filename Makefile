@@ -4,7 +4,8 @@
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
 	bench-paged bench-sharded bench-trace trace-demo bench-rl-dist \
-	bench-obs bench-chaos bench-gang bench-pipeline bench-spec
+	bench-obs bench-chaos bench-gang bench-pipeline bench-spec \
+	bench-disagg
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
 # strict 9-family run WITH the stats.json refresh folded in (one
@@ -60,6 +61,14 @@ bench-sharded:
 # delta -> BENCH_SERVE.json. CPU-host caveats: BENCH_NOTES.md.
 bench-spec:
 	python bench_decode.py --sections spec $(BENCH_ARGS)
+
+# Disaggregated prefill/decode rows (ISSUE 17): mixed-length TTFT p99 +
+# inter-token p99 vs the colocated fleet, handoff descriptor bytes +
+# publish->adopt latency, and pages_leaked=0 under prefill-replica
+# SIGKILL churn -> BENCH_SERVE.json, merge-preserving. CPU-host rows
+# measure the splice mechanism, not speedup (BENCH_NOTES.md).
+bench-disagg:
+	python bench_serve.py --sections disagg $(BENCH_ARGS)
 
 # Tracing/metrics overhead on the decode step loop (instrumented vs
 # stripped engine; acceptance bar <2%) -> BENCH_SERVE.json.

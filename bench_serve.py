@@ -139,6 +139,204 @@ def closed_loop(handle, seq, n_clients: int, duration_s: float):
     return lats, wall
 
 
+def _stream_lats(handle, prompts, n_reqs: int, max_new: int):
+    """Sequential streamed requests over a mixed-length prompt cycle:
+    client-side TTFT (submit -> first item) and inter-token gaps —
+    the same stopwatch for the colocated and disaggregated paths, so
+    the comparison is methodology-clean."""
+    ttfts, gaps = [], []
+    for i in range(n_reqs):
+        prompt = prompts[i % len(prompts)]
+        t0 = time.perf_counter()
+        first = last = None
+        n_items = 0
+        for _tok in handle.stream({"tokens": prompt, "stream": True,
+                                   "max_new_tokens": max_new}):
+            last = time.perf_counter()
+            if first is None:
+                first = last
+                ttfts.append(first - t0)
+            n_items += 1
+        # Per-request inter-token = (finish - first) / (tokens - 1):
+        # raw item-to-item gaps are bursty under chunked emission (the
+        # engine's own serve_inter_token_s doctrine, metrics.py).
+        if n_items > 1:
+            gaps.append((last - first) / (n_items - 1))
+    return ttfts, gaps
+
+
+def bench_disagg(args, serve) -> list:
+    """Disaggregated prefill/decode rows (ROADMAP #3): mixed-length
+    TTFT/inter-token p99 vs the colocated fleet, the handoff
+    descriptor's wire size and publish->adopt latency from the
+    production histograms, and the zero-leak soak under prefill-replica
+    churn. CPU-host rows measure the MECHANISM (splice overhead,
+    descriptor size, leak accounting); speedup claims wait for the rig
+    (BENCH_NOTES.md)."""
+    import ray_tpu
+    from ray_tpu.serve.decode import LlamaDecodeDeployment
+    from ray_tpu.serve.deployment import _Router
+    from ray_tpu.serve.handoff import HANDOFF_DESC_BYTE_BUDGET
+
+    rows = []
+    n_reqs = 9 if args.quick else 30
+    max_new = 16
+    prompts = [list(range(1, 1 + n)) for n in (16, 96, 160)]
+    kw = dict(preset="debug", slots=4, capacity=256, kv_page_tokens=16,
+              prefill_chunk_tokens=64, prefix_pool_entries=0)
+
+    # num_cpus=0: four CPU-host replicas must co-schedule even on a
+    # 1-core box (the node's default CPU resource is os.cpu_count();
+    # replicas defaulting to 1 CPU each would otherwise churn through
+    # spawn/kill cycles fighting for the single slot).
+    opts = dict(max_ongoing_requests=8,
+                ray_actor_options={"num_cpus": 0})
+    serve.run(serve.deployment(
+        LlamaDecodeDeployment, role="decode",
+        **opts).bind(**kw), name="dz-decode")
+    serve.run(serve.deployment(
+        LlamaDecodeDeployment, role="prefill",
+        decode_deployment="dz-decode", num_replicas=2,
+        **opts).bind(**kw), name="dz-prefill")
+    serve.run(serve.deployment(
+        LlamaDecodeDeployment, **opts).bind(**kw), name="dz-coloc")
+    disagg = serve.get_deployment_handle("dz-prefill")
+    coloc = serve.get_deployment_handle("dz-coloc")
+    for h in (disagg, coloc):  # compile + snapshot warmup, unmeasured
+        for p in prompts:
+            h.remote({"tokens": p, "max_new_tokens": 2}).result(
+                timeout=600)
+        # Warm the STREAMED splice too (stream_adopted is a different
+        # replica method than decode_adopted): without this the first
+        # measured stream pays one-time costs and p99 reports setup,
+        # not steady state.
+        _stream_lats(h, prompts, len(prompts), max_new)
+
+    c_ttft, _ = _stream_lats(coloc, prompts, n_reqs, max_new)
+    d_ttft, _ = _stream_lats(disagg, prompts, n_reqs, max_new)
+    mix = "/".join(str(len(p)) for p in prompts)
+    rows.append({
+        "metric": "disagg_ttft_p99",
+        "value": round(pctl(d_ttft, 0.99) * 1000, 1), "unit": "ms",
+        "note": f"streamed submit->first-token over prompt mix {mix} "
+                f"({n_reqs} reqs); colocated fleet p99="
+                f"{pctl(c_ttft, 0.99) * 1000:.1f}ms p50="
+                f"{pctl(c_ttft, 0.5) * 1000:.1f}ms, disagg p50="
+                f"{pctl(d_ttft, 0.5) * 1000:.1f}ms — disagg TTFT "
+                f"carries the KV-page handoff (publish + object-plane "
+                f"fetch + adopt scatter)",
+    })
+    # Inter-token from the ENGINE's per-request histogram (the serve
+    # stream path delivers items in bursts, so a client stopwatch can't
+    # see decode cadence): disagg requests decode on dz-decode, the
+    # baseline on dz-coloc.
+    deadline = time.monotonic() + 60
+    d_it = c_it = {}
+    while time.monotonic() < deadline:
+        st = serve.status()
+        d_it = st.get("dz-decode", {}).get("slo", {}).get(
+            "inter_token_s", {})
+        c_it = st.get("dz-coloc", {}).get("slo", {}).get(
+            "inter_token_s", {})
+        if (d_it.get("count", 0) >= n_reqs
+                and c_it.get("count", 0) >= n_reqs):
+            break  # the measured traffic has flushed, not just warmup
+        time.sleep(0.5)
+    rows.append({
+        "metric": "disagg_inter_token_p99",
+        "value": round((d_it.get("p99") or 0) * 1000, 2), "unit": "ms",
+        "note": f"engine-side serve_inter_token_s p99 on the decode "
+                f"fleet (count={d_it.get('count')}, p50="
+                f"{(d_it.get('p50') or 0) * 1000:.2f}ms); colocated "
+                f"fleet p99={(c_it.get('p99') or 0) * 1000:.2f}ms p50="
+                f"{(c_it.get('p50') or 0) * 1000:.2f}ms — decode steps "
+                f"are the same program either way, so the gap measures "
+                f"the decode fleet's isolation from prefill "
+                f"interference",
+    })
+
+    # Handoff wire accounting from the production instruments (same
+    # source as /metrics): descriptor bytes must stay RPC-header-sized.
+    deadline = time.monotonic() + 60
+    slo = {}
+    while time.monotonic() < deadline:
+        slo = serve.status().get("dz-prefill", {}).get("slo", {})
+        if slo.get("handoff_bytes", {}).get("count") \
+                and slo.get("handoff_latency_s", {}).get("count"):
+            break
+        time.sleep(0.5)
+    bytes_p99 = slo.get("handoff_bytes", {}).get("p99")
+    assert bytes_p99 is not None and bytes_p99 <= HANDOFF_DESC_BYTE_BUDGET, \
+        f"handoff descriptor p99 {bytes_p99} over " \
+        f"{HANDOFF_DESC_BYTE_BUDGET}B budget"
+    rows.append({
+        "metric": "disagg_handoff_desc_bytes_p99",
+        "value": round(bytes_p99, 0), "unit": "bytes",
+        "note": f"pickled descriptor (refs + block geometry, never KV "
+                f"payload) from serve_handoff_bytes; budget "
+                f"{HANDOFF_DESC_BYTE_BUDGET}B — page payloads ride the "
+                f"object plane by reference",
+    })
+    lat = slo.get("handoff_latency_s", {})
+    rows.append({
+        "metric": "disagg_handoff_latency_p50",
+        "value": round((lat.get("p50") or 0) * 1000, 1), "unit": "ms",
+        "note": f"publish->adopt-ack from serve_handoff_latency_s "
+                f"(p99={(lat.get('p99') or 0) * 1000:.1f}ms, "
+                f"count={lat.get('count')}): the window pages live as "
+                f"host blobs between the fleets",
+    })
+
+    # Zero-leak soak under replica churn: SIGKILL one of two prefill
+    # replicas mid-traffic, keep requesting, then audit every pool.
+    router = _Router.get("dz-prefill")
+    with router._lock:
+        victim = router._replicas[0]["handle"]
+    ray_tpu.kill(victim, no_restart=True)
+    served = 0
+    deadline = time.monotonic() + 120
+    while served < (4 if args.quick else 12) \
+            and time.monotonic() < deadline:
+        try:
+            disagg.remote({"tokens": prompts[served % len(prompts)],
+                           "max_new_tokens": 8}).result(timeout=60)
+            served += 1
+        except Exception:
+            time.sleep(0.5)  # mid-respawn; the router heals
+    leaked = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        leaked = 0
+        for name in ("dz-prefill", "dz-decode", "dz-coloc"):
+            r = _Router.get(name)
+            with r._lock:
+                handles = [rep["handle"] for rep in r._replicas]
+            for h in handles:
+                try:
+                    s = ray_tpu.get(h.stats.remote(), timeout=10)
+                except Exception:
+                    continue  # dead/respawning replica holds no pages
+                leaked += int(s.get("pages_in_use", 0) or 0)
+                leaked += int(s.get("handoffs_live", 0) or 0)
+        if leaked == 0:
+            break
+        time.sleep(1.0)
+    rows.append({
+        "metric": "disagg_pages_leaked",
+        "value": leaked, "unit": "pages+leases",
+        "note": f"pages_in_use + live handoff leases across all three "
+                f"fleets after {served} requests with a prefill-replica "
+                f"SIGKILL mid-run (killed replica's refs die with the "
+                f"owner; survivors' leases adopt-ack or abort) — must "
+                f"be 0",
+    })
+    for name in ("dz-prefill", "dz-decode", "dz-coloc"):
+        serve.delete(name)
+    for r in rows:  # this section runs the debug preset, not args.model
+        r["note"] += "; debug model, cpu backend (nearest-rank pctl)"
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -150,7 +348,12 @@ def main() -> None:
         "--model", default="160m",
         help="llama preset for the serving rows (the 160m default needs "
              "the rig; CPU re-measures use debug)")
+    ap.add_argument(
+        "--sections", default="serve,autoscale",
+        help="comma list of sections to run: serve (throughput/latency/"
+             "http), autoscale, disagg (prefill/decode handoff rows)")
     args = ap.parse_args()
+    sections = {s.strip() for s in args.sections.split(",") if s.strip()}
     duration = 10.0 if args.quick else 30.0
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -161,6 +364,18 @@ def main() -> None:
     ray_tpu.init()
     rows = []
 
+    if "disagg" in sections:
+        rows += bench_disagg(args, serve)
+    if "serve" in sections:
+        rows += bench_serve_path(args, serve, duration)
+    if "autoscale" in sections:
+        rows += bench_autoscale(args, serve)
+    serve.shutdown()
+    _write(rows, args)
+
+
+def bench_serve_path(args, serve, duration) -> list:
+    rows = []
     # ---- 1+2: handle-path throughput + latency on the TPU replica
     LlamaServer = llama_deployment(serve, cpu=args.cpu,
                                    model=args.model)
@@ -230,7 +445,11 @@ def main() -> None:
                     f"proxy histogram never flushed — fallback)",
         })
     serve.delete("llama")
+    return rows
 
+
+def bench_autoscale(args, serve) -> list:
+    rows = []
     # ---- 4: autoscale-up-under-load (CPU replicas; one chip = one TPU
     # replica, so the scaling mechanism is shown on the CPU pool)
     @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
@@ -275,12 +494,15 @@ def main() -> None:
                 f"{peak} replicas ({ {k: round(v, 1) for k, v in sorted(scale_times.items())} }); "
                 f"CPU replicas — single chip hosts one TPU replica",
     })
-    serve.shutdown()
+    return rows
 
+
+def _write(rows, args) -> None:
     if args.cpu:
         for r in rows:
-            r["note"] += (f"; {args.model} model, cpu backend "
-                          f"(nearest-rank pctl)")
+            if "cpu backend" not in r["note"]:  # disagg rows self-tag
+                r["note"] += (f"; {args.model} model, cpu backend "
+                              f"(nearest-rank pctl)")
     out = {
         "artifact": "BENCH_SERVE",
         "model": f"llama-{args.model} prefill, seq 128, bf32 defaults",

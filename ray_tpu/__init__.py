@@ -16,6 +16,7 @@ from ray_tpu._version import version as __version__  # noqa: F401
 from ray_tpu.core.api import (  # noqa: F401
     available_resources,
     cluster_resources,
+    free,
     get,
     get_actor,
     init,

@@ -237,6 +237,16 @@ RESOURCE_METHOD_PAIRS = {
     # activation tensor cluster-wide, the serve ``_add_replica`` leak
     # shape for ObjectRefs.
     "borrow_ref": "drop_ref",
+    # Disaggregated-serving KV-page handoff (serve/handoff.py
+    # HandoffLedger): ``self._handoffs.publish_handoff(desc)`` opens a
+    # lease over the prefill replica's filled KV pages (pinned in the
+    # object store by the descriptor's refs); every escaping exception
+    # must discharge it (``discharge_handoff`` — reached via the
+    # _drop_handoff self-callee on the adopt-ack/abort/expiry paths) or
+    # the pages stay pinned until the TTL sweep. A lease surviving a
+    # NORMAL exit is the design: the returned descriptor transfers the
+    # discharge obligation to the router splice.
+    "publish_handoff": "discharge_handoff",
 }
 # Slot-pool attributes: ``self._free.pop()`` leases a slot that
 # ``self._free.append(slot)`` returns (DecodeEngine slot discipline);

@@ -230,6 +230,39 @@ def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
     return get_core_worker().wait(refs, num_returns, timeout)
 
 
+def free(refs) -> None:
+    """Eagerly release the object-store entries behind ``refs``
+    (reference: ``ray._private.internal_api.free``). Owner-local refs
+    free synchronously; remote owners get a best-effort ``free_object``
+    notify — an unreachable owner is usually a DEAD owner, whose
+    objects already died with it (the ref tracker abandons deltas to
+    undialable owners), so the miss is not a leak.
+
+    This is the fast path the serve plane's KV-page handoff uses to
+    drop multi-MB page payloads within one engine step of the adopt /
+    abort decision, instead of waiting out the distributed ref
+    tracker's ``ref_free_grace_s`` sweep."""
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    core = get_core_worker()
+    for ref in refs:
+        if ref is None:
+            continue
+        if ref.owner_addr in (None, core.addr):
+            core.free_object(ref.id)
+        else:
+            try:
+                core.clients.get(ref.owner_addr).notify(
+                    "free_object", ref.id.binary())
+            except Exception:  # noqa: BLE001 — dead owner == already freed
+                from ray_tpu.util.ratelimit import log_every
+
+                log_every("api.free", 30.0, __import__("logging")
+                          .getLogger(__name__),
+                          "remote free_object notify failed",
+                          exc_info=True)
+
+
 def kill(actor_handle, no_restart: bool = True) -> None:
     client = _client()
     if client is not None:

@@ -457,6 +457,16 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "replaced and their reservations queued for release. Bounds "
         "control-plane MTTR: snapshots republish right after this "
         "window at the latest."),
+    "serve_handoff_ttl_s": (float, 60.0,
+        "How long a prefill replica's handoff ledger keeps a published "
+        "KV-page handoff (object-plane refs + descriptor) that nobody "
+        "discharged. The router discharges on adopt-ack or abort; this "
+        "TTL only catches a router that died mid-splice — the sweep "
+        "(driven by the controller's reconcile stats pull) frees the "
+        "expired refs so an orphaned handoff can never pin its page "
+        "payload past the window. Must exceed the worst-case publish->"
+        "adopt gap (seconds); expiry after a successful adopt is "
+        "harmless (the decode replica already fetched the bytes)."),
     "serve_mttr_bound_s": (float, 30.0,
         "Acceptance bound on serve control-plane MTTR: controller "
         "death -> routing snapshots flowing again (epoch-bumped "

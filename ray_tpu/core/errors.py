@@ -129,3 +129,14 @@ class OverloadedError(RayTpuError):
     def __reduce__(self):
         return (OverloadedError, (self.args[0] if self.args else
                                   "server overloaded", self.retry_after_s))
+
+
+class HandoffAdoptError(RayTpuError):
+    """A decode replica could not adopt a published KV-page handoff
+    (page-geometry mismatch, payload shape that does not fit the pool,
+    or the page payload refs were already gone).
+
+    Raised by ``DecodeEngine.submit(adopt=...)`` validation and shipped
+    back through the actor-call error path; the router treats it as
+    "this splice cannot work" and falls back to the colocated path
+    after aborting the prefill side's lease."""

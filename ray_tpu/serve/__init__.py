@@ -1,4 +1,10 @@
-"""ray_tpu.serve: model serving on actors (reference: Ray Serve)."""
+"""ray_tpu.serve: model serving on actors (reference: Ray Serve).
+
+Deployments default to the colocated posture (every replica prefills
+and decodes). Pass ``role="prefill"`` / ``role="decode"`` to
+``serve.deployment`` to split the two phases onto separate fleets with
+KV pages handed off over the object plane — see docs/SERVING.md
+"Disaggregated prefill/decode"."""
 
 from ray_tpu.serve.api import (  # noqa: F401
     delete,

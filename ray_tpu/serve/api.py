@@ -87,13 +87,17 @@ def _degraded_status() -> Dict[str, Any]:
 
 def status(timeout: float = 30.0, include_slo: bool = True
            ) -> Dict[str, Any]:
-    """Per-deployment control-plane state, plus (``include_slo``) the
-    SLO DISTRIBUTIONS from the metrics pipeline: each deployment gains
+    """Per-deployment control-plane state — replica count, autoscale
+    load, page-pool health, disaggregation posture (``role``,
+    ``decode_deployment``, live handoff leases ``handoffs_live`` /
+    ``handoff_live_bytes``) — plus (``include_slo``) the SLO
+    DISTRIBUTIONS from the metrics pipeline: each deployment gains
     an ``slo`` dict with TTFT / inter-token / queue-wait / HTTP-latency
-    histogram summaries (count, mean, p50, p99) and outcome counters —
-    the same numbers the dashboard serve panel and the proxy's
-    ``/metrics`` route report, because all three read the controller's
-    aggregated registry through ``serve.metrics.slo_summary``.
+    / handoff histogram summaries (count, mean, p50, p99), outcome
+    counters and handoff lease-event counters — the same numbers the
+    dashboard serve panel and the proxy's ``/metrics`` route report,
+    because all three read the controller's aggregated registry
+    through ``serve.metrics.slo_summary``.
 
     FAILS SOFT during a controller outage: when the controller actor is
     dead or restarting, the call returns this process's cached routing

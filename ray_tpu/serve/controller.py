@@ -38,6 +38,45 @@ STATE_KEY = "serve:controller:state"
 EPOCH_NAME = "serve_controller"
 
 
+def autoscale_load(stats: Dict[str, Any]) -> float:
+    """One replica's autoscaler load signal from its reported stats.
+
+    Base signal: ``max(ongoing, load)`` — HTTP concurrency vs the
+    engine's own backlog (slots + queue + prefill backlog), whichever
+    is worse.
+
+    Speculative replicas would OVER-report headroom from that alone: a
+    spec engine's slots complete requests ``(1 + accept_rate * k)``
+    tokens per step instead of 1, so the same backlog clears faster at
+    high acceptance — but at LOW acceptance each slot still pays the
+    (k+1)-token verify forward per emitted token, and a draft pool
+    under pressure keeps new seats draftless (no speedup at full spec
+    cost). Scale the signal by the spec slowdown factor
+    ``(k + 1) / (1 + accept_rate * k)`` (1.0 at perfect acceptance =
+    the engine really does have spec-sized headroom; (k+1) at zero
+    acceptance = every slot is doing verify work for nothing), plus a
+    draft-pool-pressure bump when the pool is nearly exhausted."""
+    load = float(max(stats.get("ongoing", 0) or 0,
+                     stats.get("load", 0) or 0))
+    spec = stats.get("spec")
+    if not isinstance(spec, dict):
+        return load
+    k = float(spec.get("k", 0) or 0)
+    if k <= 0:
+        return load
+    accept = spec.get("accept_rate")
+    accept = 0.0 if accept is None else min(1.0, max(0.0, float(accept)))
+    load *= (k + 1.0) / (1.0 + accept * k)
+    total = float(spec.get("draft_pages_total", 0) or 0)
+    if total > 0:
+        occupancy = 1.0 - float(spec.get("draft_pages_free", 0)) / total
+        if occupancy > 0.75:
+            # Draft pool nearly dry: new admissions seat draftless and
+            # decode at 1 token/step while paying spec overheads.
+            load *= 1.0 + (occupancy - 0.75)
+    return load
+
+
 class ReplicaRecord:
     def __init__(self, handle, replica_id: str,
                  sub_slice: Optional[Dict[str, Any]] = None):
@@ -484,7 +523,8 @@ class ServeController:
                     init_kwargs["mesh_shape"] = tuple(mesh_shape)
             handle = actor_cls.options(**opts).remote(
                 rec.cls_blob, rec.init_args, init_kwargs,
-                replica_id=replica_id, owner_epoch=self._epoch)
+                replica_id=replica_id, owner_epoch=self._epoch,
+                role=rec.cfg.get("role") or "")
         except Exception:
             if sub is not None:
                 self._release_reservation(sub["reservation_id"],
@@ -635,6 +675,11 @@ class ServeController:
                 for r in rec.replicas],
             "max_ongoing_requests": rec.cfg.get("max_ongoing_requests", 8),
             "deleted": rec.deleting,
+            # Disaggregated posture: a role="prefill" deployment's
+            # routers splice requests to decode_deployment's fleet.
+            # Unset reads as colocated — the legacy path, byte-for-byte.
+            "role": rec.cfg.get("role") or "colocated",
+            "decode_deployment": rec.cfg.get("decode_deployment"),
         }
         try:
             # min_version keeps subscriber clocks monotonic across a hub
@@ -662,6 +707,19 @@ class ServeController:
                 name: {
                     "replicas": len(rec.replicas),
                     "replica_ids": [r.replica_id for r in rec.replicas],
+                    # Disaggregated posture (colocated = legacy).
+                    "role": rec.cfg.get("role") or "colocated",
+                    "decode_deployment": rec.cfg.get(
+                        "decode_deployment"),
+                    # Handoff-lease health, summed: live (undischarged)
+                    # handoffs and the payload bytes they pin. Nonzero
+                    # at steady state means a leaking splice path.
+                    "handoffs_live": sum(
+                        r.last_stats.get("handoffs_live", 0)
+                        for r in rec.replicas),
+                    "handoff_live_bytes": sum(
+                        r.last_stats.get("handoff_live_bytes", 0)
+                        for r in rec.replicas),
                     "ongoing": sum(
                         r.last_stats.get("ongoing", 0)
                         for r in rec.replicas),
@@ -708,6 +766,7 @@ class ServeController:
                         for r in rec.replicas),
                     "replica_topology": [
                         {"replica_id": r.replica_id,
+                         "role": rec.cfg.get("role") or "colocated",
                          "mesh_shape": r.last_stats.get("mesh_shape"),
                          "chips": r.last_stats.get(
                              "chips",
@@ -1122,9 +1181,11 @@ class ServeController:
                 # Replica load = max(HTTP concurrency, replica-reported
                 # backlog): a decode engine with a full pending queue and
                 # every slot busy must scale OUT even when each request
-                # occupies only one "ongoing" call slot.
-                ongoing = sum(max(r.last_stats.get("ongoing", 0),
-                                  r.last_stats.get("load", 0))
+                # occupies only one "ongoing" call slot. autoscale_load
+                # additionally inflates speculative replicas' signal by
+                # their verify overhead at the observed accept rate, so
+                # spec engines don't over-report headroom.
+                ongoing = sum(autoscale_load(r.last_stats)
                               for r in rec.replicas)
                 # A mesh-parallel replica is chips-many units of
                 # capacity, not one: load per CHIP drives the count, so

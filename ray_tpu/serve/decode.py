@@ -94,6 +94,14 @@ class _Request:
     prompt_len: int = 0       # ORIGINAL prompt length (tokens grows when
     #   a preempted request re-queues with its emitted tokens absorbed)
     prefilled: int = 0        # prompt tokens prefilled so far (chunked)
+    # ------------------------------------------- disaggregated handoff
+    prefill_only: bool = False  # terminal = filled pages, not tokens: the
+    #   request ends at first-token with its KV pages gathered to host
+    #   as the handoff payload instead of entering the decode loop
+    handoff: Optional[Dict[str, Any]] = None  # prefill_only result: k/v
+    #   page payloads + committed_len + first_token + page geometry
+    adopt: Optional[Dict[str, Any]] = None    # decode-side twin: payload
+    #   to scatter into this engine's pool at admission (zero recompute)
     # --------------------------------------------------- request lifecycle
     request_id: str = ""
     deadline: Optional[float] = None       # absolute monotonic; None = none
@@ -437,6 +445,16 @@ class DecodeEngine:
             self._decode = self._mesh_scoped(jax.jit(
                 self._paged_decode_impl, donate_argnums=(1,),
                 **cache_out))
+            # Disaggregated adopt: scatter handed-off page payloads into
+            # the pool (pure data movement, no model math) and park the
+            # slot cursor at the committed length. Cache-only output, so
+            # mesh engines pin just the cache sharding (the
+            # draft_cache_only precedent below).
+            self._adopt_pages = self._mesh_scoped(jax.jit(
+                self._adopt_pages_impl, static_argnames=("width",),
+                donate_argnums=(0,),
+                **({"out_shardings": self._cache_sharding}
+                   if self.mesh is not None else {})))
         else:
             self._prefill_many = self._mesh_scoped(jax.jit(
                 self._prefill_many_impl, static_argnames=("n", "bucket"),
@@ -523,6 +541,12 @@ class DecodeEngine:
             if step_timeline is None else step_timeline)
         self._compiled: set = set()  # program keys dispatched once
         self._prefill_waves = 0      # prefill programs dispatched
+        # Disaggregated handoff accounting (engine side; the per-replica
+        # lease ledger lives on the deployment wrapper).
+        self.handoffs_published = 0  # prefill_only captures completed
+        self.handoffs_adopted = 0    # adopted seats completed
+        self._handoff_phases: List[Dict[str, Any]] = []  # pending steplog
+        #   phase rows, drained into the next _steplog_row
 
     def _mesh_scoped(self, fn, rules=None):
         """Mesh engines trace every program inside the decode axis-rules
@@ -647,6 +671,20 @@ class DecodeEngine:
         logits, pool, lens = self._ld.paged_decode_step(
             params, pool, bt, cache["length"], tokens, self.config)
         return logits, {"k": pool["k"], "v": pool["v"], "length": lens}
+
+    def _adopt_pages_impl(self, cache, k_pages, v_pages, ids, slot_ids,
+                          lengths, width):
+        """Adopt a handed-off prefill: scatter ``width`` page payloads
+        into the pool at ``ids`` and park the slot cursor at the
+        committed length. Pure data movement — no model math — so the
+        adopted state is bit-identical to having prefilled locally.
+        Pad columns target the scratch page (id 0, never read) with
+        zero payloads; ``width`` is the pow-2 compile bucket."""
+        return {
+            "k": cache["k"].at[:, ids].set(k_pages),
+            "v": cache["v"].at[:, ids].set(v_pages),
+            "length": cache["length"].at[slot_ids].set(lengths),
+        }
 
     def _paged_decode_chunk_impl(self, params, cache, tokens, bt, k):
         pool = {"k": cache["k"], "v": cache["v"]}
@@ -936,12 +974,21 @@ class DecodeEngine:
                temperature: float = 0.0, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> _Request:
+               request_id: Optional[str] = None,
+               prefill_only: bool = False,
+               adopt: Optional[Dict[str, Any]] = None) -> _Request:
         req = _Request(np.asarray(prompt_tokens, np.int32).reshape(-1),
                        int(max_new_tokens), float(temperature), eos_id,
                        on_token)
         req.request_id = request_id or f"req-{next(_req_ids)}"
         req.prompt_len = len(req.tokens)
+        if prefill_only and not self.paged:
+            raise ValueError("prefill_only handoff requires a paged "
+                             "engine (kv_page_tokens > 0)")
+        req.prefill_only = bool(prefill_only)
+        if adopt is not None:
+            self._validate_adopt(req, adopt)
+            req.adopt = dict(adopt)
         if self.paged and self._seq_pages(
                 len(req.tokens) + req.max_new_tokens) > self.pool_pages:
             # A request no amount of preemption can seat must fail fast,
@@ -1010,6 +1057,34 @@ class DecodeEngine:
         self._pending.put(req)
         self._work.set()
         return req
+
+    def _validate_adopt(self, req: _Request,
+                        adopt: Dict[str, Any]) -> None:
+        """Reject a handoff this pool cannot splice BEFORE enqueue, as
+        the typed error the router maps to its colocated fallback. The
+        payload must have been gathered from a pool with identical page
+        geometry and head layout, and must cover exactly the prompt."""
+        from ray_tpu.core.errors import HandoffAdoptError
+
+        if not self.paged:
+            raise HandoffAdoptError(
+                "adopt requires a paged engine (kv_page_tokens > 0)")
+        if int(adopt["page_tokens"]) != self.page_tokens:
+            raise HandoffAdoptError(
+                f"handoff page_tokens ({adopt['page_tokens']}) != this "
+                f"engine's ({self.page_tokens}); pages cannot splice")
+        if int(adopt["committed_len"]) != len(req.tokens):
+            raise HandoffAdoptError(
+                f"handoff committed_len ({adopt['committed_len']}) != "
+                f"prompt length ({len(req.tokens)})")
+        k = adopt["k"]
+        pool = self.cache["k"].shape  # (L, pages+1, T, KV, D)
+        if (k.ndim != 5 or k.shape[0] != pool[0]
+                or tuple(k.shape[2:]) != tuple(pool[2:])
+                or k.shape[1] != self._seq_pages(len(req.tokens))):
+            raise HandoffAdoptError(
+                f"handoff payload shape {tuple(k.shape)} does not fit "
+                f"this engine's pool {tuple(pool)}")
 
     def retry_after_estimate_s(self) -> float:
         """How long a shed caller should wait before retrying, from the
@@ -1212,6 +1287,21 @@ class DecodeEngine:
         suffix_group: List[_Request] = []
         seated: List[_Request] = []
         for i, req in enumerate(live):
+            if req.adopt is not None:
+                # Disaggregated adopt: the prompt's KV already exists as
+                # a handed-off page payload — scatter it in, no model
+                # math, no prefix match (the adopted pages ARE the
+                # prompt; they get inserted into the prefix index so
+                # later prompts can splice them).
+                if not self._seat_adopted(req):
+                    rest = live[i:]
+                    for r in reversed(rest):
+                        with self._reqs_lock:
+                            r.admitted = False
+                        self._requeue.insert(0, r)
+                        self._queued_tokens += len(r.tokens)
+                    break
+                continue
             m = (self.prefix.match(req.tokens)
                  if self.prefix is not None else None)
             if m is not None:
@@ -1265,6 +1355,80 @@ class DecodeEngine:
         self._admit_paged_full(full_group)
         self._admit_paged_suffix(suffix_group)
         return not self._requeue
+
+    def _seat_adopted(self, req: _Request) -> bool:
+        """Seat one adopted (handed-off) request: allocate pages for the
+        committed prompt, scatter the payload in with the jitted adopt
+        program, and emit the handoff's first token — the request enters
+        the decode loop exactly as if this engine had prefilled it.
+        Returns False when the pool is dry (caller requeues; the adopt
+        payload stays on the request for the retry)."""
+        import jax.numpy as jnp
+
+        adopt = req.adopt
+        clen = int(adopt["committed_len"])
+        pages = self._alloc_pages(self._seq_pages(clen))
+        if pages is None:
+            return False
+        slot = self._free.pop()
+        req.slot = slot  # ownership on the request before any fallible
+        #   call: a raise must not strand the pages
+        self._set_slot_pages(slot, pages)
+        req.prefix_pages, req.prefix_len = [], 0
+        req.prefilled = clen
+        # Pow-2 page-count bucket: one compiled adopt program per width,
+        # pad columns scatter zero payloads into the scratch page.
+        width = 1
+        while width < len(pages):
+            width *= 2
+        ids = np.zeros((width,), np.int32)
+        ids[:len(pages)] = pages
+        L = self.cache["k"].shape[0]
+        tail = self.cache["k"].shape[2:]
+        k_pad = np.zeros((L, width) + tuple(tail), adopt["k"].dtype)
+        v_pad = np.zeros((L, width) + tuple(tail), adopt["v"].dtype)
+        k_pad[:, :len(pages)] = adopt["k"]
+        v_pad[:, :len(pages)] = adopt["v"]
+        t0 = time.time()
+        self.cache = self._dispatch_fresh(
+            ("adopt_pages", width),
+            lambda: self._adopt_pages(
+                self.cache, jnp.asarray(k_pad), jnp.asarray(v_pad),
+                jnp.asarray(ids), jnp.asarray([slot], np.int32),
+                jnp.asarray([clen], np.int32), width=width))
+        self._wave_span("adopt", t0, [req], pages=len(pages))
+        if self.steplog.enabled:
+            self.steplog.event("handoff-adopt", slot=slot,
+                               pages=len(pages), committed=clen)
+            self._handoff_phases.append(
+                {"phase": "handoff", "t0": t0, "t1": time.time(),
+                 "slot": slot, "pages": len(pages)})
+        self._mark_admitted([req])
+        self._post_adopt(req, slot)
+        return True
+
+    def _post_adopt(self, req: _Request, slot: int) -> None:
+        """Adopted twin of _post_admit's per-request tail: prefix-index
+        insert first (the adopted pages hold the full prompt, so later
+        prompts sharing it splice against THIS replica), then emit the
+        handoff's first token and enter the decode loop."""
+        if self.prefix is not None:
+            self.prefix.insert(req.tokens, self._slot_pages[slot],
+                               matched_len=0)
+        now = time.monotonic()
+        self._tokens_dev = None
+        tok = int(req.adopt["first_token"])
+        req.first_token_at = now
+        self._emit(req, tok)
+        self._tokens[slot] = tok
+        self._active[slot] = req
+        self.handoffs_adopted += 1
+        req.adopt = None  # drop the multi-MB payload promptly
+        if req.generated >= req.max_new_tokens or (
+                req.eos_id is not None and tok == req.eos_id):
+            self._finish(slot)
+        elif self.spec:
+            self._draft_seat([req])
 
     def _admit_paged_full(self, reqs: List[_Request]) -> None:
         import jax.numpy as jnp
@@ -1587,6 +1751,16 @@ class DecodeEngine:
             tok = self._sample_host(logits[i], req)
             req.slot = slots[i]
             req.first_token_at = now
+            if req.prefill_only:
+                # Disaggregated prefill terminal: the deliverable is the
+                # slot's filled pages + the sampled first token, not an
+                # emitted stream. Gather to host, then finish the slot —
+                # its device pages free immediately (the prefix insert
+                # above already pinned the shareable ones).
+                self._capture_handoff(req, slots[i], tok)
+                self._active[slots[i]] = req
+                self._finish(slots[i])
+                continue
             self._emit(req, tok)
             self._tokens[slots[i]] = tok
             self._active[slots[i]] = req
@@ -1609,6 +1783,34 @@ class DecodeEngine:
                         slot, row)
         if self.spec:
             self._draft_seat([r for r in group if not r.done.is_set()])
+
+    def _capture_handoff(self, req: _Request, slot: int,
+                         first_token: int) -> None:
+        """Prefill-only terminal: gather the slot's filled pages to host
+        as the handoff payload. The gather is a pure device->host copy
+        of page payloads (no model math), so an adopting engine's state
+        is bit-identical to having prefilled there. The first sampled
+        token rides the descriptor instead of being emitted here — the
+        decode side emits it, keeping the client-visible stream
+        identical to the colocated path."""
+        t0 = time.time()
+        ids = np.asarray(self._slot_pages[slot], np.int32)
+        k = np.asarray(self.cache["k"][:, ids])
+        v = np.asarray(self.cache["v"][:, ids])
+        req.handoff = {
+            "k": k, "v": v,
+            "committed_len": int(req.prompt_len),
+            "first_token": int(first_token),
+            "page_tokens": self.page_tokens,
+            "nbytes": int(k.nbytes + v.nbytes),
+        }
+        self.handoffs_published += 1
+        if self.steplog.enabled:
+            self.steplog.event("handoff", slot=slot, pages=len(ids),
+                               nbytes=req.handoff["nbytes"])
+            self._handoff_phases.append(
+                {"phase": "handoff", "t0": t0, "t1": time.time(),
+                 "slot": slot, "pages": int(len(ids))})
 
     def _draft_seat(self, reqs: List[_Request]) -> None:
         """Give each freshly-admitted slot its draft-side state: draft
@@ -2122,6 +2324,12 @@ class DecodeEngine:
         """Close the step's timeline row; idle steps with no phases and
         no pending events record nothing (an idle engine must not churn
         useful rows out of the bounded ring)."""
+        if self._handoff_phases:
+            # Handoff gathers/adopts happen inside admission helpers that
+            # don't see the step's phases list; merge them here so the
+            # row shows the handoff slice of the step.
+            phases = phases + self._handoff_phases
+            self._handoff_phases = []
         if not self.steplog.enabled or not (phases
                                             or self.steplog.pending_events):
             return
@@ -2287,6 +2495,8 @@ class DecodeEngine:
             out["pages_pinned"] = (self.prefix.pinned_pages
                                    if self.prefix is not None else 0)
             out["kv_fragmentation"] = self._fragmentation()
+            out["handoffs_published"] = self.handoffs_published
+            out["handoffs_adopted"] = self.handoffs_adopted
         if self.spec:
             # Fleet-visible acceptance: proposed/accepted feed the same
             # counters Prometheus sees; accept_rate is the cumulative
@@ -2419,6 +2629,12 @@ class LlamaDecodeDeployment:
             device_sampler=device_sampler)
         if (rt_config.decode_warmup if warmup is None else warmup):
             self.engine.warmup()
+        # Prefill->decode handoff lease ledger (disaggregated serving):
+        # tracks published-but-undischarged KV-page handoffs so the TTL
+        # sweep (riding replica_metrics) can return refs nobody claimed.
+        from ray_tpu.serve.handoff import HandoffLedger
+
+        self._handoffs = HandoffLedger()
         self._thread = threading.Thread(target=self.engine.serve_forever,
                                         name="decode-loop", daemon=True)
         self._thread.start()
@@ -2465,6 +2681,16 @@ class LlamaDecodeDeployment:
         if self.engine.prefix is not None:
             out["prefix"] = s.get("prefix", {})
             out["prefixes"] = self.engine.prefix.hashes()
+        ledger = getattr(self, "_handoffs", None)
+        if ledger is not None:
+            # The controller's reconcile stats pull doubles as the
+            # handoff-lease backstop: expire entries nobody discharged
+            # (router death mid-splice) and free their refs.
+            self._sweep_handoffs()
+            out["handoffs_live"] = ledger.live()
+            out["handoff_live_bytes"] = ledger.live_bytes()
+            out["handoffs_published"] = s.get("handoffs_published", 0)
+            out["handoffs_adopted"] = s.get("handoffs_adopted", 0)
         return out
 
     def timeline(self) -> Dict[str, Any]:
@@ -2472,7 +2698,9 @@ class LlamaDecodeDeployment:
         forwards here; merged into the serve Chrome trace)."""
         return self.engine.timeline()
 
-    def _submit(self, request: Dict[str, Any], on_token=None) -> _Request:
+    def _submit(self, request: Dict[str, Any], on_token=None,
+                prefill_only: bool = False,
+                adopt: Optional[Dict[str, Any]] = None) -> _Request:
         """Admission with the request's deadline attached: explicit
         ``deadline_s`` in the payload wins, else the deadline the serve
         stack propagated with this call (proxy header / handle
@@ -2489,14 +2717,14 @@ class LlamaDecodeDeployment:
             eos_id=request.get("eos_id"),
             on_token=on_token,
             deadline_s=deadline_s,
-            request_id=request.get("request_id"))
+            request_id=request.get("request_id"),
+            prefill_only=prefill_only,
+            adopt=adopt)
 
-    def __call__(self, request: Dict[str, Any]):
-        if request.get("stream"):
-            # Generator return = the replica streams it (handle.stream /
-            # HTTP chunked via X-Serve-Stream on this same route).
-            return self.stream(request)
-        req = self._submit(request)
+    def _wait_done(self, req: _Request) -> None:
+        """Block until the engine finishes the request; a wedged decode
+        loop (never-completing wait) turns into a cancel + deadline
+        error rather than hanging the replica thread forever."""
         if req.deadline is not None:
             # The engine enforces the deadline; the +10 s slack only
             # covers a wedged decode loop (never-completing wait).
@@ -2508,9 +2736,185 @@ class LlamaDecodeDeployment:
                     f"loop within its deadline")
         else:
             req.done.wait()
+
+    def __call__(self, request: Dict[str, Any]):
+        if request.get("stream"):
+            # Generator return = the replica streams it (handle.stream /
+            # HTTP chunked via X-Serve-Stream on this same route).
+            return self.stream(request)
+        req = self._submit(request)
+        self._wait_done(req)
         req.raise_for_status()
         return {"tokens": req.output,
                 "ttft_s": round(req.first_token_at - req.submitted_at, 4)}
+
+    # --------------------------------------- disaggregated prefill/decode
+
+    def prefill_handoff(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Disagg prefill half: run admission + (chunked) prefill into
+        this engine's paged pool, then publish the filled KV pages as
+        object-plane refs plus a descriptor small enough to ride the
+        router splice inline (budget: ``HANDOFF_DESC_BYTE_BUDGET``).
+
+        The returned descriptor is a LEASE: the caller must either
+        adopt-ack it (``discharge_handoff``) or abort it
+        (``abort_handoff``) on every path; the ledger's TTL sweep is
+        the backstop for a caller that died mid-splice, and a SIGKILL
+        of this replica frees the refs structurally (objects die with
+        their owner process)."""
+        import uuid as _uuid
+
+        import ray_tpu
+
+        req = self._submit(request, prefill_only=True)
+        self._wait_done(req)
+        req.raise_for_status()
+        payload = req.handoff
+        if payload is None:  # engine retired the request pre-capture
+            raise RuntimeError(
+                f"prefill request {req.request_id} completed without a "
+                f"handoff payload")
+        desc = {
+            "handoff_id": _uuid.uuid4().hex[:16],
+            "k_ref": ray_tpu.put(payload["k"]),
+            "v_ref": ray_tpu.put(payload["v"]),
+            "committed_len": payload["committed_len"],
+            "first_token": payload["first_token"],
+            "page_tokens": payload["page_tokens"],
+            "nbytes": payload["nbytes"],
+            "prefill_ttft_s": round(
+                req.first_token_at - req.submitted_at, 4),
+        }
+        req.handoff = None  # the object store owns the payload now
+        self._handoffs.publish_handoff(desc)
+        try:
+            self._observe_handoff_published(desc)
+        except BaseException:
+            # The lease must not outlive a failed publish tail: hand the
+            # refs back before the error escapes (graftlint polices the
+            # publish->discharge pairing on every raise exit).
+            self._drop_handoff(desc["handoff_id"], "aborted")
+            raise
+        return desc
+
+    def discharge_handoff(self, handoff_id: str) -> None:
+        """Adopt-ack from the router splice: the decode replica fetched
+        the page payload, so free the refs NOW (one engine step), not
+        at the distributed ref tracker's grace sweep."""
+        self._drop_handoff(handoff_id, "adopted")
+
+    def abort_handoff(self, handoff_id: str) -> None:
+        """Splice failure (decode replica died / cannot adopt / request
+        cancelled): return the pages now. Idempotent, like discharge."""
+        self._drop_handoff(handoff_id, "aborted")
+
+    def _drop_handoff(self, handoff_id: str, event: str) -> None:
+        """Discharge one published handoff and free its payload refs
+        eagerly. Idempotent — the router's abort path and the TTL sweep
+        may race, and the ledger referees the double discharge."""
+        entry = self._handoffs.discharge_handoff(handoff_id)
+        if entry is not None:
+            self._discharge_entry(entry, event)
+
+    def _sweep_handoffs(self) -> None:
+        for entry in self._handoffs.sweep():
+            self._discharge_entry(entry, "expired")
+
+    def _discharge_entry(self, entry: Dict[str, Any],
+                         event: str) -> None:
+        import ray_tpu
+
+        desc = entry["desc"]
+        try:
+            ray_tpu.free([desc.get("k_ref"), desc.get("v_ref")])
+        except Exception:
+            logger.warning("freeing handoff %s refs failed",
+                           desc.get("handoff_id"), exc_info=True)
+        if self.engine._obs_metrics:
+            from ray_tpu.serve import metrics as smetrics
+
+            tags = dict(self.engine._mtags)
+            smetrics.HANDOFFS.inc(1.0, {**tags, "event": event})
+            if event == "adopted":
+                # publish->adopt latency: the window the pages spent as
+                # host blobs between the two fleets.
+                smetrics.HANDOFF_LATENCY.observe(entry["age_s"], tags)
+
+    def _observe_handoff_published(self, desc: Dict[str, Any]) -> None:
+        if not self.engine._obs_metrics:
+            return
+        from ray_tpu.serve import handoff as _handoff
+        from ray_tpu.serve import metrics as smetrics
+
+        tags = dict(self.engine._mtags)
+        smetrics.HANDOFF_BYTES.observe(
+            float(_handoff.descriptor_nbytes(desc)), tags)
+        smetrics.HANDOFFS.inc(1.0, {**tags, "event": "published"})
+
+    def _fetch_adopt(self, desc: Dict[str, Any]) -> Dict[str, Any]:
+        """Pull the handed-off page payload out of the object plane and
+        shape it as the engine's adopt argument. A dead prefill replica
+        (refs died with their owner) surfaces as the typed adopt error
+        the router maps to re-prefill."""
+        import ray_tpu
+        from ray_tpu.core.errors import HandoffAdoptError
+        from ray_tpu.serve.replica import request_deadline_s
+
+        timeout = request_deadline_s() or 30.0
+        try:
+            k, v = ray_tpu.get([desc["k_ref"], desc["v_ref"]],
+                               timeout=max(1.0, timeout))
+        except Exception as e:
+            raise HandoffAdoptError(
+                f"handoff {desc.get('handoff_id')} page payload "
+                f"unavailable: {e!r}") from e
+        return {"k": k, "v": v,
+                "committed_len": desc["committed_len"],
+                "first_token": desc["first_token"],
+                "page_tokens": desc["page_tokens"]}
+
+    def decode_adopted(self, request: Dict[str, Any],
+                       desc: Dict[str, Any]) -> Dict[str, Any]:
+        """Disagg decode half (unary): adopt the published pages into
+        this engine's pool — zero recompute — and decode to completion.
+        The prompt's KV never transits Python bytes-concat: page blobs
+        go object-store -> scatter program -> pool."""
+        req = self._submit(request, adopt=self._fetch_adopt(desc))
+        self._wait_done(req)
+        req.raise_for_status()
+        return {"tokens": req.output,
+                "ttft_s": desc.get("prefill_ttft_s", 0.0)}
+
+    def stream_adopted(self, request: Dict[str, Any],
+                       desc: Dict[str, Any]):
+        """Streaming twin of ``decode_adopted``. Adoption (object-plane
+        fetch + engine submit) runs EAGERLY in this call, not in the
+        returned generator, so the replica's synchronous ``start_stream``
+        surfaces adopt failures as retryable call errors and the router
+        can discharge the prefill lease the moment the stream id comes
+        back."""
+        q: "queue.Queue" = queue.Queue()
+        req = self._submit(request, on_token=q.put,
+                           adopt=self._fetch_adopt(desc))
+
+        def _gen():
+            try:
+                while True:
+                    try:
+                        yield q.get(timeout=0.5)
+                        continue
+                    except queue.Empty:
+                        pass
+                    if req.done.is_set():
+                        while not q.empty():
+                            yield q.get()
+                        req.raise_for_status()
+                        break
+            finally:
+                if not req.done.is_set():
+                    self.engine.cancel(req.request_id)
+
+        return _gen()
 
     def stream(self, request: Dict[str, Any]):
         """Streaming generator: yields tokens as the engine emits them

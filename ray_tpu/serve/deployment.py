@@ -58,12 +58,16 @@ class AutoscalingConfig:
 class Deployment:
     """Declarative deployment config (``@serve.deployment``)."""
 
+    ROLES = (None, "colocated", "prefill", "decode")
+
     def __init__(self, cls, name: Optional[str] = None,
                  num_replicas: int = 1,
                  ray_actor_options: Optional[Dict] = None,
                  autoscaling_config: Optional[AutoscalingConfig] = None,
                  max_ongoing_requests: int = 8,
-                 mesh_shape: Optional[Any] = None):
+                 mesh_shape: Optional[Any] = None,
+                 role: Optional[str] = None,
+                 decode_deployment: Optional[str] = None):
         self.cls = cls
         self.name = name or cls.__name__
         self.num_replicas = num_replicas
@@ -76,13 +80,30 @@ class Deployment:
         # spans it with GSPMD-sharded weights/KV (single replica, many
         # devices — the model-parallel serving mode).
         self.mesh_shape = tuple(mesh_shape) if mesh_shape else None
+        # Disaggregated serving posture (ROADMAP #3). Unset/"colocated"
+        # is the legacy path, byte-for-byte: each replica prefills AND
+        # decodes. "prefill" replicas run admission + chunked prefill,
+        # publish the filled KV pages over the object plane, and the
+        # router splices each request to ``decode_deployment`` (a
+        # role="decode" deployment of the SAME model/page geometry),
+        # which adopts the pages — zero recompute — and decodes.
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role must be one of {self.ROLES}, got {role!r}")
+        if role == "prefill" and not decode_deployment:
+            raise ValueError(
+                "role='prefill' requires decode_deployment (the "
+                "role='decode' deployment that adopts its handoffs)")
+        self.role = role
+        self.decode_deployment = decode_deployment
         self._init_args: tuple = ()
         self._init_kwargs: dict = {}
 
     def options(self, **overrides) -> "Deployment":
         dep = Deployment(self.cls, self.name, self.num_replicas,
                          dict(self.actor_options), self.autoscaling,
-                         self.max_ongoing_requests, self.mesh_shape)
+                         self.max_ongoing_requests, self.mesh_shape,
+                         self.role, self.decode_deployment)
         dep._init_args = self._init_args
         dep._init_kwargs = self._init_kwargs
         for k, v in overrides.items():
@@ -108,6 +129,8 @@ class Deployment:
             # into the class's init kwargs (LlamaDecodeDeployment-style)
             # reaches placement the same way.
             "mesh_shape": list(mesh) if mesh else None,
+            "role": self.role,
+            "decode_deployment": self.decode_deployment,
         }
 
 
@@ -144,6 +167,19 @@ def _affinity_hashes(args: tuple):
             tokens, rt_config.prefix_match_min_tokens) or None
     except Exception:
         return None
+
+
+def _error_chain(e: BaseException):
+    """Walk an exception chain (TaskError.cause / __cause__) — replica-
+    side typed errors arrive wrapped in the actor-call error shipping,
+    and the splice's fallback decisions key on the original type."""
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        yield cur
+        nxt = getattr(cur, "cause", None)
+        cur = nxt if isinstance(nxt, BaseException) else cur.__cause__
 
 
 _local_slice_cache: List[Optional[str]] = []  # memo: [] = not probed yet
@@ -201,6 +237,11 @@ class _Router:
         self._have_snapshot = threading.Event()
         self._max_ongoing = 8
         self._deleted = False
+        # Disaggregated posture from the controller snapshot: routers of
+        # a role="prefill" deployment splice __call__ requests across
+        # the prefill and decode fleets; everything else routes legacy.
+        self._role = "colocated"
+        self._decode_dep: Optional[str] = None
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="serve-router")
@@ -225,6 +266,8 @@ class _Router:
             self._version = version
             self._deleted = snapshot.get("deleted", False)
             self._max_ongoing = snapshot.get("max_ongoing_requests", 8)
+            self._role = snapshot.get("role") or "colocated"
+            self._decode_dep = snapshot.get("decode_deployment")
             self._replicas = [
                 {"handle": ActorHandle(ActorID(r["actor_id"])),
                  "id": r["replica_id"],
@@ -408,7 +451,6 @@ class _Router:
 
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
-        budget = max(1, rt_config.handle_retry_budget)
         spans = rt_config.serve_trace_spans
         try:
             # One router span per request; each attempt gets a child
@@ -420,68 +462,214 @@ class _Router:
                     (tracing.trace(f"router:{self.name}", method=method)
                      if spans else nullcontext()):
                 self.wait_ready()
-                prefix_hashes = _affinity_hashes(args)
-                last_err: Optional[BaseException] = None
-                for attempt in range(budget):
-                    remaining = (None if deadline is None
-                                 else deadline - time.monotonic())
-                    if remaining is not None and remaining <= 0:
-                        raise DeadlineExceededError(
-                            f"deadline expired before attempt "
-                            f"{attempt + 1} to {self.name!r}") from last_err
-                    replica = self._pick(model_id, prefix_hashes)
-                    if replica is None:
-                        # Advisory read: worst case a request that raced
-                        # the delete gets the "no replicas" message
-                        # instead of "was deleted" — both terminate it
-                        # identically.
-                        # graftlint: disable=unguarded-field-access
-                        if self._deleted:
-                            raise RuntimeError(
-                                f"deployment {self.name!r} was deleted")
-                        raise RuntimeError(
-                            f"deployment {self.name!r} has no replicas")
-                    try:
-                        # The deadline ships as a RELATIVE duration; the
-                        # replica re-anchors it to its own clock. get()'s
-                        # grace past it only covers transit — the replica
-                        # enforces the deadline itself.
-                        with (tracing.trace("attempt", attempt=attempt,
-                                            replica=replica["id"])
-                              if spans else nullcontext()):
-                            ref = replica["handle"].handle_request.remote(
-                                method, args, kwargs, model_id, remaining)
-                            fut.set_result(ray_tpu.get(
-                                ref, timeout=(None if remaining is None
-                                              else remaining + 10.0)))
-                        return
-                    except GetTimeoutError as e:
-                        raise DeadlineExceededError(
-                            f"no reply from {self.name!r} within the "
-                            f"request deadline") from e
-                    except (ActorDiedError, ActorUnavailableError) as e:
-                        # Replica died: forget it locally; the
-                        # controller's next snapshot heals the set.
-                        # Retry elsewhere — within the per-request
-                        # budget, with backoff, and never past the
-                        # deadline.
-                        last_err = e
-                        with self._lock:
-                            self._replicas = [r for r in self._replicas
-                                              if r["id"] != replica["id"]]
-                        if attempt + 1 >= budget:
-                            break
-                        pause = self._backoff_s(attempt)
-                        if (deadline is not None
-                                and time.monotonic() + pause >= deadline):
-                            break  # the retry could not finish in time
-                        self._count_retry()
-                        time.sleep(pause)
-                    finally:
-                        self._release(replica)
-                raise last_err
+                if self._splice_eligible(method, args):
+                    fut.set_result(self._run_spliced(
+                        args[0], model_id, deadline))
+                else:
+                    fut.set_result(self._call_with_retries(
+                        method, args, kwargs, model_id, deadline,
+                        _affinity_hashes(args)))
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
+
+    def _call_with_retries(self, method, args, kwargs, model_id,
+                           deadline: Optional[float],
+                           prefix_hashes=None) -> Any:
+        """One routed unary call: pick -> call -> return, retrying a
+        dead replica within the handle budget (backoff, never past the
+        absolute monotonic ``deadline``)."""
+        from contextlib import nullcontext
+
+        from ray_tpu.core.config import config as rt_config
+        from ray_tpu.util import tracing
+
+        budget = max(1, rt_config.handle_retry_budget)
+        spans = rt_config.serve_trace_spans
+        last_err: Optional[BaseException] = None
+        for attempt in range(budget):
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceededError(
+                    f"deadline expired before attempt "
+                    f"{attempt + 1} to {self.name!r}") from last_err
+            replica = self._pick(model_id, prefix_hashes)
+            if replica is None:
+                # Advisory read: worst case a request that raced
+                # the delete gets the "no replicas" message
+                # instead of "was deleted" — both terminate it
+                # identically.
+                # graftlint: disable=unguarded-field-access
+                if self._deleted:
+                    raise RuntimeError(
+                        f"deployment {self.name!r} was deleted")
+                raise RuntimeError(
+                    f"deployment {self.name!r} has no replicas")
+            try:
+                # The deadline ships as a RELATIVE duration; the
+                # replica re-anchors it to its own clock. get()'s
+                # grace past it only covers transit — the replica
+                # enforces the deadline itself.
+                with (tracing.trace("attempt", attempt=attempt,
+                                    replica=replica["id"])
+                      if spans else nullcontext()):
+                    ref = replica["handle"].handle_request.remote(
+                        method, args, kwargs, model_id, remaining)
+                    return ray_tpu.get(
+                        ref, timeout=(None if remaining is None
+                                      else remaining + 10.0))
+            except GetTimeoutError as e:
+                raise DeadlineExceededError(
+                    f"no reply from {self.name!r} within the "
+                    f"request deadline") from e
+            except (ActorDiedError, ActorUnavailableError) as e:
+                # Replica died: forget it locally; the
+                # controller's next snapshot heals the set.
+                # Retry elsewhere — within the per-request
+                # budget, with backoff, and never past the
+                # deadline.
+                last_err = e
+                with self._lock:
+                    self._replicas = [r for r in self._replicas
+                                      if r["id"] != replica["id"]]
+                if attempt + 1 >= budget:
+                    break
+                pause = self._backoff_s(attempt)
+                if (deadline is not None
+                        and time.monotonic() + pause >= deadline):
+                    break  # the retry could not finish in time
+                self._count_retry()
+                time.sleep(pause)
+            finally:
+                self._release(replica)
+        raise last_err
+
+    # --------------------------------------- disaggregated splice
+
+    def _splice_eligible(self, method: str, args: tuple,
+                         stream: bool = False) -> bool:
+        """Should this request split across the prefill/decode fleets?
+        Only a role="prefill" deployment splices, only for generation-
+        shaped requests, and only while the decode fleet has routable
+        replicas — otherwise fall through to the legacy colocated path
+        (prefill replicas run the full engine; role is routing posture,
+        not capability)."""
+        # graftlint: disable=unguarded-field-access — advisory reads;
+        # a stale posture routes one request the legacy way, harmlessly
+        if self._role != "prefill" or not self._decode_dep:
+            return False
+        if method not in (("__call__", "stream") if stream
+                          else ("__call__",)):
+            return False
+        req = args[0] if args else None
+        if not isinstance(req, dict) or req.get("tokens") is None:
+            return False
+        if not stream and req.get("stream"):
+            return False  # generator path: _Router.stream splices it
+        decode = _Router.get(self._decode_dep)
+        if not decode._have_snapshot.is_set():
+            return False  # decode fleet not routable yet: don't publish
+        with decode._lock:
+            return bool(decode._replicas)
+
+    def _notify_handoff(self, replica, verb: str, desc) -> None:
+        """Fire-and-forget lease notify back to the prefill replica
+        (adopt-ack or abort). Best-effort by design: an unreachable
+        prefill replica is a dead one, whose refs died with it, and the
+        ledger's TTL sweep backstops a lost notify."""
+        try:
+            replica["handle"].handle_request.remote(
+                verb, (desc["handoff_id"],), {}, "", None)
+        except Exception:
+            log_every("router.handoff_notify", 10.0, logger,
+                      "handoff lease notify failed", exc_info=True)
+
+    def _run_spliced(self, request, model_id,
+                     deadline: Optional[float]) -> Any:
+        """Disaggregated splice, unary: prefill on this fleet publishes
+        the prompt's KV pages (``prefill_handoff``), the decode fleet
+        adopts them (``decode_adopted``). The published lease is
+        discharged on EVERY path: adopt-ack on success, abort on any
+        decode-side failure; a prefill replica that dies mid-handoff
+        needs nothing (its refs died with the owner process) and the
+        request re-prefills within the retry budget."""
+        from ray_tpu.core.config import config as rt_config
+        from ray_tpu.core.errors import (HandoffAdoptError,
+                                         RequestCancelledError)
+
+        decode = _Router.get(self._decode_dep)
+        prefix_hashes = _affinity_hashes((request,))
+        budget = max(1, rt_config.handle_retry_budget)
+        last_err: Optional[BaseException] = None
+        for attempt in range(budget):
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceededError(
+                    f"deadline expired before splice attempt "
+                    f"{attempt + 1} via {self.name!r}") from last_err
+            replica = self._pick(model_id, prefix_hashes)
+            if replica is None:
+                raise RuntimeError(
+                    f"deployment {self.name!r} has no replicas")
+            try:
+                ref = replica["handle"].handle_request.remote(
+                    "prefill_handoff", (request,), {}, model_id,
+                    remaining)
+                desc = ray_tpu.get(
+                    ref, timeout=(None if remaining is None
+                                  else remaining + 10.0))
+            except GetTimeoutError as e:
+                raise DeadlineExceededError(
+                    f"no prefill handoff from {self.name!r} within "
+                    f"the request deadline") from e
+            except (ActorDiedError, ActorUnavailableError) as e:
+                # Prefill replica death mid-handoff: its object-plane
+                # refs died with the owner process, so nothing strands
+                # — forget it and re-prefill elsewhere.
+                last_err = e
+                with self._lock:
+                    self._replicas = [r for r in self._replicas
+                                      if r["id"] != replica["id"]]
+                if attempt + 1 >= budget:
+                    break
+                pause = self._backoff_s(attempt)
+                if (deadline is not None
+                        and time.monotonic() + pause >= deadline):
+                    break
+                self._count_retry()
+                time.sleep(pause)
+                continue
+            finally:
+                self._release(replica)
+            # Published: the lease is this router's to discharge. The
+            # decode router retries a dead decode replica internally —
+            # the descriptor stays valid (the prefill replica holds the
+            # refs until we notify).
+            try:
+                result = decode._call_with_retries(
+                    "decode_adopted", (request, desc), {}, model_id,
+                    deadline, prefix_hashes)
+            except BaseException as e:
+                self._notify_handoff(replica, "abort_handoff", desc)
+                for cause in _error_chain(e):
+                    if isinstance(cause, (DeadlineExceededError,
+                                          RequestCancelledError)):
+                        raise  # terminal by contract: never fall back
+                    if isinstance(cause, HandoffAdoptError):
+                        # The decode fleet cannot splice these pages
+                        # (geometry mismatch / payload gone with a dead
+                        # owner): serve the request colocated, once.
+                        logger.warning(
+                            "handoff adopt failed (%s); falling back "
+                            "to colocated on %r", cause, self.name)
+                        return self._call_with_retries(
+                            "__call__", (request,), {}, model_id,
+                            deadline, prefix_hashes)
+                raise
+            self._notify_handoff(replica, "discharge_handoff", desc)
+            return result
+        raise last_err
 
     def _count_retry(self) -> None:
         from ray_tpu.core.config import config as rt_config
@@ -494,6 +682,119 @@ class _Router:
     def stream(self, method: str, args: tuple, kwargs: dict,
                model_id: str = "", chunk_items: int = 16,
                timeout_s: Optional[float] = None):
+        """Generator of streamed items from one replica (or, for a
+        role="prefill" deployment, spliced across the prefill and
+        decode fleets): see ``_stream_plain`` / ``_stream_spliced``."""
+        self.wait_ready()
+        if self._splice_eligible(method, args, stream=True):
+            yield from self._stream_spliced(
+                method, args[0], model_id, chunk_items,
+                (time.monotonic() + timeout_s
+                 if timeout_s is not None else None))
+            return
+        yield from self._stream_plain(method, args, kwargs, model_id,
+                                      chunk_items, timeout_s)
+
+    def _stream_spliced(self, method, request, model_id,
+                        chunk_items: int, deadline: Optional[float]):
+        """Disaggregated splice, streaming: publish the prefill handoff
+        here, then delegate to the decode router's stream (which adopts
+        EAGERLY inside start_stream, so pre-first-item failures are
+        visible before any token reaches the client). The lease is
+        discharged at the first streamed item (adoption observably
+        complete) and aborted on any pre-first-item failure."""
+        from ray_tpu.core.config import config as rt_config
+        from ray_tpu.core.errors import (HandoffAdoptError,
+                                         RequestCancelledError)
+
+        decode = _Router.get(self._decode_dep)
+        prefix_hashes = _affinity_hashes((request,))
+        budget = max(1, rt_config.handle_retry_budget)
+        last_err: Optional[BaseException] = None
+        for attempt in range(budget):
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceededError(
+                    f"deadline expired before the spliced stream via "
+                    f"{self.name!r} started") from last_err
+            replica = self._pick(model_id, prefix_hashes)
+            if replica is None:
+                raise RuntimeError(
+                    f"deployment {self.name!r} has no replicas")
+            try:
+                desc = ray_tpu.get(
+                    replica["handle"].handle_request.remote(
+                        "prefill_handoff", (request,), {}, model_id,
+                        remaining),
+                    timeout=(None if remaining is None
+                             else remaining + 10.0))
+            except GetTimeoutError as e:
+                raise DeadlineExceededError(
+                    f"no prefill handoff from {self.name!r} within "
+                    f"the request deadline") from e
+            except (ActorDiedError, ActorUnavailableError) as e:
+                last_err = e
+                with self._lock:
+                    self._replicas = [r for r in self._replicas
+                                      if r["id"] != replica["id"]]
+                if attempt + 1 >= budget:
+                    break
+                pause = self._backoff_s(attempt)
+                if (deadline is not None
+                        and time.monotonic() + pause >= deadline):
+                    break
+                self._count_retry()
+                time.sleep(pause)
+                continue
+            finally:
+                self._release(replica)
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            inner = decode.stream(
+                "stream_adopted", (request, desc), {}, model_id,
+                chunk_items=chunk_items, timeout_s=remaining)
+            discharged = False
+            try:
+                for item in inner:
+                    if not discharged:
+                        discharged = True
+                        self._notify_handoff(replica,
+                                             "discharge_handoff", desc)
+                    yield item
+                if not discharged:  # empty stream still adopted
+                    discharged = True
+                    self._notify_handoff(replica,
+                                         "discharge_handoff", desc)
+                return
+            except BaseException as e:
+                if not discharged:
+                    self._notify_handoff(replica, "abort_handoff", desc)
+                for cause in _error_chain(e):
+                    if isinstance(cause, (DeadlineExceededError,
+                                          RequestCancelledError)):
+                        raise
+                    if isinstance(cause, HandoffAdoptError):
+                        if discharged:
+                            raise  # mid-stream: never replay tokens
+                        logger.warning(
+                            "handoff adopt failed (%s); falling back "
+                            "to colocated stream on %r", cause,
+                            self.name)
+                        yield from self._stream_plain(
+                            method, (request,), {}, model_id,
+                            chunk_items,
+                            (None if deadline is None
+                             else deadline - time.monotonic()))
+                        return
+                raise
+            finally:
+                inner.close()
+        raise last_err
+
+    def _stream_plain(self, method: str, args: tuple, kwargs: dict,
+                      model_id: str = "", chunk_items: int = 16,
+                      timeout_s: Optional[float] = None):
         """Generator of streamed items from one replica: the replica's
         generator suspends between pulls (consumer-paced). The replica's
         in-flight slot and this router's count are held for the stream's

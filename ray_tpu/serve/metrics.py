@@ -100,6 +100,36 @@ SPEC_ACCEPT = Histogram(
     boundaries=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
     tag_keys=("deployment",))
 
+# Disaggregated prefill/decode handoff (ROADMAP #3). Descriptor bytes
+# prove the handoff rides the object plane by reference: the descriptor
+# is block-table metadata (~hundreds of bytes), never the KV payload
+# itself — a descriptor past a few KiB means someone inlined pages.
+# Latency is publish -> adopt (the lease's open interval); the counter's
+# event tag closes the books: published == adopted + aborted + expired
+# at quiescence, anything else is a leaked lease.
+_HANDOFF_BYTE_BUCKETS = (128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
+                         8192.0, 16384.0, 65536.0)
+
+HANDOFF_BYTES = Histogram(
+    "serve_handoff_bytes",
+    "Pickled size of one prefill->decode handoff descriptor (block-table "
+    "metadata + ObjectRefs, NOT the KV payload).",
+    boundaries=_HANDOFF_BYTE_BUCKETS, tag_keys=("deployment",))
+
+HANDOFF_LATENCY = Histogram(
+    "serve_handoff_latency_s",
+    "KV-page handoff lease lifetime: publish on the prefill replica -> "
+    "adopt acknowledged by the decode side.",
+    boundaries=_TTFT_BUCKETS, tag_keys=("deployment",))
+
+HANDOFFS = Counter(
+    "serve_handoffs_total",
+    "KV-page handoff lease events: published | adopted | aborted | "
+    "expired. published - (adopted + aborted + expired) is the number "
+    "of leases currently open; nonzero at quiescence means leaked "
+    "pages/refs.",
+    tag_keys=("deployment", "event"))
+
 PENDING_RELEASES = Gauge(
     "serve_pending_subslice_releases",
     "Sub-slice release RPCs awaiting retry after a head blip "
@@ -131,6 +161,8 @@ _HISTOGRAMS = {
     "queue_wait_s": "serve_queue_wait_s",
     "http_request_s": "serve_http_request_s",
     "spec_accept_rate": "serve_spec_accept_rate",
+    "handoff_bytes": "serve_handoff_bytes",
+    "handoff_latency_s": "serve_handoff_latency_s",
 }
 
 
@@ -172,4 +204,10 @@ def slo_summary(aggregated: Dict[str, List[Dict[str, Any]]]
                     tags.get("code", "?")] = int(total)
             else:
                 rec(dep)[field] = rec(dep).get(field, 0) + int(total)
+    for key, total in counter_totals(aggregated,
+                                     "serve_handoffs_total").items():
+        tags = dict(key)
+        dep = tags.get("deployment", "-")
+        rec(dep).setdefault("handoffs", {})[
+            tags.get("event", "?")] = int(total)
     return out

@@ -229,7 +229,9 @@ def make_handler(in_flight: _InFlight, routes: _RouteTable):
             aggregated registry (reference: the node agent's exporter).
             Serving it from the INGRESS port means a Prometheus scraping
             the proxies sees every deployment's TTFT / inter-token /
-            queue-wait histograms without reaching the control plane."""
+            queue-wait histograms — and on disaggregated fleets the
+            ``serve_handoff_*`` descriptor-size / lease-latency /
+            lease-event series — without reaching the control plane."""
             from ray_tpu.core.runtime import get_core_worker
 
             try:

@@ -115,9 +115,15 @@ def loaded_model_ids(instance) -> List[str]:
 
 class ReplicaActor:
     def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict,
-                 replica_id: str = "", owner_epoch: int = 0):
+                 replica_id: str = "", owner_epoch: int = 0,
+                 role: str = ""):
         from ray_tpu.core import serialization
 
+        # Disaggregated posture ("prefill" / "decode" / "" = colocated):
+        # routing-plane metadata, reported back through stats() so
+        # serve.status() shows each replica's role. The hosted class is
+        # identical either way — role never changes engine behavior.
+        self._role = role
         if replica_id:
             # Before the user class runs: its __init__ may build the
             # engine that reads this identity for metric labels.
@@ -302,6 +308,8 @@ class ReplicaActor:
             out.update({"ongoing": self._ongoing, "total": self._total,
                         "models": models,
                         "uptime_s": time.monotonic() - self._started})
+        if self._role:
+            out["role"] = self._role
         return out
 
     def ping(self) -> str:
