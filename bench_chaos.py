@@ -14,8 +14,23 @@ merge idiom):
 * ``chaos_adopted_replicas``        — replicas adopted in place
   (same actor ids, no respawn, no cold start).
 
+Autopilot rows (ISSUE 18) — the closed-loop remediator driven against
+the same cluster, chaos first, then a healthy soak:
+
+* ``autopilot_mttr_s``              — gang-death signature first seen
+  -> fenced ``autopilot_evict`` applied -> gang ALIVE under a bumped
+  epoch (detection-to-remediated, doctor cadence compressed to 1s);
+* ``autopilot_actions_taken``       — applied actions across the chaos
+  phase (taint-host on an RTT outlier + reschedule-gang eviction),
+  each fenced, rate-limited and audit-logged;
+* ``autopilot_false_remediations``  — applied actions across live
+  doctor windows on the HEALTHY cluster (bound: 0 — stale post-mortem
+  signatures must fence to no-ops, never replayed mutations).
+
 Run: ``make bench-chaos`` (CPU host; the bound being measured is
-control-plane latency, so no accelerator is involved).
+control-plane latency, so no accelerator is involved — see
+BENCH_NOTES.md for what the virtual 4-host slice does and does not
+prove about placement).
 """
 
 from __future__ import annotations
@@ -25,6 +40,132 @@ import json
 import os
 import threading
 import time
+
+
+def _autopilot_bench() -> list:
+    """Closed-loop remediation under chaos, then a healthy soak.
+
+    Three phases against the live cluster (autopilot enabled only for
+    the duration; restored after):
+
+    1. taint-host — a heartbeat-rtt-outlier signature naming a LIVE
+       node (by its 8-hex metric prefix, exactly as the doctor emits
+       it) is damped for one window, then applied: the node lands in
+       the topology taint set and is lifted again through the
+       probe-gated ``untaint_host`` re-admission path.
+    2. reschedule-gang — a real 2-host gang on the virtual slice; a
+       gang-death signature is damped, then applied as a FENCED
+       ``autopilot_evict`` group-KV write at the observed epoch; the
+       group monitor consumes it through its own reconcile path and
+       the gang comes back ALIVE under a bumped epoch. MTTR runs from
+       the first window the signature was seen to the gang healthy.
+    3. healthy soak — full live passes (doctor collect -> diagnose ->
+       post-mortem -> step); applied actions must be ZERO. The soak
+       deliberately still sees the eviction's own post-mortem trail:
+       the fence (group gone / epoch moved on) is what keeps that
+       stale evidence from becoming a mutation.
+    """
+    import ray_tpu  # noqa: F401  (cluster already initialised)
+    from ray_tpu import doctor
+    from ray_tpu.autopilot import Autopilot
+    from ray_tpu.core.config import config
+    from ray_tpu.core.multihost import HostGroup
+    from ray_tpu.core.rpc_stubs import ControllerStub
+    from ray_tpu.core.runtime import get_core_worker
+
+    client = get_core_worker().controller
+    saved = (config.autopilot_enabled, config.autopilot_dry_run)
+    config.autopilot_enabled, config.autopilot_dry_run = True, False
+    actions = 0
+    try:
+        pilot = Autopilot(client=client)
+
+        # ---- 1. taint-host: RTT outlier -> live host demoted --------
+        node_hex = next(n["node_id"]
+                        for n in ControllerStub(client).list_nodes()
+                        if n.get("alive"))
+        rtt = {
+            "signature": "heartbeat-rtt-outlier", "severity": "warning",
+            "source": f"node:{node_hex[:8]}",
+            "summary": "bench: node RTT p99 far off the fleet median",
+            "evidence": {"p99_s": 0.9, "fleet_median_s": 0.01},
+            "remediation": doctor._remediation(
+                "taint-host", node_hex[:8],
+                ("p99_s", "fleet_median_s")),
+        }
+        assert pilot.step([rtt]) == []  # window 1: hysteresis damps
+        recs = pilot.step([rtt])        # window 2: acts
+        assert [r["outcome"] for r in recs] == ["applied"], recs
+        assert node_hex in ControllerStub(client).taint_state()
+        actions += 1
+        # Probe-gated re-admission: the host is healthy, so the probe
+        # passes and the taint lifts early (instead of waiting out the
+        # TTL) — keeps the soak below on a clean topology.
+        res = ControllerStub(client).untaint_host(node_hex, probe=True)
+        assert res["untainted"], res
+
+        # ---- 2. reschedule-gang: fenced eviction, epoch bump --------
+        g = HostGroup(2, name="ap-bench", max_group_restarts=2).start()
+        try:
+            gid = g.group_id
+            death = {
+                "signature": "gang-death", "severity": "critical",
+                "source": f"group:{gid}",
+                "summary": "bench: member host-1 repeatedly dying",
+                "evidence": {"first_dying": "host-1",
+                             "dead": ["host-1"], "old_epoch": 1,
+                             "surviving_epoch": 1, "injected": True,
+                             "stage": None},
+                "remediation": doctor._remediation(
+                    "reschedule-gang", gid,
+                    ("first_dying", "dead", "old_epoch",
+                     "surviving_epoch", "injected", "stage")),
+            }
+            t_detect = time.monotonic()
+            assert pilot.step([death]) == []  # window 1: damped
+            time.sleep(1.0)                   # compressed doctor cadence
+            recs = pilot.step([death])        # window 2: fenced evict
+            assert [r["outcome"] for r in recs] == ["applied"], recs
+            deadline = time.monotonic() + 60.0
+            while not (g.status()["epoch"] >= 2
+                       and g.status()["state"] == "ALIVE"):
+                assert time.monotonic() < deadline, g.status()
+                time.sleep(0.05)
+            mttr = time.monotonic() - t_detect
+            actions += 1
+        finally:
+            g.shutdown()
+
+        # ---- 3. healthy soak: zero false remediations ---------------
+        false_rem = 0
+        for _ in range(3):
+            false_rem += sum(1 for r in pilot.run_once(interval_s=0.5)
+                             if r["outcome"] == "applied")
+    finally:
+        config.autopilot_enabled, config.autopilot_dry_run = saved
+
+    assert mttr <= 30.0, mttr
+    assert false_rem == 0, pilot.status()["audit"]
+    return [
+        {"metric": "autopilot_mttr_s",
+         "value": round(mttr, 3), "unit": "s",
+         "note": "gang-death signature first seen -> fenced "
+                 "autopilot_evict at the observed epoch -> monitor "
+                 "reconciled the gang ALIVE under a bumped epoch; "
+                 "doctor cadence compressed to 1s windows"},
+        {"metric": "autopilot_actions_taken",
+         "value": actions, "unit": "actions",
+         "note": "taint-host (RTT outlier -> live node demoted, then "
+                 "probe-gated re-admission) + reschedule-gang (fenced "
+                 "eviction); every action audited (flightrec "
+                 "autopilot.action + controller-KV record)"},
+        {"metric": "autopilot_false_remediations",
+         "value": false_rem, "unit": "actions",
+         "note": "applied actions across 3 live doctor windows on the "
+                 "healthy cluster (bound: 0 — the eviction's own "
+                 "post-mortem trail fences to no-ops: group gone / "
+                 "epoch moved on)"},
+    ]
 
 
 def main() -> None:
@@ -38,6 +179,12 @@ def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     faults_path = f"/tmp/ray_tpu_bench_chaos_{os.getpid()}.json"
     os.environ["RAY_TPU_FAULTINJECT_PATH"] = faults_path
+    # The autopilot phase needs a multi-host gang: advertise a virtual
+    # 4-host slice (the test_multihost_group cluster shape) and a
+    # flight-recorder dir so autopilot audits flush durably.
+    os.environ.setdefault("RAY_TPU_VIRTUAL_SLICE", "4x4/4")
+    flightrec_dir = f"/tmp/ray_tpu_bench_chaos_fr_{os.getpid()}"
+    os.environ.setdefault("RAY_TPU_FLIGHTREC_DIR", flightrec_dir)
 
     import ray_tpu
     from ray_tpu import serve
@@ -46,7 +193,8 @@ def main() -> None:
     from ray_tpu.util.faultinject import Faults
 
     config.faultinject_path = faults_path
-    ray_tpu.init(num_cpus=4)
+    config.flightrec_dir = os.environ["RAY_TPU_FLIGHTREC_DIR"]
+    ray_tpu.init(num_cpus=8)
 
     class Streamer:
         def __call__(self, req):
@@ -134,6 +282,8 @@ def main() -> None:
     assert not errors, errors
     assert adopted >= 1, (actors0, actors1)
     assert mttr <= config.serve_mttr_bound_s, mttr
+
+    rows += _autopilot_bench()
 
     serve.shutdown()
     ray_tpu.shutdown()

@@ -81,7 +81,8 @@ def _family_checks():
     (project_or_graph, emit_files=None): whole-program indexes are
     always built, but per-file emission work is skipped for files
     outside ``emit_files`` (the --diff fast path)."""
-    from ray_tpu.analysis import (guarded_by, lifecycle_hygiene, lifetime,
+    from ray_tpu.analysis import (autopilot_lint, guarded_by,
+                                  lifecycle_hygiene, lifetime,
                                   lock_discipline, metrics_lint,
                                   reactor_safety, rpc_contract,
                                   sharding_safety, stubgen, trace_safety)
@@ -97,6 +98,7 @@ def _family_checks():
         "sharding-safety": (True, sharding_safety.check),
         "rpc-stubs": (True, stubgen.check),
         "metrics": (False, metrics_lint.check_project),
+        "autopilot": (False, autopilot_lint.check_project),
     }
 
 

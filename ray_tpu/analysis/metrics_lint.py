@@ -186,7 +186,7 @@ def _flightrec_aliases(tree: ast.AST) -> Tuple[set, set]:
         if isinstance(node, ast.ImportFrom):
             if node.module == rules.FLIGHTREC_MODULE:
                 for a in node.names:
-                    if a.name == rules.FLIGHTREC_RECORD_FUNC:
+                    if a.name in rules.FLIGHTREC_RECORD_FUNCS:
                         direct.add(a.asname or a.name)
             elif node.module == "ray_tpu.util":
                 for a in node.names:
@@ -205,7 +205,7 @@ def _is_flightrec_record(call: ast.Call, direct: set,
     if isinstance(fn, ast.Name):
         return fn.id in direct
     return (isinstance(fn, ast.Attribute)
-            and fn.attr == rules.FLIGHTREC_RECORD_FUNC
+            and fn.attr in rules.FLIGHTREC_RECORD_FUNCS
             and isinstance(fn.value, ast.Name)
             and fn.value.id in mod_aliases)
 

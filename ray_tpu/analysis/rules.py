@@ -30,6 +30,7 @@ RPC_STUB_DRIFT = "rpc-stub-drift"
 METRICS_COLLISION = "metrics-name-collision"
 METRICS_CARDINALITY = "metrics-label-cardinality"
 CHECKPOINT_MISSING = "checkpoint-missing-save"
+AUTOPILOT_UNPAIRED = "autopilot-unpaired-action"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -44,9 +45,10 @@ ALL_RULES = (
     RPC_STUB_DRIFT,
     METRICS_COLLISION, METRICS_CARDINALITY,
     CHECKPOINT_MISSING,
+    AUTOPILOT_UNPAIRED,
 )
 
-# The ten checker families, for ``--jobs`` scheduling and per-family
+# The eleven checker families, for ``--jobs`` scheduling and per-family
 # stats: family name -> tuple of rule ids it emits.
 FAMILIES = {
     "reactor-safety": (REACTOR_BLOCKING,),
@@ -60,6 +62,7 @@ FAMILIES = {
                         SHARDING_UNPINNED, SHARDING_UNSCOPED),
     "rpc-stubs": (RPC_STUB_DRIFT,),
     "metrics": (METRICS_COLLISION, METRICS_CARDINALITY),
+    "autopilot": (AUTOPILOT_UNPAIRED,),
 }
 
 # ------------------------------------------------- blocking-API tables
@@ -311,8 +314,24 @@ RPC_LEASE_VERBS = ("call", "notify")
 CHECKPOINT_CLASSES = {
     "ServeController": ("_save_state",
                         ("deploy", "delete", "set_route", "enable_http",
-                         "disable_http", "shutdown")),
+                         "disable_http", "shutdown",
+                         "_apply_resize", "_apply_shed")),
 }
+
+# ---------------------------------------- autopilot action discipline
+
+# The closed-loop remediator's handler idiom (the RPC_LEASE_PAIRS shape
+# applied to control actions): in these modules, every action handler —
+# a method whose name carries the action prefix — must PAIR an
+# epoch-fence check with a durable audit record. An action that cannot
+# show its fence can double-kill a gang the cluster already healed; one
+# that cannot show its audit trail is an unaccountable mutation. Both
+# calls must appear in the handler body itself (not a transitive
+# callee): the pairing is the readable contract.
+AUTOPILOT_MODULES = ("ray_tpu/autopilot.py",)
+AUTOPILOT_ACTION_PREFIX = "_act_"
+AUTOPILOT_FENCE_CALL = "_fence_ok"
+AUTOPILOT_AUDIT_CALL = "_audit"
 
 # ------------------------------------------ v3: sharding/mesh safety
 
@@ -418,6 +437,9 @@ METRICS_ID_CALLS = frozenset({"hex", "uuid4", "uuid1"})
 # whose attrs are per-request ids is a metric trying to be born).
 FLIGHTREC_MODULE = "ray_tpu.util.flightrec"
 FLIGHTREC_RECORD_FUNC = "record"
+# audit() is record()+flush_now() (durable variant, PR 18): an audit
+# site defines an event schema exactly like a record site does.
+FLIGHTREC_RECORD_FUNCS = (FLIGHTREC_RECORD_FUNC, "audit")
 # Attr keys whose values are bounded schedule/geometry integers by
 # construction ({step, mb, stage} and friends): exempt from the
 # id-shaped check — `step=self._step` is a clock, not a cardinality

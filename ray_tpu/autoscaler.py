@@ -249,6 +249,12 @@ class StandardAutoscaler:
         autoscaler.py:374)."""
         state = self._controller.autoscaler_state()
         nodes = [n for n in state["nodes"] if n["alive"]]
+        # Hosts the autopilot demoted from placement (heartbeat-RTT
+        # outliers etc.): still alive, but their free capacity must not
+        # absorb pending demand below — otherwise the demand looks met
+        # and no healthy replacement ever launches. They still count
+        # against max_nodes and still scale down when idle.
+        tainted = set(state.get("tainted", ()))
         # Demand entries: {"resources": ..., "labels": ...} (labels from
         # node_label-blocked tasks). A label-constrained demand only counts
         # against this autoscaler's node type if the template labels
@@ -288,7 +294,7 @@ class StandardAutoscaler:
             provisioning += self._im.requested_count()
         unmet: List[tuple] = []
         capacity = ([(n.get("labels", {}), dict(n["available"]))
-                     for n in nodes]
+                     for n in nodes if n["node_id"] not in tainted]
                     + [(self._node_labels, dict(self._node_resources))
                        for _ in range(provisioning)])
         for shape, want in demand:

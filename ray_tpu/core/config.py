@@ -479,6 +479,50 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "-1 disables; 0 binds an ephemeral port (Controller."
         "metrics_http_addr reports it). The dashboard serves the same "
         "text at its own /metrics route."),
+    "autopilot_enabled": (bool, False,
+        "Global kill switch for closed-loop remediation (autopilot.py). "
+        "OFF (default) = the reconciler observes and records what it "
+        "WOULD do but takes no action — byte-identical legacy behavior. "
+        "ON = doctor signatures that persist across the hysteresis "
+        "window become fenced, rate-limited control actions (taint host, "
+        "reschedule gang, shed tenant, resize deployment)."),
+    "autopilot_dry_run": (bool, False,
+        "Autopilot evaluates the full pipeline (hysteresis, rate "
+        "limits, fencing) and writes audit records with outcome "
+        "'dry-run', but never mutates the cluster. Subordinate to "
+        "autopilot_enabled: with the kill switch OFF nothing runs at "
+        "all; with it ON, dry-run is the safe observe-only mode the "
+        "CLI's --dry-run uses."),
+    "autopilot_poll_s": (float, 5.0,
+        "Autopilot reconcile cadence: each tick collects a doctor "
+        "window (two metrics snapshots interval_s apart is the "
+        "caller's job — the loop just spaces ticks) and steps the "
+        "remediation pipeline. Also the denominator of 'windows' in "
+        "autopilot_hysteresis_windows."),
+    "autopilot_hysteresis_windows": (int, 2,
+        "Consecutive doctor windows a (signature, source) pair must "
+        "persist before autopilot may act on it. 2 (default) means a "
+        "one-window transient — a single slow heartbeat, one queue "
+        "spike — NEVER triggers remediation. 1 disables hysteresis "
+        "(test/bench use)."),
+    "autopilot_rate_per_min": (float, 2.0,
+        "Token-bucket refill rate, actions per minute PER ACTION CLASS "
+        "(taint-host, reschedule-gang, shed-tenant, resize-deployment "
+        "each get their own bucket). Actions past the budget are "
+        "suppressed (autopilot_suppressed_total{reason='rate-limit'}) "
+        "and retried on a later tick if the signature persists."),
+    "autopilot_burst": (int, 2,
+        "Token-bucket capacity per action class: how many actions of "
+        "one class may fire back-to-back before the per-minute refill "
+        "gates further ones. Bounds blast radius when a correlated "
+        "fault (rack loss) lights up many signatures at once."),
+    "autopilot_taint_ttl_s": (float, 120.0,
+        "How long a taint-host demotion keeps a node out of new "
+        "gang/replica placement. After the TTL the taint lapses and "
+        "the host is re-admitted IF its recent heartbeats look healthy "
+        "(probe-based re-admission: the controller checks the node's "
+        "last-heartbeat freshness before lifting the taint; a host "
+        "still wedged keeps its taint another TTL)."),
 }
 
 

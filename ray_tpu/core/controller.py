@@ -211,6 +211,9 @@ class Controller:
                 "reserve_subslice": self.reserve_subslice,
                 "release_subslice": self.release_subslice,
                 "topology_state": self.topology_state,
+                "taint_host": self.taint_host,
+                "untaint_host": self.untaint_host,
+                "taint_state": self.taint_state,
                 "mh_register_group": self.multihost.register_group,
                 "mh_drop_group": self.multihost.drop_group,
                 "mh_barrier": self.multihost.barrier,
@@ -584,6 +587,49 @@ class Controller:
         fragmentation, and live reservations."""
         return self._topology.state()
 
+    def taint_host(self, node_hex: str,
+                   ttl_s: Optional[float] = None) -> Dict[str, Any]:
+        """Demote a host from new gang/replica placement (autopilot's
+        taint-host action, or an operator). Placement preference, not
+        exclusion: reservations still succeed when only tainted
+        capacity remains. The taint lapses after ``ttl_s`` (default
+        ``config.autopilot_taint_ttl_s``) — but the health loop keeps
+        re-arming it while the host fails the re-admission probe."""
+        ttl = (float(ttl_s) if ttl_s is not None
+               else config.autopilot_taint_ttl_s)
+        self._topology.taint(node_hex, ttl)
+        return {"node": node_hex, "ttl_s": ttl}
+
+    def untaint_host(self, node_hex: str, probe: bool = True
+                     ) -> Dict[str, Any]:
+        """Lift a host taint early. With ``probe`` (default) the host is
+        re-admitted only if its heartbeats look healthy; a host that
+        fails the probe keeps its taint for another TTL."""
+        if probe and not self._node_probe_ok(node_hex):
+            self._topology.taint(node_hex, config.autopilot_taint_ttl_s)
+            return {"node": node_hex, "untainted": False,
+                    "reason": "probe-failed"}
+        return {"node": node_hex,
+                "untainted": self._topology.untaint(node_hex)}
+
+    def taint_state(self) -> Dict[str, float]:
+        """Live host taints: node hex -> remaining seconds."""
+        return self._topology.tainted()
+
+    def _node_probe_ok(self, node_hex: str) -> bool:
+        """Re-admission probe: alive with a heartbeat fresher than the
+        health-check threshold. An unknown node fails (it can't be
+        placed on anyway, and re-admitting a ghost proves nothing)."""
+        threshold = (config.health_check_failure_threshold
+                     * config.heartbeat_period_s)
+        now = time.monotonic()
+        with self._lock:
+            for rec in self._nodes.values():
+                if rec.node_id.hex() == node_hex:
+                    return (rec.alive
+                            and now - rec.last_heartbeat <= threshold)
+        return False
+
     def _health_loop(self) -> None:
         period = config.heartbeat_period_s
         threshold = config.health_check_failure_threshold * period
@@ -598,6 +644,13 @@ class Controller:
             for node_id in dead_nodes:
                 self._on_node_dead(node_id)
             self._reap_dead_actors()
+            # Probe-gated taint expiry: a taint about to lapse on a host
+            # that still fails the re-admission probe re-arms for
+            # another TTL — TTLs re-admit recovered hosts, not sick ones.
+            for node_hex, left in self._topology.tainted().items():
+                if left <= period and not self._node_probe_ok(node_hex):
+                    self._topology.taint(
+                        node_hex, config.autopilot_taint_ttl_s)
 
     def _reap_dead_actors(self) -> None:
         """Bound the DEAD-actor cache (records + pubsub entries) so a
@@ -1262,6 +1315,10 @@ class Controller:
                 "pending_demand": [
                     {"resources": s, "labels": labels}
                     for s, _ts, labels in self._pending_demand.values()],
+                # Autopilot-demoted hosts: the autoscaler must not let a
+                # demoted host's free capacity mark demand as met (it
+                # should launch a healthy replacement instead).
+                "tainted": sorted(self._topology.tainted()),
             }
 
     # ------------------------------------------- metrics + task events

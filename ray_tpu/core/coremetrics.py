@@ -251,6 +251,36 @@ PIPE_MFU = Gauge(
     "Absent unless the peak is configured — there is no honest peak "
     "for a time-sliced CPU host.", tag_keys=("pipeline",))
 
+# --------------------------------------------------- autopilot plane
+# Closed-loop remediation (autopilot.py): every decision the
+# reconciler takes — or declines — has a series. actions_total's
+# outcome label distinguishes applied / dry-run / stale-epoch /
+# failed; suppressed_total's reason label is WHY nothing happened
+# (kill-switch, hysteresis, rate-limit). Both label sets are fixed
+# small enums — never ids.
+
+AUTOPILOT_ACTIONS = Counter(
+    "autopilot_actions_total",
+    "Remediation actions the autopilot decided, by action class "
+    "(taint-host | reschedule-gang | shed-tenant | resize-deployment) "
+    "and outcome (applied | dry-run | stale-epoch | failed). "
+    "stale-epoch is the fence working: the cluster self-healed "
+    "between observation and action, so the action no-opped.",
+    tag_keys=("action", "outcome"))
+AUTOPILOT_SUPPRESSED = Counter(
+    "autopilot_suppressed_total",
+    "Remediations the autopilot declined, by reason (disabled | "
+    "hysteresis | rate-limit). Hysteresis suppressions on a healthy "
+    "cluster are the false-remediation guard doing its job.",
+    tag_keys=("reason",))
+AUTOPILOT_MTTR_S = Gauge(
+    "autopilot_mttr_s",
+    "Seconds from a signature's FIRST observation to its remediation "
+    "action being applied (per action class; last action wins). The "
+    "detect->decide->act latency of the closed loop — hysteresis "
+    "windows are inside it by design.",
+    tag_keys=("action",))
+
 
 # ----------------------------------------------------- cluster summary
 
@@ -369,5 +399,15 @@ def core_summary(aggregated: Dict[str, List[Dict[str, Any]]]
             aggregated, "pipeline_model_tflops"), "pipeline"),
         "mfu_pct": _tag_map(gauge_totals(
             aggregated, "pipeline_mfu_pct"), "pipeline"),
+    }
+    out["autopilot"] = {
+        "actions": _tag_map(counter_totals(
+            aggregated, "autopilot_actions_total"), "action"),
+        "outcomes": _tag_map(counter_totals(
+            aggregated, "autopilot_actions_total"), "outcome"),
+        "suppressed": _tag_map(counter_totals(
+            aggregated, "autopilot_suppressed_total"), "reason"),
+        "mttr_s": _tag_map(gauge_totals(
+            aggregated, "autopilot_mttr_s"), "action"),
     }
     return out

@@ -1060,6 +1060,22 @@ class HostGroup:
 
         chip_ids = [[origin[0] + i, origin[1] + j]
                     for i in range(shape[0]) for j in range(shape[1])]
+        # Formation-time taint consult (autopilot taint-host action):
+        # the reservation's node list is already untainted-first, but
+        # taints move between reserve and spawn — and a RE-formation
+        # after a member death is exactly when a freshly-demoted host
+        # must not get the gang back. Best-effort: an unreachable head
+        # changes nothing (empty taint set = legacy order).
+        if nodes:
+            try:
+                from ray_tpu.core.rpc_stubs import ControllerStub
+                taints = ControllerStub(
+                    _controller_client()).taint_state()
+            except Exception:
+                taints = {}
+            if taints:
+                nodes = ([n for n in nodes if n not in taints]
+                         + [n for n in nodes if n in taints])
         actor_cls = ray_tpu.remote(self._worker_cls)
         try:
             for rank in range(self.num_hosts):
@@ -1145,6 +1161,14 @@ class HostGroup:
                 except Exception:
                     dead.append(i)
             if not dead:
+                victim = (self._poll_autopilot_eviction()
+                          if config.autopilot_enabled else None)
+                if victim is None:
+                    continue
+                with self._lock:
+                    if self._state != _ALIVE or self._members != members:
+                        continue
+                self._reconcile([victim])
                 continue
             with self._lock:
                 # The gang may have been replaced while we pinged the
@@ -1152,6 +1176,28 @@ class HostGroup:
                 if self._state != _ALIVE or self._members != members:
                     continue
             self._reconcile([member_name(i) for i in dead])
+
+    def _poll_autopilot_eviction(self) -> Optional[str]:
+        """Autopilot's reschedule-gang action arrives as a FENCED
+        group-KV write (key ``autopilot_evict``, fenced on the epoch
+        the autopilot observed — the registry already rejected any
+        stale write, and re-registration clears the key with the rest
+        of the group KV, so a consumed eviction dies with its epoch).
+        The monitor treats the named member as dead, funnelling the
+        action through the exact same epoch-fenced reconcile path as a
+        real member death: never a double kill. Only polled when
+        config.autopilot_enabled — the OFF path does not even RPC."""
+        from ray_tpu.core.rpc_stubs import ControllerStub
+
+        try:
+            victim = ControllerStub(_controller_client()).mh_group_get(
+                self.group_id, "autopilot_evict")
+        except Exception:
+            return None
+        if not isinstance(victim, str):
+            return None
+        valid = {member_name(i) for i in range(self.num_hosts)}
+        return victim if victim in valid else None
 
     def _reconcile(self, dead_members: List[str]) -> None:
         """Death reconciliation: the WHOLE gang dies as a unit (no

@@ -272,11 +272,22 @@ class ControllerStub(_StubBase):
         return self._call('reserve_subslice', owner, chips, shape=shape,
                           timeout=timeout)
 
+    def taint_host(self, node_hex, ttl_s=_UNSET, *, timeout=_UNSET):
+        return self._call('taint_host', node_hex, ttl_s=ttl_s,
+                          timeout=timeout)
+
+    def taint_state(self, *, timeout=_UNSET):
+        return self._call('taint_state', timeout=timeout)
+
     def topology_state(self, *, timeout=_UNSET):
         return self._call('topology_state', timeout=timeout)
 
     def unregister_node(self, node_id_bytes, *, timeout=_UNSET):
         return self._call('unregister_node', node_id_bytes, timeout=timeout)
+
+    def untaint_host(self, node_hex, probe=_UNSET, *, timeout=_UNSET):
+        return self._call('untaint_host', node_hex, probe=probe,
+                          timeout=timeout)
 
 
 class CoreWorkerStub(_StubBase):

@@ -32,6 +32,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -262,6 +263,12 @@ class TopologyView:
         # have one node per TPU-VM host, all advertising the same slice).
         self._nodes: Dict[str, List[str]] = {}
         self._owners: Dict[str, str] = {}  # reservation id -> owner tag
+        # Demoted hosts (autopilot taint-host action, or an operator):
+        # node hex -> monotonic expiry deadline. A tainted host is a
+        # placement PREFERENCE, not a hard exclusion — when every
+        # feasible slice is tainted the reservation still succeeds
+        # (capacity beats hygiene); taints only reorder choices.
+        self._taints: Dict[str, float] = {}
 
     def register(self, node_hex: str, info: SliceInfo) -> None:
         with self._lock:
@@ -287,6 +294,38 @@ class TopologyView:
                         for rid in list(grid._reservations):
                             self._owners.pop(rid, None)
 
+    # ------------------------------------------------------------ taints
+
+    def _live_taints(self) -> Dict[str, float]:
+        """Prune expired taints; returns node hex -> expiry deadline.
+        Caller holds ``_lock``."""
+        now = time.monotonic()
+        for node in [n for n, exp in self._taints.items() if exp <= now]:
+            del self._taints[node]
+        return self._taints
+
+    def taint(self, node_hex: str, ttl_s: float) -> None:
+        """Demote ``node_hex`` from new placement for ``ttl_s`` seconds.
+        Re-tainting extends the deadline (never shortens it)."""
+        deadline = time.monotonic() + max(0.0, float(ttl_s))
+        with self._lock:
+            self._taints[node_hex] = max(
+                self._taints.get(node_hex, 0.0), deadline)
+
+    def untaint(self, node_hex: str) -> bool:
+        """Lift a taint early (probe-based re-admission, operator
+        override). Returns whether a live taint existed."""
+        with self._lock:
+            self._live_taints()
+            return self._taints.pop(node_hex, None) is not None
+
+    def tainted(self) -> Dict[str, float]:
+        """Live taints as node hex -> remaining seconds."""
+        with self._lock:
+            now = time.monotonic()
+            return {n: round(exp - now, 3)
+                    for n, exp in self._live_taints().items()}
+
     def reserve(self, owner: str, chips: int = 0,
                 shape: Optional[Tuple[int, int]] = None
                 ) -> Optional[Dict[str, Any]]:
@@ -295,20 +334,28 @@ class TopologyView:
         available for big replicas). A request larger than ANY single
         slice — or satisfiable only by combining fragments of several
         slices — returns None: ICI contiguity is a hard constraint, not
-        a preference."""
+        a preference. Tainted hosts demote, they don't exclude: slices
+        containing a tainted node sort after clean ones, and the
+        returned node list is ordered untainted-first so rank->host
+        assignment lands on healthy hosts when any exist."""
         if shape is None:
             shape = most_square(chips)
         shape = (int(shape[0]), int(shape[1]))
         with self._lock:
+            taints = self._live_taints()
             order = sorted(self._grids.values(),
-                           key=lambda g: (g.free_chips,
+                           key=lambda g: (any(n in taints for n in
+                                              self._nodes[g.info.slice_id]),
+                                          g.free_chips,
                                           g.info.slice_id))
             for grid in order:
                 sub = grid.reserve(shape, owner)
                 if sub is not None:
                     self._owners[sub.reservation_id] = owner
                     out = sub.to_dict()
-                    out["nodes"] = list(self._nodes[sub.slice_id])
+                    nodes = list(self._nodes[sub.slice_id])
+                    out["nodes"] = ([n for n in nodes if n not in taints]
+                                    + [n for n in nodes if n in taints])
                     return out
             return None
 
@@ -332,10 +379,13 @@ class TopologyView:
 
     def state(self) -> Dict[str, Any]:
         with self._lock:
+            now = time.monotonic()
             return {
                 "slices": {sid: g.summary()
                            for sid, g in self._grids.items()},
                 "nodes": {sid: list(nodes)
                           for sid, nodes in self._nodes.items()},
                 "owners": dict(self._owners),
+                "taints": {n: round(exp - now, 3)
+                           for n, exp in self._live_taints().items()},
             }

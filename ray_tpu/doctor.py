@@ -42,10 +42,19 @@ historically became hangs:
   (idle ~0 while everyone else starves behind it) IS the straggler,
   and is named — a slow/wedged stage otherwise just reads as "training
   got slower".
+* **slo-burn** — a deployment's HTTP latency distribution over THIS
+  window (delta histograms, not lifetime averages) violates the p99
+  objective: the error budget is burning right now, regardless of raw
+  load.
 
 ``diagnose`` is a pure function over snapshots so tests inject each
 fault into the REAL components and assert the doctor names it; the CLI
 (``python -m ray_tpu doctor``) wires it to a live controller.
+
+Every finding also carries a machine-readable ``remediation`` hint —
+``{action, target, evidence_keys}`` with ``action`` one of
+:data:`REMEDIATION_ACTIONS` or None — the contract the autopilot
+reconciler (``ray_tpu/autopilot.py``) executes against.
 
 The second half (PR 15) is :func:`post_mortem`: where ``diagnose``
 needs a LIVE cluster, the post-mortem explains a death that already
@@ -82,7 +91,28 @@ DEFAULT_THRESHOLDS = {
     "epoch_bumps": 2,              # controller epoch bumps in the window
     "pipe_stall_idle_s": 0.5,      # starved-stage idle floor (both snaps)
     "pipe_stall_ratio": 0.3,       # straggler idle <= ratio * max idle
+    "slo_http_p99_s": 5.0,         # HTTP latency objective (slo-burn)
+    "slo_min_requests": 8,         # min window requests before burning
 }
+
+# Autopilot action classes a remediation hint may name (autopilot.py
+# executes exactly these; anything else in a hint is a doctor bug).
+REMEDIATION_ACTIONS = ("taint-host", "reschedule-gang", "shed-tenant",
+                       "resize-deployment")
+
+
+def _remediation(action: Optional[str], target: str,
+                 evidence_keys) -> Dict[str, Any]:
+    """Machine-readable remediation hint — the doctor->autopilot
+    contract (tests pin this schema so the two can't drift). ``action``
+    is one of :data:`REMEDIATION_ACTIONS` or None (no automated action
+    exists; the human ``remedy`` text is all there is), ``target`` is
+    the action's object (node hex, group id, source key, deployment
+    name), ``evidence_keys`` names the finding's evidence fields the
+    decision rests on."""
+    assert action is None or action in REMEDIATION_ACTIONS, action
+    return {"action": action, "target": target,
+            "evidence_keys": sorted(evidence_keys)}
 
 
 def _per_source(aggregated, name: str, kind: str) -> Dict[str, float]:
@@ -158,6 +188,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                             f"{interval_s:.0f}s — a peer stopped reading "
                             f"its replies (stalled or wedged process)"),
                 "evidence": {"backpressure_drops": drops},
+                "remediation": _remediation("shed-tenant", source,
+                                            ("backpressure_drops",)),
                 "remedy": ("find the stalled peer (it stopped consuming "
                            "replies): `ray_tpu stacks` for wedged "
                            "threads; check rpc_outbound_queue_bytes per "
@@ -174,6 +206,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                             f"that is not reading — backpressure drop "
                             f"imminent at the outbound cap"),
                 "evidence": {"queue_bytes": qbytes},
+                "remediation": _remediation("shed-tenant", source,
+                                            ("queue_bytes",)),
                 "remedy": "identify the slow consumer before the cap "
                           "tears the stream",
             })
@@ -193,6 +227,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                             f"redialing an address that never answers "
                             f"(dead peer still referenced)"),
                 "evidence": {"dial_failures": fails, "by_role": roles},
+                "remediation": _remediation(None, source,
+                                            ("dial_failures", "by_role")),
                 "remedy": ("a dead owner/replica/controller address is "
                            "still in use; check which peers died "
                            "(`ray_tpu list nodes`, serve status) and "
@@ -220,6 +256,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                             f"consumers poll slower than publishers "
                             f"publish"),
                 "evidence": {"lagged_polls": hi, "p99_lag": p99},
+                "remediation": _remediation(None, f"channel:{channel}",
+                                            ("lagged_polls", "p99_lag")),
                 "remedy": ("latest-value semantics means state is "
                            "current but intermediate versions are "
                            "skipped; if consumers NEED every version, "
@@ -243,6 +281,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                             f"monotonic growth here pins objects "
                             f"cluster-wide (leak suspect)"),
                 "evidence": {"live_refs": now_val, "growth": growth},
+                "remediation": _remediation(None, source,
+                                            ("live_refs", "growth")),
                 "remedy": ("that process is accumulating refs without "
                            "dropping them; `ray_tpu profile <worker> "
                            "--heap` on it, and check obj_store_bytes "
@@ -271,6 +311,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                                 f"~{median * 1e3:.0f}ms — overloaded "
                                 f"host or sick link to the controller"),
                     "evidence": {"p99_s": p99, "fleet_median_s": median},
+                    "remediation": _remediation(
+                        "taint-host", node, ("p99_s", "fleet_median_s")),
                     "remedy": ("inspect that node: `ray_tpu stacks`, "
                                "CPU/memory via the dashboard, and the "
                                "controller's queue (one slow node must "
@@ -294,6 +336,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                         f"riding cached snapshots between them"),
             "evidence": {"epoch_before": ep_before,
                          "epoch_after": ep_after},
+            "remediation": _remediation(None, "serve-controller",
+                                        ("epoch_before", "epoch_after")),
             "remedy": ("read the controller worker's log for the crash "
                        "cause (`ray_tpu logs`); check whether a fault "
                        "rule / OOM kill / bad deployment config fires "
@@ -331,6 +375,9 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                 "evidence": {"replica_epoch": val,
                              "controller_epoch": ep_after,
                              "deployment": dep},
+                "remediation": _remediation(
+                    None, source,
+                    ("replica_epoch", "controller_epoch", "deployment")),
                 "remedy": ("if the serve controller is down, restart "
                            "it (it adopts live replicas from its "
                            "checkpoint); if it is up, this replica "
@@ -381,6 +428,8 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                         f"(straggler or partitioned host)"),
             "evidence": {"stragglers": stragglers,
                          "entered": sorted(in_a)},
+            "remediation": _remediation("reschedule-gang", grp,
+                                        ("stragglers", "entered")),
             "remedy": ("inspect the straggler's worker process "
                        "(`ray_tpu stacks`); if it died, the group "
                        "monitor reconciles the whole gang — check "
@@ -441,12 +490,52 @@ def diagnose(before: Dict[str, List[Dict[str, Any]]],
                         f"behind"),
             "evidence": {"stragglers": stragglers, "starved": starved,
                          "stage_idle_s": st_after},
+            "remediation": _remediation(
+                None, f"pipeline:{pipe}",
+                ("stragglers", "starved", "stage_idle_s")),
             "remedy": ("inspect the straggler stage's worker "
                        "(`ray_tpu stacks`; a dead stage reconciles "
                        "the whole gang instead — check pipe_state / "
                        "mh_group_state). pipe_step_timeout_s bounds "
                        "the stall: past it the driver raises a typed "
                        "PipelineError naming the schedule state"),
+        })
+
+    # -------------------------------------------------------- slo-burn
+    # Burn RATE, not raw load: the WINDOW's HTTP latency distribution
+    # (delta histograms) against the objective. A deployment can be
+    # lightly loaded and still burning (one wedged replica serving
+    # every Nth request slowly) — that resizes; a loaded-but-in-SLO
+    # deployment does not. Feeds autopilot's resize-deployment action.
+    try:
+        from ray_tpu.serve.metrics import slo_summary
+        slo = slo_summary(delta)
+    except Exception:
+        slo = {}
+    for dep in sorted(slo):
+        lat = slo[dep].get("http_request_s") or {}
+        p99, count = lat.get("p99"), lat.get("count", 0)
+        if (p99 is None or count < th["slo_min_requests"]
+                or p99 < th["slo_http_p99_s"]):
+            continue
+        findings.append({
+            "signature": "slo-burn", "severity": "warning",
+            "source": f"deployment:{dep}",
+            "summary": (f"deployment {dep!r}: HTTP p99 ~{p99:.2f}s over "
+                        f"{int(count)} request(s) in this "
+                        f"{interval_s:.0f}s window vs the "
+                        f"{th['slo_http_p99_s']:.1f}s objective — the "
+                        f"error budget is burning now (window "
+                        f"distribution, not lifetime average)"),
+            "evidence": {"p99_s": p99, "objective_s": th["slo_http_p99_s"],
+                         "requests": count},
+            "remediation": _remediation(
+                "resize-deployment", dep,
+                ("p99_s", "objective_s", "requests")),
+            "remedy": ("check serve status for replica health first (a "
+                       "dead replica mid-heal inflates tails); if the "
+                       "deployment is just undersized, raise "
+                       "num_replicas / autoscaling max_replicas"),
         })
 
     order = {"critical": 0, "warning": 1}
@@ -569,6 +658,10 @@ def post_mortem(dumps: Dict[str, Any],
                          "surviving_epoch": new_epoch,
                          "injected": bool(kill),
                          "stage": (stage_note.strip(" ()") or None)},
+            "remediation": _remediation(
+                "reschedule-gang", group,
+                ("first_dying", "dead", "old_epoch", "surviving_epoch",
+                 "injected", "stage")),
             "remedy": ("read the victim's worker log; if the death was "
                        "not injected, check the host (OOM killer, "
                        "preemption). Replays are safe: see the "
@@ -617,6 +710,9 @@ def post_mortem(dumps: Dict[str, Any],
                          "stage_clocks": {f"s{s}": v["step"]
                                           for s, v in by_stage.items()},
                          "max_step": max_step},
+            "remediation": _remediation(
+                None, f"pipeline:{pipe}",
+                ("stopped_stages", "stage_clocks", "max_step")),
             "remedy": ("if a gang-death finding names the matching "
                        "member (stage k = host-k), this is its stage-"
                        "side shadow; otherwise the stage process "
@@ -640,6 +736,9 @@ def post_mortem(dumps: Dict[str, Any],
                         f"applying; the loss curve is intact"),
             "evidence": {"step": int(e.get("step", -1)),
                          "clocks": str(e.get("clocks", ""))},
+            "remediation": _remediation(
+                None, f"pipeline:{e.get('pipeline')}",
+                ("step", "clocks")),
             "remedy": ("none needed — this is the guard working; "
                        "repeated fires point at a lossy link between "
                        "driver and stages"),
@@ -660,6 +759,7 @@ def post_mortem(dumps: Dict[str, Any],
                 {"site": e.get("site"), "action": e.get("action"),
                  "ts": e.get("ts"), "source": e.get("source")}
                 for e in fires]},
+            "remediation": _remediation(None, "faultinject", ("fires",)),
             "remedy": ("expected under chaos testing; in production "
                        "this means a rules file is configured — check "
                        "RAY_TPU_FAULTINJECT_PATH"),
@@ -699,7 +799,7 @@ def render(findings: List[Dict[str, Any]]) -> str:
         return ("no failure signatures detected (checked: "
                 "rpc-backpressure, reconnect-storm, pubsub-lag, "
                 "ref-leak, heartbeat-rtt-outlier, controller-flapping, "
-                "orphan-replica, gang-hang, pipeline-stall)")
+                "orphan-replica, gang-hang, pipeline-stall, slo-burn)")
     lines = [f"{len(findings)} finding(s):", ""]
     for i, f in enumerate(findings, 1):
         lines.append(f"[{i}] {f['severity'].upper()} {f['signature']} "

@@ -189,7 +189,7 @@ def cmd_metrics(args) -> None:
     print(f"sources: {len(agg)} "
           f"({', '.join(sorted(agg)[:8])}{'…' if len(agg) > 8 else ''})")
     for plane in ("rpc", "objects", "pubsub", "control", "multihost",
-                  "pipeline"):
+                  "pipeline", "autopilot"):
         print(f"\n[{plane}]")
         for field, value in summary[plane].items():
             unit = _summary_unit(field)
@@ -239,9 +239,7 @@ def cmd_doctor(args) -> int:
             print(json.dumps(findings, indent=2, default=str))
         else:
             print(doctor.render_post_mortem(findings, dumps))
-        if findings and args.fail_on_findings:
-            return 2
-        return 0
+        return _findings_exit_code(findings, args.fail_on_findings)
     client = _client(args)
     before, after, nodes, interval = doctor.collect(client, args.interval)
     findings = doctor.diagnose(before, after, interval, nodes=nodes)
@@ -249,8 +247,58 @@ def cmd_doctor(args) -> int:
         print(json.dumps(findings, indent=2, default=str))
     else:
         print(doctor.render(findings))
-    if findings and args.fail_on_findings:
-        return 2
+    return _findings_exit_code(findings, args.fail_on_findings)
+
+
+def _findings_exit_code(findings: List[Dict[str, Any]],
+                        fail_on_findings: bool) -> int:
+    """Severity-aware gating: 0 = clean, 1 = warnings only, 2 = at
+    least one critical — so CI can gate on criticals (`!= 2`) without
+    a warning-class finding failing the build."""
+    if not (fail_on_findings and findings):
+        return 0
+    return 2 if any(f.get("severity") == "critical"
+                    for f in findings) else 1
+
+
+def cmd_autopilot(args) -> int:
+    """Inspect or exercise the closed-loop remediator (ray_tpu/
+    autopilot.py). ``--status`` prints the reconciler view (streaks,
+    buckets, audit ring, live taints); ``--dry-run`` runs ONE live
+    reconcile pass with mutations disabled and prints the actions that
+    WOULD have fired (fences still evaluated); ``--untaint NODE``
+    lifts a host demotion early (probe-gated — a host that still fails
+    its health probe stays tainted)."""
+    from ray_tpu.autopilot import Autopilot
+    from ray_tpu.core.config import config
+    from ray_tpu.core.rpc_stubs import ControllerStub
+
+    client = _client(args)
+    if args.untaint:
+        res = ControllerStub(client).untaint_host(args.untaint,
+                                                  probe=True)
+        print(json.dumps(res, indent=2, default=str))
+        return 0 if res.get("untainted") else 1
+    if args.dry_run:
+        old_enabled, old_dry = (config.autopilot_enabled,
+                                config.autopilot_dry_run)
+        config.autopilot_enabled = True
+        config.autopilot_dry_run = True
+        # Dry-run must see past the hysteresis damper — the point is
+        # "what would the autopilot do about THIS window".
+        old_hyst = config.autopilot_hysteresis_windows
+        config.autopilot_hysteresis_windows = 1
+        try:
+            pilot = Autopilot(client=client)
+            records = pilot.run_once(interval_s=args.interval)
+        finally:
+            config.autopilot_enabled = old_enabled
+            config.autopilot_dry_run = old_dry
+            config.autopilot_hysteresis_windows = old_hyst
+        print(json.dumps(records, indent=2, default=str))
+        return 0
+    pilot = Autopilot(client=client)
+    print(json.dumps(pilot.status(), indent=2, default=str))
     return 0
 
 
@@ -761,7 +809,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "(rates/growth need a window)")
     p_doc.add_argument("--json", action="store_true")
     p_doc.add_argument("--fail-on-findings", action="store_true",
-                       help="exit 2 when any signature is detected")
+                       help="exit 2 when a CRITICAL signature is "
+                            "detected, 1 for warnings only, 0 clean")
     p_doc.add_argument("--post-mortem", action="store_true",
                        help="explain a gang death / pipeline stall "
                             "from flight-recorder dumps instead of "
@@ -771,6 +820,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "dumps from this directory directly (no "
                             "cluster needed); default asks the "
                             "controller's fr_dump RPC")
+    p_ap = sub.add_parser("autopilot")
+    p_ap.add_argument("--status", action="store_true",
+                      help="print the reconciler view: streaks, "
+                           "token buckets, audit ring, live taints "
+                           "(default when no other flag given)")
+    p_ap.add_argument("--dry-run", action="store_true",
+                      help="run ONE reconcile pass with mutations "
+                           "disabled; print what WOULD have fired")
+    p_ap.add_argument("--untaint", default=None, metavar="NODE",
+                      help="lift a host demotion early (probe-gated)")
+    p_ap.add_argument("--interval", type=float, default=2.0,
+                      help="dry-run: seconds between the two metric "
+                           "snapshots")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default="timeline.json")
     p_tl.add_argument("--limit", type=int, default=10000)
@@ -839,6 +901,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd_metrics(args)
     elif args.command == "doctor":
         return cmd_doctor(args)
+    elif args.command == "autopilot":
+        return cmd_autopilot(args)
     elif args.command == "list":
         cmd_list(args)
     elif args.command == "timeline":

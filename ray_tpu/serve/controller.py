@@ -429,6 +429,82 @@ class ServeController:
         self._save_state()
         return version
 
+    # ------------------------------------------------- autopilot hooks
+
+    def autopilot_resize(self, deployment: str, delta: int = 1,
+                         epoch: int = 0) -> Dict[str, Any]:
+        """Autopilot's resize-deployment action (SLO burn). Fenced on
+        the serve-controller epoch the autopilot OBSERVED: a mismatch
+        means this plane restarted (and re-settled) since the evidence
+        was collected, so the action no-ops — the successor already
+        reconciled against fresh reality. Autoscaling deployments get
+        their floor raised (the autoscaler stays in charge of the rest);
+        fixed deployments get num_replicas bumped. The reconcile loop
+        settles toward the new target on its next tick."""
+        if self._fenced or int(epoch) != self._epoch:
+            return {"ok": False, "reason": "stale-epoch",
+                    "epoch": self._epoch}
+        with self._lock:
+            rec = self._deployments.get(deployment)
+        if rec is None or rec.deleting:
+            return {"ok": False, "reason": "unknown-deployment"}
+        return self._apply_resize(rec, delta)
+
+    def _apply_resize(self, rec: "DeploymentRecord",
+                      delta: int) -> Dict[str, Any]:
+        """The mutating half (checkpoint-obliged: every exit saves)."""
+        with rec.lock:
+            auto = rec.cfg.get("autoscaling")
+            if auto:
+                auto["min_replicas"] = max(1, min(
+                    int(auto.get("max_replicas", 1)),
+                    int(auto.get("min_replicas", 1)) + int(delta)))
+                target = auto["min_replicas"]
+            else:
+                rec.cfg["num_replicas"] = max(
+                    1, int(rec.cfg.get("num_replicas", 1)) + int(delta))
+                target = rec.cfg["num_replicas"]
+        self._save_state()
+        return {"ok": True, "target": target, "epoch": self._epoch}
+
+    def autopilot_shed(self, deployment: str, queue_max: int,
+                       epoch: int = 0) -> Dict[str, Any]:
+        """Autopilot's shed-tenant action (sustained rpc-backpressure):
+        tighten the deployment's admission cap so overload sheds at
+        enqueue (OverloadedError -> HTTP 503 + Retry-After — PR 3's
+        admission machinery) instead of queueing into minutes of
+        latency and backpressuring the control plane. Fenced like
+        autopilot_resize. The override persists in the deployment cfg
+        (checkpointed; re-applied to respawned replicas) until a
+        redeploy replaces the record."""
+        if self._fenced or int(epoch) != self._epoch:
+            return {"ok": False, "reason": "stale-epoch",
+                    "epoch": self._epoch}
+        with self._lock:
+            rec = self._deployments.get(deployment)
+        if rec is None or rec.deleting:
+            return {"ok": False, "reason": "unknown-deployment"}
+        return self._apply_shed(rec, queue_max)
+
+    def _apply_shed(self, rec: "DeploymentRecord",
+                    queue_max: int) -> Dict[str, Any]:
+        """The mutating half (checkpoint-obliged: every exit saves)."""
+        with rec.lock:
+            rec.cfg["queue_max_override"] = max(1, int(queue_max))
+            replicas = list(rec.replicas)
+        applied = 0
+        for r in replicas:
+            try:
+                r.handle.set_admission.remote(rec.cfg["queue_max_override"])
+                applied += 1
+            except Exception:
+                log_every("serve.autopilot_shed", 10.0, logger,
+                          "admission-cap push to replica %s failed",
+                          r.replica_id, exc_info=True)
+        self._save_state()
+        return {"ok": True, "queue_max": rec.cfg["queue_max_override"],
+                "replicas": applied, "epoch": self._epoch}
+
     def _target_replicas(self, rec: DeploymentRecord) -> int:
         auto = rec.cfg.get("autoscaling")
         if auto:
@@ -539,6 +615,17 @@ class ServeController:
             except Exception:
                 log_every("serve.set_topology", 10.0, logger,
                           "pushing sub-slice to replica %s failed",
+                          replica_id, exc_info=True)
+        if rec.cfg.get("queue_max_override"):
+            try:
+                # A live shed-tenant override outlives the replicas it
+                # was first pushed to: respawns get it too, or the heal
+                # path would quietly undo the admission clamp.
+                handle.set_admission.remote(
+                    int(rec.cfg["queue_max_override"]))
+            except Exception:
+                log_every("serve.set_admission", 10.0, logger,
+                          "pushing admission cap to replica %s failed",
                           replica_id, exc_info=True)
         return True
 

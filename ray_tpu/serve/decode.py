@@ -281,6 +281,9 @@ class DecodeEngine:
         if queue_max is None:
             queue_max = rt_config.decode_queue_max
         self.queue_max = int(queue_max) if queue_max else slots * 8
+        # The configured cap, kept so a runtime shed override
+        # (set_admission) can be lifted back to it.
+        self._default_queue_max = self.queue_max
         # request_id -> live request, for cancel(); guarded by _reqs_lock
         # (intake/cancel threads vs the decode loop).
         self._requests: Dict[str, _Request] = {}
@@ -969,6 +972,15 @@ class DecodeEngine:
         return True
 
     # ------------------------------------------------------------ intake
+
+    def set_admission(self, queue_max: Optional[int]) -> int:
+        """Runtime admission-cap override (the autopilot shed-tenant
+        action, via ReplicaActor.set_admission): requests past the new
+        cap shed at enqueue with OverloadedError. ``None``/``0``
+        restores the configured default. Returns the cap in effect."""
+        self.queue_max = (max(1, int(queue_max)) if queue_max
+                          else self._default_queue_max)
+        return self.queue_max
 
     def submit(self, prompt_tokens, max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: Optional[int] = None,

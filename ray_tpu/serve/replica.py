@@ -275,6 +275,23 @@ class ReplicaActor:
         if callable(fwd):
             fwd(assignment)
 
+    def set_admission(self, queue_max: int) -> bool:
+        """Admission-cap override from the serve controller (the
+        autopilot shed-tenant action): forwarded to the user instance's
+        ``set_admission`` when it implements one, else applied to a
+        hosted ``engine``'s ``queue_max`` directly. Returns whether
+        anything applied (a deployment with no bounded queue has
+        nothing to shed)."""
+        fwd = getattr(self._instance, "set_admission", None)
+        if callable(fwd):
+            fwd(int(queue_max))
+            return True
+        eng = getattr(self._instance, "engine", None)
+        if eng is not None and hasattr(eng, "queue_max"):
+            eng.queue_max = max(1, int(queue_max))
+            return True
+        return False
+
     def engine_timeline(self) -> Dict[str, Any]:
         """The hosted instance's step-timeline dump (empty for non-engine
         deployments): phase rows + page/compile events, merged by

@@ -87,6 +87,17 @@ def record(ev: str, **attrs: Any) -> None:
     _written += 1
 
 
+def audit(ev: str, **attrs: Any) -> Optional[str]:
+    """Durable append: :func:`record` + an immediate :func:`flush_now`.
+    For events that must survive the process dying right after the
+    decision they capture (the autopilot's control actions) — the
+    normal ring only reaches disk on the background flush cadence.
+    Returns the flushed path (None when the recorder is off or the dir
+    is unwritable); like ``record``, never raises."""
+    record(ev, **attrs)
+    return flush_now()
+
+
 def dump() -> List[Dict[str, Any]]:
     """This process's events, oldest first."""
     ring = _ring

@@ -547,6 +547,8 @@ def test_baseline_split_and_stale(tmp_path):
 
 # ------------------------------------------------------------------ CLI
 
+@pytest.mark.slow  # 9s: full-repo CLI run; the repo-clean property
+# stays via test_repo_is_clean_under_strict; PR 18 rebudget
 def test_cli_strict_clean_repo_and_list_rules(capsys):
     from ray_tpu.analysis.__main__ import main
 
@@ -556,6 +558,8 @@ def test_cli_strict_clean_repo_and_list_rules(capsys):
     assert main(["--rules", "no-such-rule"]) == 2
 
 
+@pytest.mark.slow  # 10s: full-repo CLI run; JSON shape stays via the
+# diff-mode CLI tests, repo-clean via the strict gate; PR 18 rebudget
 def test_cli_json_output(capsys):
     import json
 

@@ -560,6 +560,8 @@ def test_mutation_handler_signature_drift_caught():
 
 # ------------------------------------------------- generated stubs
 
+@pytest.mark.slow  # 9s: double full-repo stub gen; drift stays gated
+# via test_repo_clean_rpc_stubs + make lint's stubs-check; PR 18 rebudget
 def test_stub_generation_deterministic_and_current():
     project = Project.load(repo_root())
     a = stubgen.generate(CallGraph(project))
@@ -944,3 +946,91 @@ def test_handoff_lifetime_repo_clean():
             if f.path in ("ray_tpu/serve/decode.py",
                           "ray_tpu/serve/deployment.py",
                           "ray_tpu/serve/handoff.py")] == []
+
+
+# ------------------------------------- PR 18: autopilot action idiom
+
+
+def _run_autopilot_lint(project):
+    from ray_tpu.analysis import autopilot_lint
+
+    findings = autopilot_lint.check_project(project)
+    by_rel = {f.relpath: f for f in project.files}
+    return [f for f in findings
+            if not by_rel[f.path].suppressed(f.rule, f.line)]
+
+
+def test_autopilot_unpaired_action_tp():
+    """TP: an _act_* handler missing the fence, the audit, or both is
+    flagged with the missing call(s) named."""
+    project = project_at({"autopilot": """
+        class Autopilot:
+            def _act_no_audit(self, finding, epoch):
+                if not self._fence_ok("taint-host", True):
+                    return None
+                return self._do_it(finding)
+
+            def _act_no_fence(self, finding, epoch):
+                return self._audit(finding, "shed-tenant", "d",
+                                   "applied")
+
+            def _act_neither(self, finding, epoch):
+                return self._do_it(finding)
+        """})
+    findings = _run_autopilot_lint(project)
+    assert len(findings) == 3
+    assert all(f.rule == rules.AUTOPILOT_UNPAIRED for f in findings)
+    by_sym = {f.symbol.rsplit(".", 1)[-1]: f.message for f in findings}
+    assert "_audit" in by_sym["_act_no_audit"]
+    assert "_fence_ok" in by_sym["_act_no_fence"]
+    assert "_fence_ok" in by_sym["_act_neither"] \
+        and "_audit" in by_sym["_act_neither"]
+
+
+def test_autopilot_unpaired_action_tn():
+    """TN: paired handlers pass; helper methods without the action
+    prefix, module-level _act_-named functions (no class = not a
+    handler), other modules, and a pragma'd site are all quiet."""
+    project = project_at({"autopilot": """
+        class Autopilot:
+            def _act_good(self, finding, epoch):
+                if not self._fence_ok("reschedule-gang", True):
+                    return self._audit(finding, "reschedule-gang",
+                                       "g", "stale-epoch")
+                return self._audit(finding, "reschedule-gang", "g",
+                                   "applied")
+
+            def _decide(self, finding):
+                return self._handlers["taint-host"](finding)
+
+            # graftlint: disable=autopilot-unpaired-action (test fixture)
+            def _act_pragma(self, finding, epoch):
+                return None
+
+        def _act_free_function(finding):
+            return None
+        """, "other_module": """
+        class NotTheAutopilot:
+            def _act_elsewhere(self, finding):
+                return None
+        """})
+    assert _run_autopilot_lint(project) == []
+
+
+def test_mutation_autopilot_dropped_fence_caught():
+    """Mutation fixture: neutering the resize handler's fence check in
+    the REAL autopilot.py is caught statically."""
+    project = repo_project_with(
+        "ray_tpu/autopilot.py",
+        'if not self._fence_ok("resize-deployment",',
+        'if not (lambda *_a: True)("resize-deployment",')
+    findings = _run_autopilot_lint(project)
+    hits = [f for f in findings
+            if f.symbol.endswith("_act_resize_deployment")]
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert "_fence_ok" in hits[0].message
+
+
+def test_repo_clean_autopilot():
+    new = _clean_under([rules.AUTOPILOT_UNPAIRED])
+    assert new == [], "\n".join(f.render() for f in new)

@@ -200,6 +200,10 @@ def test_paged_matches_contiguous_across_boundaries():
         tb = jnp.argmax(lb, -1).astype(jnp.int32)
 
 
+@pytest.mark.slow  # 9s: exactness sweep; suffix-prefill exactness
+# stays via the slow-marked boundary sweep's siblings
+# (engine_paged_streams_match_contiguous, chunked bit-exact, sharded
+# suite's suffix-prefill rows); PR 18 rebudget
 def test_paged_suffix_prefill_token_exact():
     """Chunked continuation: prefill a prompt in two paged suffix calls
     and decode — token stream identical to the solo contiguous path."""
@@ -396,6 +400,8 @@ def test_paged_preemption_recovers_exact_streams():
 # --------------------------------------------- chunked-prefill fairness
 
 
+@pytest.mark.slow  # 6s: starvation soak; the chunked scheduler path
+# stays via chunked_prefill_stream_exact_and_ttft_counted; PR 18 rebudget
 def test_chunked_prefill_never_starves_active_slots():
     """The no-decode-starvation invariant, step-count based: while a
     long prompt chunk-prefills, EVERY active slot emits a token on

@@ -170,6 +170,8 @@ def test_appo_single_iteration(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow  # 10s: run-to-reward soak; APPO machinery stays via
+# test_appo_single_iteration, PPO soak stays in tier-1; PR 18 rebudget
 @pytest.mark.timeout_s(420)
 def test_appo_learns_cartpole(ray_start_regular):
     """Run-to-reward: async clipped-surrogate learning clearly beats the

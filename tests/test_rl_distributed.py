@@ -323,6 +323,8 @@ def test_mutation_shard_queue_unlocked_put_caught():
         f.render() for f in found)
 
 
+@pytest.mark.slow  # 6s: full-repo lock-family run; the strict repo
+# gate covers these files (see docstring); PR 18 rebudget
 def test_shard_queue_lock_idiom_clean_tn():
     """TN: the committed plane is clean under the lock families (the
     strict repo gate covers this too; this pins the specific files so a

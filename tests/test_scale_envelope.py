@@ -144,6 +144,9 @@ def test_actor_wave_across_nodes(ray_start_cluster):
         ray_tpu.kill(a)
 
 
+@pytest.mark.slow  # 6s: 100-actor surge soak; envelope stays via the
+# cross-node actor wave (the raylet storm is already marked);
+# PR 18 rebudget
 @pytest.mark.timeout_s(170)
 def test_actor_surge_forkserver(ray_start_regular):
     """A burst of 100 actors — the Serve-replica-surge shape — must come up
