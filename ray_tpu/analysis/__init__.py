@@ -1,6 +1,6 @@
 """graftlint: AST-based concurrency & trace-safety analysis for ray_tpu.
 
-Nine checker families fitted to this codebase's real failure modes
+Thirteen checker families fitted to this codebase's real failure modes
 (each rule is documented in docs/ANALYSIS.md):
 
 =====================  ==================================================
@@ -34,6 +34,22 @@ sharding-unscoped-trace  a sharded program (reaches ``constrain``)
                        jitted with sharding kwargs outside axis_rules
 rpc-stub-drift         core/rpc_stubs.py stale vs the handler index
                        (regenerate with ``--gen-stubs``)
+fence-result-ignored   a fenced write (kv_put_fenced / epoch publish /
+                       mh_group_put / pipe_step_complete) whose stale-
+                       epoch verdict is discarded, incl. through
+                       fence-carrier return chains
+unfenced-mutation-in-fenced-class  raw kv_put / epoch-less publish
+                       inside a class whose state is epoch-fenced
+epoch-compare-direction  a stored-clock comparison whose direction
+                       contradicts the table (equal-ok vs strict)
+epoch-not-threaded     fenced publish whose dict payload lacks the
+                       epoch/version key subscribers fence against
+donation-unguarded-dispatch  a donate_argnums program dispatched
+                       outside _dispatch_fresh (PR 14 reload footgun)
+donation-asarray-alias np.asarray over donated device state / dispatch
+                       results (PR 16 host-view clobber; use np.array)
+donation-read-after-donate  a local read again after being passed in a
+                       donated argument position
 =====================  ==================================================
 
 Run it: ``python -m ray_tpu.analysis [--strict] [--format json]
@@ -81,7 +97,8 @@ def _family_checks():
     (project_or_graph, emit_files=None): whole-program indexes are
     always built, but per-file emission work is skipped for files
     outside ``emit_files`` (the --diff fast path)."""
-    from ray_tpu.analysis import (autopilot_lint, guarded_by,
+    from ray_tpu.analysis import (autopilot_lint, donation_safety,
+                                  fence_safety, guarded_by,
                                   lifecycle_hygiene, lifetime,
                                   lock_discipline, metrics_lint,
                                   reactor_safety, rpc_contract,
@@ -99,6 +116,8 @@ def _family_checks():
         "rpc-stubs": (True, stubgen.check),
         "metrics": (False, metrics_lint.check_project),
         "autopilot": (False, autopilot_lint.check_project),
+        "fence-safety": (True, fence_safety.check),
+        "donation-aliasing": (True, donation_safety.check),
     }
 
 

@@ -31,6 +31,13 @@ METRICS_COLLISION = "metrics-name-collision"
 METRICS_CARDINALITY = "metrics-label-cardinality"
 CHECKPOINT_MISSING = "checkpoint-missing-save"
 AUTOPILOT_UNPAIRED = "autopilot-unpaired-action"
+FENCE_RESULT_IGNORED = "fence-result-ignored"
+FENCE_UNFENCED_MUTATION = "unfenced-mutation-in-fenced-class"
+FENCE_COMPARE_DIRECTION = "epoch-compare-direction"
+FENCE_EPOCH_NOT_THREADED = "epoch-not-threaded"
+DONATION_UNGUARDED = "donation-unguarded-dispatch"
+DONATION_ASARRAY_ALIAS = "donation-asarray-alias"
+DONATION_READ_AFTER_DONATE = "donation-read-after-donate"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -46,10 +53,14 @@ ALL_RULES = (
     METRICS_COLLISION, METRICS_CARDINALITY,
     CHECKPOINT_MISSING,
     AUTOPILOT_UNPAIRED,
+    FENCE_RESULT_IGNORED, FENCE_UNFENCED_MUTATION,
+    FENCE_COMPARE_DIRECTION, FENCE_EPOCH_NOT_THREADED,
+    DONATION_UNGUARDED, DONATION_ASARRAY_ALIAS,
+    DONATION_READ_AFTER_DONATE,
 )
 
-# The eleven checker families, for ``--jobs`` scheduling and per-family
-# stats: family name -> tuple of rule ids it emits.
+# The thirteen checker families, for ``--jobs`` scheduling and
+# per-family stats: family name -> tuple of rule ids it emits.
 FAMILIES = {
     "reactor-safety": (REACTOR_BLOCKING,),
     "trace-safety": (TRACE_HOST_SYNC, TRACE_PY_BRANCH, TRACE_RETRACE),
@@ -63,6 +74,10 @@ FAMILIES = {
     "rpc-stubs": (RPC_STUB_DRIFT,),
     "metrics": (METRICS_COLLISION, METRICS_CARDINALITY),
     "autopilot": (AUTOPILOT_UNPAIRED,),
+    "fence-safety": (FENCE_RESULT_IGNORED, FENCE_UNFENCED_MUTATION,
+                     FENCE_COMPARE_DIRECTION, FENCE_EPOCH_NOT_THREADED),
+    "donation-aliasing": (DONATION_UNGUARDED, DONATION_ASARRAY_ALIAS,
+                          DONATION_READ_AFTER_DONATE),
 }
 
 # ------------------------------------------------- blocking-API tables
@@ -447,3 +462,86 @@ FLIGHTREC_RECORD_FUNCS = (FLIGHTREC_RECORD_FUNC, "audit")
 FLIGHTREC_BOUNDED_ATTRS = frozenset({
     "step", "mb", "stage", "epoch", "asked", "mbs", "attempt", "hosts",
     "stages", "chips", "current", "n"})
+
+# ------------------------------------ v4: epoch-fence protocol (#12)
+
+# Fenced write APIs whose RESULT is the stale-epoch verdict: a caller
+# that discards it keeps acting as the owner after being deposed (the
+# split-brain the fencing exists to prevent). Matched by call tail
+# (stub methods and direct handler calls) and by the string form
+# ``client.call("<name>", ...)``. The autopilot's fenced actions ride
+# mh_group_put, so its handlers are covered by this same table (the
+# _fence_ok/_audit PAIRING is family #11's job).
+FENCED_WRITE_APIS = {
+    "kv_put_fenced": "False == stale epoch: the writer was deposed",
+    "mh_group_put": '{"ok": False, "reason": "stale_epoch"} == deposed',
+    "pipe_step_complete": '{"ok": False} == stale incarnation',
+}
+# Publish-shaped APIs are fenced ONLY when an epoch rides the call
+# (the hub treats epoch=None as an unfenced write — there is no stale
+# verdict to consume): name -> (epoch kwarg, its positional index).
+FENCED_WRITE_EPOCH_ARG = {
+    "publish": ("epoch", 4),
+    "psub_publish": ("epoch", 4),
+}
+# RPC verbs carrying the string form of a fenced write; ``notify`` is
+# fire-and-forget by design, so only result-returning verbs count.
+FENCED_RPC_VERBS = ("call",)
+
+# Classes whose controller-KV / pubsub state is epoch-fenced: every
+# mutating write from these classes must go through the fenced API
+# (kv_put_fenced / an epoch-carrying publish) — the raw spellings
+# listed here bypass the fence and re-open the PR 12 split-brain.
+# The core Controller itself (the KV owner) is deliberately absent:
+# it IS the fence.
+FENCED_STATE_CLASSES = {
+    "ServeController": ("kv_put", "kv_del"),
+    "Autopilot": ("kv_put", "kv_del"),
+}
+
+# Epoch/version comparison sites: (path, dotted suffix of the STORED
+# clock, mode). mode "equal-ok" = stale iff STRICTLY older (the
+# serve-snapshot rule: a same-epoch republish must be accepted — a
+# normalized ``incoming <= stored`` / ``incoming > stored`` guard
+# drops legitimate same-epoch writes); mode "strict" = strictly-newer
+# -wins (the WeightFanout/receiver rule: an equal version is a replay
+# — a normalized ``incoming < stored`` / ``incoming >= stored`` guard
+# re-applies it). Comparisons against literal constants are not
+# protocol checks and are ignored.
+EPOCH_COMPARE_TABLE = (
+    ("ray_tpu/core/controller.py", "current", "equal-ok"),
+    ("ray_tpu/core/multihost.py", "rec.epoch", "equal-ok"),
+    ("ray_tpu/core/pipereg.py", "rec.epoch", "equal-ok"),
+    ("ray_tpu/core/pubsub.py", "cur_epoch", "equal-ok"),
+    ("ray_tpu/serve/deployment.py", "self._ctrl_epoch", "equal-ok"),
+    ("ray_tpu/serve/controller.py", "self._epoch", "equal-ok"),
+    ("ray_tpu/rl/distributed/fanout.py", "self._version", "strict"),
+    ("ray_tpu/rl/distributed/fanout.py", "self._weights_version",
+     "strict"),
+    ("ray_tpu/rl/distributed/learner.py", "self._last_version",
+     "equal-ok"),
+)
+
+# Fenced publishes whose PAYLOAD must carry the clock: (class, call
+# tail) -> (payload positional index, required literal key). A
+# subscriber that cannot read the epoch/version out of the payload
+# cannot run its own staleness check (the router-snapshot idiom).
+# Only dict-literal payloads (direct or via a same-function local)
+# are checked — an opaque payload expression is not evidence.
+FENCED_PAYLOAD_RULES = {
+    ("ServeController", "psub_publish"): (2, "epoch"),
+    ("HostGroup", "mh_group_put"): (2, "epoch"),
+    ("WeightFanout", "psub_publish"): (2, "version"),
+}
+
+# --------------------------------- v4: donated-buffer aliasing (#13)
+
+# Guard wrappers a donated program's dispatch must flow through:
+# ``self._dispatch_fresh(key, lambda: self._prog(...))`` detaches the
+# persistent XLA cache on the FIRST dispatch (jaxlib 0.4.37, PR 14: a
+# donated executable reloaded from the disk cache segfaults or
+# returns wrong numbers). Dispatch inside the guard's own body is the
+# guard working, not a violation.
+DONATED_DISPATCH_GUARDS = ("_dispatch_fresh",)
+# Keyword spellings that mark a jit construction as donating.
+DONATION_JIT_KWARGS = ("donate_argnums", "donate")

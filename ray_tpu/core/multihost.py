@@ -990,9 +990,23 @@ class HostGroup:
                 reg = stub.mh_register_group(self.group_id,
                                              self.num_hosts,
                                              None, self._owner)
-                stub.mh_group_put(self.group_id, "reservation",
-                                  sub["reservation_id"],
-                                  int(reg["epoch"]))
+                # The fenced write's verdict matters even during
+                # formation: a stale epoch here means a concurrent
+                # re-registration already owns the group — spawning
+                # members against it would form a zombie gang. The
+                # verdict is consumed in test position and the raise
+                # message stays off ``reg``: assignment values and
+                # raise expressions transfer lease ownership to the
+                # lifetime checker, which would mask the
+                # _abort_formation leak edges (the docstring's
+                # subscript-only-read invariant).
+                if not (stub.mh_group_put(self.group_id, "reservation",
+                                          sub["reservation_id"],
+                                          int(reg["epoch"]))
+                        or {}).get("ok"):
+                    raise GroupEpochFenced(
+                        f"reservation write for group {self.group_id} "
+                        "rejected: a newer registration owns the epoch")
                 self._spawn_members_into(
                     members, int(reg["epoch"]), sub["reservation_id"],
                     sub["slice_id"], sub["nodes"], sub["origin"],

@@ -1471,14 +1471,15 @@ class DecodeEngine:
                 rows[i] = rows[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
                 bt[i] = bt[len(group) - 1]
-            self._mark_compile(("paged_prefill", n, bucket))
             self._prefill_waves += 1
             t0 = time.time()
-            logits, self.cache = self._paged_prefill(
-                self.params, self.cache, jnp.asarray(rows),
-                jnp.asarray(lengths), jnp.asarray(bt),
-                jnp.asarray(slot_ids), n=n, bucket=bucket)
-            logits = np.asarray(logits)
+            logits, self.cache = self._dispatch_fresh(
+                ("paged_prefill", n, bucket),
+                lambda: self._paged_prefill(
+                    self.params, self.cache, jnp.asarray(rows),
+                    jnp.asarray(lengths), jnp.asarray(bt),
+                    jnp.asarray(slot_ids), n=n, bucket=bucket))
+            logits = np.array(logits)
             self._wave_span("prefill", t0, group, n=len(group),
                             bucket=bucket)
             self._post_admit(group, [r.slot for r in group], logits)
@@ -1525,15 +1526,16 @@ class DecodeEngine:
                 plens[i] = plens[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
                 bt[i] = bt[len(group) - 1]
-            self._mark_compile(("paged_suffix", n, bucket, width))
             self._prefill_waves += 1
             t0 = time.time()
-            logits, self.cache = self._paged_suffix(
-                self.params, self.cache, jnp.asarray(rows),
-                jnp.asarray(plens), jnp.asarray(lengths),
-                jnp.asarray(bt), jnp.asarray(slot_ids),
-                n=n, bucket=bucket, width=width)
-            logits = np.asarray(logits)
+            logits, self.cache = self._dispatch_fresh(
+                ("paged_suffix", n, bucket, width),
+                lambda: self._paged_suffix(
+                    self.params, self.cache, jnp.asarray(rows),
+                    jnp.asarray(plens), jnp.asarray(lengths),
+                    jnp.asarray(bt), jnp.asarray(slot_ids),
+                    n=n, bucket=bucket, width=width))
+            logits = np.array(logits)
             self._wave_span("suffix-prefill", t0, group, n=len(group),
                             bucket=bucket)
             self._post_admit(group, [r.slot for r in group], logits)
@@ -1573,14 +1575,15 @@ class DecodeEngine:
         rows[0, :step_tok] = req.tokens[req.prefilled:
                                         req.prefilled + step_tok]
         bt = self._block_tables[slot:slot + 1, :width]
-        self._mark_compile(("paged_suffix", 1, bucket, width))
         t0 = time.time()
-        logits, self.cache = self._paged_suffix(
-            self.params, self.cache, jnp.asarray(rows),
-            jnp.asarray([req.prefilled], np.int32),
-            jnp.asarray([req.prefilled + step_tok], np.int32),
-            jnp.asarray(bt), jnp.asarray([slot], np.int32),
-            n=1, bucket=bucket, width=width)
+        logits, self.cache = self._dispatch_fresh(
+            ("paged_suffix", 1, bucket, width),
+            lambda: self._paged_suffix(
+                self.params, self.cache, jnp.asarray(rows),
+                jnp.asarray([req.prefilled], np.int32),
+                jnp.asarray([req.prefilled + step_tok], np.int32),
+                jnp.asarray(bt), jnp.asarray([slot], np.int32),
+                n=1, bucket=bucket, width=width))
         self.prefill_chunks += 1
         self._wave_span("prefill-chunk", t0, [req], tokens=step_tok,
                         prefilled=req.prefilled + step_tok,
@@ -1588,7 +1591,7 @@ class DecodeEngine:
         req.prefilled += step_tok
         if req.prefilled >= len(req.tokens):
             self._prefilling.pop(slot)
-            self._post_admit([req], [slot], np.asarray(logits))
+            self._post_admit([req], [slot], np.array(logits))
 
     def _retire(self, req: _Request, status: str) -> None:
         """Terminal exit for a request that never held a slot."""
@@ -1678,14 +1681,15 @@ class DecodeEngine:
             for i in range(len(group), n):  # idempotent pad rows
                 rows[i] = rows[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
-            self._mark_compile(("prefill", n, bucket))
             self._prefill_waves += 1
             t0 = time.time()
-            logits, self.cache = self._prefill_many(
-                self.params, self.cache, jnp.asarray(rows),
-                jnp.asarray(lengths), jnp.asarray(slot_ids),
-                n=n, bucket=bucket)
-            logits = np.asarray(logits)
+            logits, self.cache = self._dispatch_fresh(
+                ("prefill", n, bucket),
+                lambda: self._prefill_many(
+                    self.params, self.cache, jnp.asarray(rows),
+                    jnp.asarray(lengths), jnp.asarray(slot_ids),
+                    n=n, bucket=bucket))
+            logits = np.array(logits)
             self._wave_span("prefill", t0, group, n=len(group),
                             bucket=bucket)
             self._post_admit(group, slots, logits)
@@ -1725,15 +1729,17 @@ class DecodeEngine:
                 plens[i] = plens[len(group) - 1]
                 lengths[i] = lengths[len(group) - 1]
                 entries[i] = entries[len(group) - 1]
-            self._mark_compile(("suffix", n, bucket))
             self._prefill_waves += 1
             t0 = time.time()
-            logits, self.cache = self._prefill_suffix_many(
-                self.params, self.cache, self._pool["k"], self._pool["v"],
-                jnp.asarray(entries), jnp.asarray(slot_ids),
-                jnp.asarray(rows), jnp.asarray(plens),
-                jnp.asarray(lengths), n=n, bucket=bucket)
-            logits = np.asarray(logits)
+            logits, self.cache = self._dispatch_fresh(
+                ("suffix", n, bucket),
+                lambda: self._prefill_suffix_many(
+                    self.params, self.cache, self._pool["k"],
+                    self._pool["v"], jnp.asarray(entries),
+                    jnp.asarray(slot_ids), jnp.asarray(rows),
+                    jnp.asarray(plens), jnp.asarray(lengths),
+                    n=n, bucket=bucket))
+            logits = np.array(logits)
             self._wave_span("suffix-prefill", t0, group, n=len(group),
                             bucket=bucket)
             for req in group:
@@ -1790,9 +1796,12 @@ class DecodeEngine:
                                          matched_len=req.prefix_len)
                 if ins is not None:
                     row, _ins_len = ins
-                    self._pool["k"], self._pool["v"] = self._pool_insert(
-                        self.cache, self._pool["k"], self._pool["v"],
-                        slot, row)
+                    self._pool["k"], self._pool["v"] = \
+                        self._dispatch_fresh(
+                            ("pool_insert",),
+                            lambda: self._pool_insert(
+                                self.cache, self._pool["k"],
+                                self._pool["v"], slot, row))
         if self.spec:
             self._draft_seat([r for r in group if not r.done.is_set()])
 
@@ -1807,8 +1816,11 @@ class DecodeEngine:
         identical to the colocated path."""
         t0 = time.time()
         ids = np.asarray(self._slot_pages[slot], np.int32)
-        k = np.asarray(self.cache["k"][:, ids])
-        v = np.asarray(self.cache["v"][:, ids])
+        # np.array (never asarray): the payload outlives later donated
+        # dispatches, so it must OWN its bytes — a host view of the
+        # cache would be clobbered in place (the PR 16 pin).
+        k = np.array(self.cache["k"][:, ids])
+        v = np.array(self.cache["v"][:, ids])
         req.handoff = {
             "k": k, "v": v,
             "committed_len": int(req.prompt_len),
@@ -2096,17 +2108,21 @@ class DecodeEngine:
             chunk = min(chunk, self._pick_chunk())
         stepped = len(self._active)
         if chunk > 1:
-            self._mark_compile(("decode_k", chunk))
             t_d0 = time.time() if rec else 0.0
             if self.paged:
-                toks, self.cache = self._decode_k(
-                    self.params, self.cache, jnp.asarray(self._tokens),
-                    jnp.asarray(self._block_tables), k=chunk)
+                toks, self.cache = self._dispatch_fresh(
+                    ("decode_k", chunk),
+                    lambda: self._decode_k(
+                        self.params, self.cache,
+                        jnp.asarray(self._tokens),
+                        jnp.asarray(self._block_tables), k=chunk))
             else:
-                toks, self.cache = self._decode_k(
-                    self.params, self.cache, jnp.asarray(self._tokens),
-                    k=chunk)
-            toks = np.asarray(toks)  # (chunk, slots)
+                toks, self.cache = self._dispatch_fresh(
+                    ("decode_k", chunk),
+                    lambda: self._decode_k(
+                        self.params, self.cache,
+                        jnp.asarray(self._tokens), k=chunk))
+            toks = np.array(toks)  # (chunk, slots)
             if rec:
                 phases.append({"phase": "decode", "t0": t_d0,
                                "t1": time.time(), "batch": stepped,
@@ -2127,16 +2143,20 @@ class DecodeEngine:
             return stepped
         if self._device_sampler:
             return self._sampled_step(t_step0, phases, rec)
-        self._mark_compile(("decode",))
         t_d0 = time.time() if rec else 0.0
         if self.paged:
-            logits, self.cache = self._decode(
-                self.params, self.cache, jnp.asarray(self._tokens),
-                jnp.asarray(self._block_tables))
+            logits, self.cache = self._dispatch_fresh(
+                ("decode",),
+                lambda: self._decode(
+                    self.params, self.cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._block_tables)))
         else:
-            logits, self.cache = self._decode(
-                self.params, self.cache, jnp.asarray(self._tokens))
-        logits = np.asarray(logits)
+            logits, self.cache = self._dispatch_fresh(
+                ("decode",),
+                lambda: self._decode(
+                    self.params, self.cache,
+                    jnp.asarray(self._tokens)))
+        logits = np.array(logits)
         if rec:
             phases.append({"phase": "decode", "t0": t_d0,
                            "t1": time.time(), "batch": stepped, "k": 1})
@@ -2371,21 +2391,23 @@ class DecodeEngine:
             bt = jnp.asarray(self._block_tables)
             bucket = self.prefill_bucket
             wp = max(1, -(-bucket // self.page_tokens))
-            self._mark_compile(("paged_prefill", 1, bucket))
-            _, self.cache = self._paged_prefill(
-                self.params, self.cache,
-                jnp.zeros((1, bucket), jnp.int32),
-                jnp.asarray([0], jnp.int32),
-                jnp.asarray(self._block_tables[:1, :wp]),
-                jnp.asarray([0], jnp.int32), n=1, bucket=bucket)
-            self._mark_compile(("decode",))
-            _, self.cache = self._decode(self.params, self.cache, toks,
-                                         bt)
+            _, self.cache = self._dispatch_fresh(
+                ("paged_prefill", 1, bucket),
+                lambda: self._paged_prefill(
+                    self.params, self.cache,
+                    jnp.zeros((1, bucket), jnp.int32),
+                    jnp.asarray([0], jnp.int32),
+                    jnp.asarray(self._block_tables[:1, :wp]),
+                    jnp.asarray([0], jnp.int32), n=1, bucket=bucket))
+            _, self.cache = self._dispatch_fresh(
+                ("decode",),
+                lambda: self._decode(self.params, self.cache, toks, bt))
             c = 2
             while c <= self.decode_chunk:
-                self._mark_compile(("decode_k", c))
-                _, self.cache = self._decode_k(self.params, self.cache,
-                                               toks, bt, k=c)
+                _, self.cache = self._dispatch_fresh(
+                    ("decode_k", c),
+                    lambda: self._decode_k(self.params, self.cache,
+                                           toks, bt, k=c))
                 c *= 2
             if self._device_sampler:
                 _, self.cache = self._dispatch_fresh(
@@ -2410,13 +2432,15 @@ class DecodeEngine:
                 self._draft_cache["length"] = \
                     self._draft_cache["length"].at[:].set(0)
         else:
-            self._mark_compile(("decode",))
-            _, self.cache = self._decode(self.params, self.cache, toks)
+            _, self.cache = self._dispatch_fresh(
+                ("decode",),
+                lambda: self._decode(self.params, self.cache, toks))
             c = 2
             while c <= self.decode_chunk:
-                self._mark_compile(("decode_k", c))
-                _, self.cache = self._decode_k(self.params, self.cache,
-                                               toks, k=c)
+                _, self.cache = self._dispatch_fresh(
+                    ("decode_k", c),
+                    lambda: self._decode_k(self.params, self.cache,
+                                           toks, k=c))
                 c *= 2
             if self._device_sampler:
                 _, self.cache = self._dispatch_fresh(

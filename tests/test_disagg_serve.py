@@ -549,3 +549,26 @@ def test_prefill_sigkill_mid_handoff_no_leaked_refs(disagg_cluster):
     assert out is not None, "prefill fleet never healed after SIGKILL"
     assert out["tokens"] == want
     _handoffs_drained("dg-prefill")
+
+
+def test_handoff_payload_owns_its_bytes():
+    """Regression (the PR 16 pin, now lint-pinned by graftlint
+    donation-asarray-alias): the captured K/V handoff payload must OWN
+    its bytes. np.asarray would hand back a host VIEW of the paged
+    cache, and the engine's next donated dispatch would clobber a
+    payload already published to the object plane."""
+    cfg, params = _tiny()
+    pre = _paged(params, cfg)
+    r1 = pre.submit(list(range(1, 19)), max_new_tokens=4,
+                    prefill_only=True)
+    _drive(pre, [r1])
+    for key in ("k", "v"):
+        arr = r1.handoff[key]
+        assert isinstance(arr, np.ndarray)
+        assert arr.flags["OWNDATA"] and arr.base is None, key
+    # The payload survives further donated engine work verbatim.
+    k0 = r1.handoff["k"].copy()
+    r2 = pre.submit([7, 3, 11], max_new_tokens=4)
+    _drive(pre, [r2])
+    assert np.array_equal(k0, r1.handoff["k"])
+    pre.shutdown()

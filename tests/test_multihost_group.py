@@ -395,3 +395,62 @@ def test_doctor_names_gang_hang_straggler(mh_cluster):
                 if x["signature"] == "gang-hang"] == []
     finally:
         g.shutdown()
+
+
+# ------------------------------- formation fence verdict (no cluster)
+
+
+def test_form_aborts_when_reservation_write_is_fenced(monkeypatch):
+    """Regression (lint-pinned by graftlint fence-result-ignored): the
+    reservation write during formation is a FENCED group-KV write, and
+    its verdict must be honored. A stale-epoch rejection means a
+    concurrent re-registration already owns the group — spawning
+    members against it would form a zombie gang. The fenced refusal
+    must abort formation, release the sub-slice exactly once, drop the
+    half-registered group record, and spawn nothing."""
+    from ray_tpu.core import rpc_stubs
+    from ray_tpu.core.multihost import GroupEpochFenced
+
+    calls = []
+
+    class FencingStub:
+        def __init__(self, client):
+            pass
+
+        def topology_state(self):
+            return {"slices": {"s0": {"chips_per_host": 4}}}
+
+        def reserve_subslice(self, owner, chips):
+            calls.append(("reserve", chips))
+            return {"reservation_id": "res-1", "slice_id": "s0",
+                    "nodes": ["n0", "n1"], "origin": [0, 0],
+                    "shape": [4, 8]}
+
+        def mh_register_group(self, group_id, num_hosts, res, owner):
+            calls.append(("register", group_id))
+            return {"epoch": 3}
+
+        def mh_group_put(self, group_id, key, value, epoch):
+            calls.append(("put", key, epoch))
+            return {"ok": False, "reason": "stale_epoch", "epoch": 4}
+
+        def release_subslice(self, reservation_id):
+            calls.append(("release", reservation_id))
+            return True
+
+        def mh_drop_group(self, group_id):
+            calls.append(("drop", group_id))
+            return True
+
+    monkeypatch.setattr(multihost, "_controller_client", lambda: None)
+    monkeypatch.setattr(rpc_stubs, "ControllerStub", FencingStub)
+    g = HostGroup(2, chips_per_host=4, name="fenced-form")
+    with pytest.raises(GroupEpochFenced) as exc:
+        g._form()
+    assert "rejected" in str(exc.value)
+    # the fenced write happened at the observed epoch...
+    assert ("put", "reservation", 3) in calls
+    # ...and the abort path discharged BOTH leases, spawning nothing
+    assert ("release", "res-1") in calls
+    assert ("drop", "fenced-form") in calls
+    assert g._members == [] and g._sub is None and g._epoch == 0

@@ -325,3 +325,46 @@ def test_submit_rejects_over_capacity_budget():
         eng.step()
     assert req.done.is_set()
     assert len(req.output) == 29
+
+
+def test_every_compile_routes_through_dispatch_fresh(monkeypatch):
+    """Regression (the PR 14 pin, now lint-pinned by graftlint
+    donation-unguarded-dispatch): every donated program's FIRST
+    dispatch must run with the persistent XLA compile cache detached
+    (_dispatch_fresh), and only the first — later dispatches of the
+    same key hit the live jit cache with the disk cache reattached."""
+    import contextlib
+
+    from ray_tpu.serve import decode as decode_mod
+
+    detached = []
+    real = decode_mod._no_persistent_cache
+
+    @contextlib.contextmanager
+    def counting(jaxmod):
+        detached.append(1)
+        with real(jaxmod):
+            yield
+
+    monkeypatch.setattr(decode_mod, "_no_persistent_cache", counting)
+    cfg, params = _tiny()
+    eng = decode_mod.DecodeEngine(params, cfg, slots=2, capacity=64)
+    req = eng.submit([5, 9, 2], max_new_tokens=4)
+    for _ in range(30):
+        if req.done.is_set():
+            break
+        eng.step()
+    assert req.done.is_set()
+    # every compiled program key detached the cache exactly once
+    assert eng._compiled and len(detached) == len(eng._compiled)
+    n = len(detached)
+    # a same-bucket request re-dispatches every program: no new
+    # compiles, no new detaches
+    req2 = eng.submit([7, 1, 3], max_new_tokens=4)
+    for _ in range(30):
+        if req2.done.is_set():
+            break
+        eng.step()
+    assert req2.done.is_set()
+    assert len(detached) == n == len(eng._compiled)
+    eng.shutdown()
