@@ -1,6 +1,6 @@
 # Developer entry points. Tier-1 CI runs `make lint` semantics via
 # tests/test_analysis.py::test_repo_is_clean_under_strict (+ the
-# v2/v3/v4 per-family gates and the stub-drift gate in
+# v2/v3/v4/v5 per-family gates and the stub-drift gate in
 # tests/test_analysis_v3.py).
 
 .PHONY: lint lint-diff lint-stats lint-stubs-check gen-stubs test \
@@ -9,7 +9,7 @@
 	bench-disagg
 
 # The full gate: regenerate-and-diff the typed RPC stubs, then the
-# strict 13-family run WITH the stats.json refresh folded in (one
+# strict 14-family run WITH the stats.json refresh folded in (one
 # analysis pass serves both; a drifted stats artifact shows up as a
 # dirty tree, same as drifted stubs).
 lint: lint-stubs-check

@@ -1,6 +1,6 @@
 """graftlint: AST-based concurrency & trace-safety analysis for ray_tpu.
 
-Thirteen checker families fitted to this codebase's real failure modes
+Fourteen checker families fitted to this codebase's real failure modes
 (each rule is documented in docs/ANALYSIS.md):
 
 =====================  ==================================================
@@ -50,6 +50,20 @@ donation-asarray-alias np.asarray over donated device state / dispatch
                        results (PR 16 host-view clobber; use np.array)
 donation-read-after-donate  a local read again after being passed in a
                        donated argument position
+unbounded-blocking-call  Event.wait()/Queue.get()/join()/result()/socket
+                       recv with no finite bound, reachable from any
+                       thread entry point (RPC handlers, Thread/Timer
+                       targets, executor submits — the silent hang)
+rpc-call-no-timeout    control-plane .call("x")/stub sites without
+                       timeout= (timeout=None parks forever)
+deadline-not-propagated  a timeout_s/deadline parameter handed raw to
+                       2+ blocking calls (or dropped) instead of a
+                       util.deadline.Deadline remaining-time budget
+retry-unbounded        while-True dial/RPC loops with no backoff,
+                       attempt bound, or deadline (reconnect storm)
+timeout-knob-dead      a *_timeout_s config knob no code ever reads
+stale-pragma           a ``# graftlint:`` pragma suppressing nothing
+                       (computed centrally on full runs)
 =====================  ==================================================
 
 Run it: ``python -m ray_tpu.analysis [--strict] [--format json]
@@ -97,12 +111,13 @@ def _family_checks():
     (project_or_graph, emit_files=None): whole-program indexes are
     always built, but per-file emission work is skipped for files
     outside ``emit_files`` (the --diff fast path)."""
-    from ray_tpu.analysis import (autopilot_lint, donation_safety,
-                                  fence_safety, guarded_by,
-                                  lifecycle_hygiene, lifetime,
-                                  lock_discipline, metrics_lint,
-                                  reactor_safety, rpc_contract,
-                                  sharding_safety, stubgen, trace_safety)
+    from ray_tpu.analysis import (autopilot_lint, deadline_safety,
+                                  donation_safety, fence_safety,
+                                  guarded_by, lifecycle_hygiene,
+                                  lifetime, lock_discipline,
+                                  metrics_lint, reactor_safety,
+                                  rpc_contract, sharding_safety,
+                                  stubgen, trace_safety)
 
     return {
         "reactor-safety": (True, reactor_safety.check),
@@ -118,7 +133,47 @@ def _family_checks():
         "autopilot": (False, autopilot_lint.check_project),
         "fence-safety": (True, fence_safety.check),
         "donation-aliasing": (True, donation_safety.check),
+        "deadline-safety": (True, deadline_safety.check),
     }
+
+
+def _stale_pragma_findings(project: Project,
+                           raw: List[Finding]) -> List[Finding]:
+    """One finding per ``# graftlint: disable=...`` comment that no
+    longer suppresses anything: none of the rules it names has a raw
+    finding on a line the pragma covers (its own line, plus the next
+    code line for standalone comments). A pragma naming an unknown
+    rule is stale by definition — it can never fire."""
+    by_path: Dict[str, Dict[int, set]] = {}
+    for f in raw:
+        by_path.setdefault(f.path, {}).setdefault(
+            f.line, set()).add(f.rule)
+    known = set(rules.ALL_RULES)
+    out: List[Finding] = []
+    for sf in project.files:
+        lines = by_path.get(sf.relpath, {})
+        for row, names, covered in sf.pragma_sites:
+            hit = False
+            for cov in covered:
+                found = lines.get(cov, set())
+                if any((n == "all" and found)
+                       or (n in known and n in found)
+                       for n in names):
+                    hit = True
+                    break
+            if hit:
+                continue
+            unknown = sorted(n for n in names
+                             if n != "all" and n not in known)
+            why = (f"names unknown rule(s) {', '.join(unknown)}"
+                   if unknown else "suppresses no live finding")
+            out.append(Finding(
+                rule=rules.STALE_PRAGMA, path=sf.relpath, line=row,
+                symbol="",
+                message=f"pragma disable={','.join(sorted(names))} "
+                        f"{why}; delete it (stale suppressions hide "
+                        f"future regressions)"))
+    return out
 
 
 def _run_family(name: str) -> Tuple[str, List[Finding], float]:
@@ -189,10 +244,18 @@ def run_analysis(root: Optional[str] = None,
         per_rule[name] = dt
 
     findings = [f for f in findings if f.rule in selected]
+    # Stale-pragma hygiene, computed centrally on FULL runs only (a
+    # family-selected or path/diff-sliced run does not see every rule's
+    # raw findings, so pragma liveness would read falsely stale there).
+    # Uses pre-suppression findings: a pragma is live exactly when it
+    # suppresses >= 1 finding some family would otherwise emit.
+    stale: List[Finding] = []
+    if select is None and paths is None and emit_files is None:
+        stale = _stale_pragma_findings(project, findings)
     # per-rule counts BEFORE pragma suppression (the --stats-json
     # trajectory tracks total analyzer debt, suppressed or not)
     raw_counts: Dict[str, int] = {}
-    for f in findings:
+    for f in findings + stale:
         raw_counts[f.rule] = raw_counts.get(f.rule, 0) + 1
     if paths:
         prefixes = tuple(p.rstrip("/") for p in paths)
@@ -200,11 +263,13 @@ def run_analysis(root: Optional[str] = None,
                     if any(f.path == p or f.path.startswith(p + "/")
                            or f.path.startswith(p)
                            for p in prefixes)]
-    # pragma suppression
+    # pragma suppression (stale-pragma findings join afterwards: a
+    # pragma must never be able to suppress its own staleness verdict)
     by_rel = {f.relpath: f for f in project.files}
     findings = [f for f in findings
                 if not (f.path in by_rel
                         and by_rel[f.path].suppressed(f.rule, f.line))]
+    findings += stale
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     assign_fingerprints(findings)
 

@@ -65,26 +65,35 @@ class SourceFile:
             mod = mod[: -len("/__init__")]
         self.module = mod.replace("/", ".")
         # line -> set of disabled rule names ("all" disables everything)
-        self.pragmas: Dict[int, Set[str]] = _extract_pragmas(text)
+        self.pragmas, self.pragma_sites = _extract_pragmas(text)
 
     def suppressed(self, rule: str, line: int) -> bool:
         rules = self.pragmas.get(line)
         return bool(rules) and ("all" in rules or rule in rules)
 
 
-def _extract_pragmas(text: str) -> Dict[int, Set[str]]:
+def _extract_pragmas(text: str) -> Tuple[Dict[int, Set[str]],
+                                         List[Tuple[int, frozenset,
+                                                    Tuple[int, ...]]]]:
+    """-> (line -> disabled rules, pragma sites). A site is ONE pragma
+    comment: (its own row, the rules it names, the code rows it covers
+    — its row plus, for standalone comments, the next code row). Sites
+    feed the stale-pragma liveness check: a pragma none of whose
+    covered rows carries a live finding of a named rule is dead weight
+    and fails strict."""
     out: Dict[int, Set[str]] = {}
+    sites: List[Tuple[int, frozenset, Tuple[int, ...]]] = []
     standalone: List[Tuple[int, Set[str]]] = []
     code_rows: Set[int] = set()
     # Fast path: tokenizing every file dominates parse time, and most
     # files carry no pragma at all — a substring probe is enough to skip
     # them (a false hit here just pays the tokenize).
     if "graftlint" not in text:
-        return out
+        return out, sites
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
     except tokenize.TokenError:
-        return out
+        return out, sites
     for tok in tokens:
         if tok.type == tokenize.COMMENT:
             m = PRAGMA_RE.search(tok.string)
@@ -95,6 +104,8 @@ def _extract_pragmas(text: str) -> Dict[int, Set[str]]:
             out.setdefault(row, set()).update(rules)
             if tok.line[: tok.start[1]].strip() == "":
                 standalone.append((row, rules))
+            else:
+                sites.append((row, frozenset(rules), (row,)))
         elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
                               tokenize.INDENT, tokenize.DEDENT,
                               tokenize.ENDMARKER):
@@ -105,7 +116,9 @@ def _extract_pragmas(text: str) -> Dict[int, Set[str]]:
         nxt = min((r for r in code_rows if r > row), default=None)
         if nxt is not None:
             out.setdefault(nxt, set()).update(rules)
-    return out
+        sites.append((row, frozenset(rules),
+                      (row,) if nxt is None else (row, nxt)))
+    return out, sites
 
 
 class Project:

@@ -38,6 +38,14 @@ FENCE_EPOCH_NOT_THREADED = "epoch-not-threaded"
 DONATION_UNGUARDED = "donation-unguarded-dispatch"
 DONATION_ASARRAY_ALIAS = "donation-asarray-alias"
 DONATION_READ_AFTER_DONATE = "donation-read-after-donate"
+DEADLINE_UNBOUNDED = "unbounded-blocking-call"
+DEADLINE_RPC_NO_TIMEOUT = "rpc-call-no-timeout"
+DEADLINE_NOT_PROPAGATED = "deadline-not-propagated"
+DEADLINE_RETRY_UNBOUNDED = "retry-unbounded"
+DEADLINE_KNOB_DEAD = "timeout-knob-dead"
+# Not a family rule: emitted centrally by run_analysis on full runs
+# (pragma liveness needs EVERY family's raw findings).
+STALE_PRAGMA = "stale-pragma"
 
 ALL_RULES = (
     REACTOR_BLOCKING,
@@ -57,10 +65,16 @@ ALL_RULES = (
     FENCE_COMPARE_DIRECTION, FENCE_EPOCH_NOT_THREADED,
     DONATION_UNGUARDED, DONATION_ASARRAY_ALIAS,
     DONATION_READ_AFTER_DONATE,
+    DEADLINE_UNBOUNDED, DEADLINE_RPC_NO_TIMEOUT,
+    DEADLINE_NOT_PROPAGATED, DEADLINE_RETRY_UNBOUNDED,
+    DEADLINE_KNOB_DEAD,
+    STALE_PRAGMA,
 )
 
-# The thirteen checker families, for ``--jobs`` scheduling and
+# The fourteen checker families, for ``--jobs`` scheduling and
 # per-family stats: family name -> tuple of rule ids it emits.
+# (STALE_PRAGMA is absent by design: pragma liveness is computed in
+# run_analysis itself, over every family's pre-suppression findings.)
 FAMILIES = {
     "reactor-safety": (REACTOR_BLOCKING,),
     "trace-safety": (TRACE_HOST_SYNC, TRACE_PY_BRANCH, TRACE_RETRACE),
@@ -78,6 +92,9 @@ FAMILIES = {
                      FENCE_COMPARE_DIRECTION, FENCE_EPOCH_NOT_THREADED),
     "donation-aliasing": (DONATION_UNGUARDED, DONATION_ASARRAY_ALIAS,
                           DONATION_READ_AFTER_DONATE),
+    "deadline-safety": (DEADLINE_UNBOUNDED, DEADLINE_RPC_NO_TIMEOUT,
+                        DEADLINE_NOT_PROPAGATED,
+                        DEADLINE_RETRY_UNBOUNDED, DEADLINE_KNOB_DEAD),
 }
 
 # ------------------------------------------------- blocking-API tables
@@ -545,3 +562,93 @@ FENCED_PAYLOAD_RULES = {
 DONATED_DISPATCH_GUARDS = ("_dispatch_fresh",)
 # Keyword spellings that mark a jit construction as donating.
 DONATION_JIT_KWARGS = ("donate_argnums", "donate")
+
+# -------------------------------------- v5: deadline safety (#20)
+
+# Wait verbs the unbounded-blocking-call rule polices, with where their
+# finite bound lives: verb -> (timeout kwarg name, its positional
+# index, label). Bounded = that argument is present and is not the
+# literal ``None`` (a Name/attribute/call expression counts as a bound
+# — config knobs are floats and ``Deadline.remaining()`` never returns
+# a forever value for a bounded deadline). ``get`` is checked only on
+# stdlib-queue-typed receivers (DEADLINE_QUEUE_CTORS): bare ``.get``
+# is dict/contextvar territory.
+DEADLINE_WAIT_VERBS = {
+    "wait": ("timeout", 0, "unbounded wait"),
+    "join": ("timeout", 0, "unbounded join"),
+    "result": ("timeout", 0, "unbounded future wait"),
+    "get": ("timeout", 1, "unbounded queue get"),
+}
+# For ``get`` only: a literal-False first positional / ``block=False``
+# makes the call non-blocking, which is as bounded as it gets.
+DEADLINE_NONBLOCK_KWARG = "block"
+# Queue constructors that type a local / self-attribute as a blocking
+# queue for the ``get`` verb (dotted, import-resolved). The in-repo
+# util.queue twins keep the stdlib signature, so the same timeout
+# position applies.
+DEADLINE_QUEUE_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "multiprocessing.Queue",
+    "ray_tpu.util.queue.Queue", "ray_tpu.util.queue.ShardedQueue",
+}
+# Socket read verbs: no timeout argument exists — the bound is
+# ``settimeout``/``setblocking`` on the socket. A module that calls
+# either anywhere manages its own socket modes (the reactor's
+# nonblocking fds, _connect's bounded dial); flagged only when the
+# enclosing MODULE shows neither.
+DEADLINE_SOCKET_VERBS = ("recv", "recv_into")
+DEADLINE_SOCKET_MODE_CALLS = ("settimeout", "setblocking")
+
+# rpc-call-no-timeout scope: control-plane modules where every literal
+# ``.call("name", ...)`` / typed-stub call must carry ``timeout=`` (or
+# the documented config default below). Data-plane and long-poll
+# surfaces (pubsub subscribe parks, object-plane streams) are
+# deliberately out of scope: their unbounded waits are the design, and
+# rule 1 still covers their thread entries.
+DEADLINE_RPC_SCOPE_PREFIXES = (
+    "ray_tpu/core/multihost.py",
+    "ray_tpu/core/pipereg.py",
+    "ray_tpu/serve/controller.py",
+    "ray_tpu/serve/proxy.py",
+    "ray_tpu/serve/deployment.py",
+    "ray_tpu/serve/handoff.py",
+    "ray_tpu/train/pipeline_plane.py",
+    "ray_tpu/autopilot.py",
+)
+# Parameters NAMED as stubs are stub-typed receivers too: helpers that
+# take the constructed stub (``def _abort_formation(self, stub, ...)``)
+# make the same control-plane calls as their caller.
+DEADLINE_STUB_PARAM_NAMES = ("stub",)
+DEADLINE_STUB_PARAM_SUFFIX = "_stub"
+# Timeout-default documentation: config knob -> the wait sites it is
+# expected to bound (module path prefix, call tail). Doubles as the
+# dead-knob cross-check's allowlist of intent — a ``*_timeout_s`` knob
+# in core/config.py that no package code ever READS (no
+# ``config.<knob>`` attribute access) is flagged timeout-knob-dead,
+# mirroring rpc-dead-endpoint.
+DEADLINE_KNOB_SUFFIX = "_timeout_s"
+DEADLINE_CONFIG_MODULE_PATH = "ray_tpu/core/config.py"
+DEADLINE_CONFIG_FLAGS_NAME = "_FLAG_DEFS"
+
+# deadline-not-propagated: parameter names that carry a caller's time
+# budget. A function taking one and making 2+ deadline-relevant calls
+# (wait verbs / scoped RPC) must show a remaining-time idiom —
+# ``Deadline`` usage (DEADLINE_IDIOM_ATTRS / the helper module) or raw
+# ``time.monotonic()`` arithmetic. Exactly ONE downstream site
+# consuming the budget is a pass-through, not a violation
+# (RpcClient.call -> pending.wait(timeout) is the exemplar).
+DEADLINE_PARAM_NAMES = ("timeout_s", "timeout", "deadline",
+                        "deadline_s", "timeout_seconds")
+DEADLINE_IDIOM_ATTRS = ("remaining", "expired", "sub")
+DEADLINE_IDIOM_DOTTED = ("time.monotonic",)
+DEADLINE_HELPER_MODULE = "ray_tpu.util.deadline"
+
+# retry-unbounded: an unconditionally-true loop (``while True`` /
+# ``itertools.count``) re-issuing dial/RPC verbs with no bounding
+# signal in the body. Bounding signals (any one suffices): a backoff
+# sleep, an attempt counter compared in body or loop test, a deadline
+# check (DEADLINE_IDIOM_ATTRS / time.monotonic), or a non-constant
+# loop test. The PR 12 reconnect storm, caught statically.
+DEADLINE_RETRY_VERBS = ("call", "notify", "create_connection",
+                        "connect", "dial")
+DEADLINE_BACKOFF_CALLS = ("sleep", "backoff", "uniform")

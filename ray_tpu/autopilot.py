@@ -230,7 +230,8 @@ class Autopilot:
                 # graftlint: disable=unfenced-mutation-in-fenced-class (append-only audit record under a per-process monotonic key — nothing to fence; the ACTION's fencing rides the handler's mh_group_put)
                 ControllerStub(self._client()).kv_put(
                     key, json.dumps(rec, default=str).encode(),
-                    overwrite=True)
+                    overwrite=True,
+                    timeout=config.ctrl_call_timeout_s)
             except Exception:
                 log_every("autopilot.audit_kv", 30.0, logger,
                           "audit KV append failed (flightrec record "
@@ -339,7 +340,8 @@ class Autopilot:
         prefix = str(finding["remediation"]["target"])
         node_hex, alive = None, False
         try:
-            for n in ControllerStub(self._client()).list_nodes():
+            for n in ControllerStub(self._client()).list_nodes(
+                    timeout=config.ctrl_call_timeout_s):
                 if str(n.get("node_id", "")).startswith(prefix):
                     node_hex, alive = n["node_id"], bool(n.get("alive"))
                     break
@@ -353,7 +355,8 @@ class Autopilot:
                                reason="node-gone-or-replaced")
         if config.autopilot_dry_run:
             return self._audit(finding, "taint-host", node_hex, "dry-run")
-        res = ControllerStub(self._client()).taint_host(node_hex)
+        res = ControllerStub(self._client()).taint_host(
+            node_hex, timeout=config.ctrl_call_timeout_s)
         return self._audit(finding, "taint-host", node_hex, "applied",
                            detail=dict(res or {}))
 
@@ -372,7 +375,8 @@ class Autopilot:
                      or (ev.get("stragglers") or [""])[0])
         state = None
         try:
-            state = ControllerStub(self._client()).mh_group_state(group)
+            state = ControllerStub(self._client()).mh_group_state(
+                group, timeout=config.ctrl_call_timeout_s)
         except Exception as exc:
             return self._audit(finding, "reschedule-gang", group,
                                "failed", reason=f"group_state: {exc}")
@@ -395,7 +399,8 @@ class Autopilot:
                                "dry-run", epoch=epoch,
                                detail={"victim": victim})
         res = ControllerStub(self._client()).mh_group_put(
-            group, "autopilot_evict", victim, epoch)
+            group, "autopilot_evict", victim, epoch,
+            timeout=config.ctrl_call_timeout_s)
         if not (res or {}).get("ok"):
             # The registry's fence fired between observation and write:
             # the gang re-registered under a newer epoch — it healed
@@ -499,7 +504,8 @@ class Autopilot:
         epoch = doctor_mod._max_controller_epoch(after)
         post: List[Dict[str, Any]] = []
         try:
-            dumps = ControllerStub(client).fr_dump()
+            dumps = ControllerStub(client).fr_dump(
+                timeout=config.ctrl_call_timeout_s)
             post = doctor_mod.post_mortem(dumps or {})
         except Exception:
             log_every("autopilot.fr_dump", 30.0, logger,
@@ -552,7 +558,8 @@ class Autopilot:
                 "audit": list(self._audits),
             }
         try:
-            out["taints"] = ControllerStub(self._client()).taint_state()
+            out["taints"] = ControllerStub(self._client()).taint_state(
+                timeout=config.ctrl_call_timeout_s)
         except Exception:
             out["taints"] = {}
         return out

@@ -140,7 +140,7 @@ def shutdown() -> None:
             if _local_cluster is not None and \
                     _local_cluster[1].log_monitor is not None:
                 _local_cluster[1].log_monitor.scan_once()
-            _log_streamer.poll_once(timeout=0.2)
+            _log_streamer.poll_once(window_s=0.2)
         except Exception:  # graftlint: disable=swallowed-exception (final log drain at shutdown)
             pass
         _log_streamer.stop()

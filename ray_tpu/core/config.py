@@ -354,6 +354,16 @@ _FLAG_DEFS: Dict[str, tuple] = {
         "member's group epoch; a 'fenced' reply is how a zombie member "
         "of a deposed gang incarnation learns to stop touching group "
         "state."),
+    "ctrl_call_timeout_s": (float, 30.0,
+        "Transport bound on one-shot control-plane RPCs (gang registry "
+        "reads/writes, lease release, taints, serve controller state "
+        "saves, autopilot actions). The client treats timeout=None as "
+        "park-forever, so every such call carries this instead: a "
+        "dropped reply becomes a typed TimeoutError the caller's "
+        "retry/refusal logic handles, never a silent distributed hang "
+        "(graftlint rpc-call-no-timeout). Long-polls (barriers, pubsub "
+        "watches) are NOT governed by this — they carry their own "
+        "window-derived bounds."),
     "mh_monitor_period_s": (float, 0.3,
         "Period of the HostGroup driver-side monitor pinging every gang "
         "member. One failed member reconciles the WHOLE group (kill all, "

@@ -27,6 +27,11 @@ logger = logging.getLogger(__name__)
 
 LOG_CHANNEL = "logs"
 
+# Transport slack ABOVE the long-poll window on every streamer RPC: the
+# bound that turns a dead head into a typed RpcTimeout (the _loop's
+# catch-and-backoff path) instead of a silently parked log pump.
+_RPC_SLACK_S = 10.0
+
 
 def worker_log_paths(node_hex: str, worker_hex: str) -> Tuple[str, str]:
     d = os.path.join(config.worker_log_dir, node_hex)
@@ -149,16 +154,22 @@ class LogStreamer:
     def _loop(self) -> None:
         while not self._stopped.is_set():
             try:
-                self.poll_once(timeout=5.0)
+                self.poll_once(window_s=5.0)
             except Exception:
                 if self._stopped.wait(1.0):
                     return
 
-    def poll_once(self, timeout: float = 5.0) -> int:
-        """One long-poll round; returns number of lines printed. Key
-        discovery is version-only (psub_keys) — window payloads transfer
-        only for keys that actually advanced."""
-        keymap = self._controller.call("psub_keys", LOG_CHANNEL)
+    def poll_once(self, window_s: float = 5.0) -> int:
+        """One long-poll round; returns number of lines printed.
+        ``window_s`` is the server-side long-poll WINDOW (how long the
+        head may hold the poll open waiting for new lines), not a call
+        budget — each RPC below carries a transport bound of the window
+        plus slack, so a dead head surfaces as a typed timeout rather
+        than a parked streamer. Key discovery is version-only
+        (psub_keys) — window payloads transfer only for keys that
+        actually advanced."""
+        keymap = self._controller.call("psub_keys", LOG_CHANNEL,
+                                       timeout=window_s + _RPC_SLACK_S)
         printed = 0
         behind = {key: ver for key, ver in keymap.items()
                   if ver > self._versions.get(key, 0)}
@@ -168,20 +179,20 @@ class LogStreamer:
             updates = self._controller.call(
                 "psub_poll_many",
                 {k: (LOG_CHANNEL, k, v - 1) for k, v in behind.items()},
-                0.5, timeout=10.0)
+                0.5, timeout=window_s + _RPC_SLACK_S)
             for key, (version, value) in (updates or {}).items():
                 printed += self._emit(key, value)
                 self._versions[key] = version
         if not keymap:
             # No node has published logs yet; re-check soon rather than
             # sleeping a full long-poll period (first-line latency).
-            self._stopped.wait(min(timeout, 1.0))
+            self._stopped.wait(min(window_s, 1.0))
             return printed
         watches = {key: (LOG_CHANNEL, key, self._versions.get(key, 0))
                    for key in keymap}
         updates = self._controller.call(
-            "psub_poll_many", watches, timeout,
-            timeout=timeout + 10.0)
+            "psub_poll_many", watches, window_s,
+            timeout=window_s + _RPC_SLACK_S)
         for key, (version, value) in (updates or {}).items():
             printed += self._emit(key, value)
             self._versions[key] = version

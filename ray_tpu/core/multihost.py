@@ -409,9 +409,7 @@ class GroupRegistry:
         cm.MH_GROUPS.set(float(n))
         for g, m, ep, entered in rows:
             # See _zero_entered for the cardinality justification.
-            # graftlint: disable=metrics-label-cardinality
             cm.MH_MEMBER_EPOCH.set(ep, tags={"group": g, "member": m})
-            # graftlint: disable=metrics-label-cardinality
             cm.MH_BARRIER_ENTERED.set(entered,
                                       tags={"group": g, "member": m})
 
@@ -536,21 +534,25 @@ def register_gang(num_members: int, *, group_id: Optional[str] = None,
     """Register a host group with the controller; returns
     ``(group_id, epoch)``. Re-registering an existing id bumps the
     epoch (restart/re-election fencing)."""
+    from ray_tpu.core.config import config
     from ray_tpu.core.rpc_stubs import ControllerStub
 
     gid = group_id or f"gang-{uuid.uuid4().hex[:8]}"
     reg = ControllerStub(_controller_client()).mh_register_group(
-        gid, num_members, reservation_id, owner)
+        gid, num_members, reservation_id, owner,
+        timeout=config.ctrl_call_timeout_s)
     return gid, reg["epoch"]
 
 
 def drop_gang(group_id: str) -> bool:
     """Unregister a group (idempotent, best-effort: a head blip here
     only leaves a record the next re-registration recycles)."""
+    from ray_tpu.core.config import config
     from ray_tpu.core.rpc_stubs import ControllerStub
 
     try:
-        return ControllerStub(_controller_client()).mh_drop_group(group_id)
+        return ControllerStub(_controller_client()).mh_drop_group(
+            group_id, timeout=config.ctrl_call_timeout_s)
     except Exception:
         log_every("multihost.drop_gang", 10.0, logger,
                   "dropping group %s failed", group_id, exc_info=True)
@@ -559,9 +561,11 @@ def drop_gang(group_id: str) -> bool:
 
 def registry_state(group_id: Optional[str] = None) -> Dict[str, Any]:
     """The controller's view of registered groups (``mh_group_state``)."""
+    from ray_tpu.core.config import config
     from ray_tpu.core.rpc_stubs import ControllerStub
 
-    return ControllerStub(_controller_client()).mh_group_state(group_id)
+    return ControllerStub(_controller_client()).mh_group_state(
+        group_id, timeout=config.ctrl_call_timeout_s)
 
 
 def form_jax_runtime(actors: List[Any], jax_config, *, group_id: str,
@@ -664,7 +668,6 @@ class HostWorker:
         self._ctx = dict(ctx)
         self._fenced = False
         self._stop = threading.Event()
-        # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
         flightrec.record("gang.member.up", group=ctx.get("group_id", ""),
                          member=ctx.get("member", ""),
                          epoch=int(ctx.get("epoch", 0)))
@@ -706,7 +709,6 @@ class HostWorker:
             if reply.get("fenced"):
                 # Zombie: a newer group epoch exists (the gang restarted
                 # without us). Stop touching group state forever.
-                # graftlint: disable=metrics-label-cardinality (gang ids bounded by live gangs; bounded ring)
                 flightrec.record("gang.fenced", group=gid, member=member,
                                  epoch=epoch)
                 with self._lock:
@@ -944,9 +946,11 @@ class HostGroup:
         return self
 
     def _resolve_chips_per_host(self, stub) -> int:
+        from ray_tpu.core.config import config
+
         if self._chips_per_host is not None:
             return int(self._chips_per_host)
-        state = stub.topology_state()
+        state = stub.topology_state(timeout=config.ctrl_call_timeout_s)
         for s in state.get("slices", {}).values():
             cph = s.get("chips_per_host")
             if cph:
@@ -967,14 +971,22 @@ class HostGroup:
         ``_commit_formation`` takes it, so the exception path below is
         the only thing standing between a spawn failure and chips
         stranded until node death."""
+        from ray_tpu.core.config import config
         from ray_tpu.core.rpc_stubs import ControllerStub
+        from ray_tpu.util.deadline import Deadline
 
         stub = ControllerStub(_controller_client())
         cph = self._resolve_chips_per_host(stub)
         chips = self.num_hosts * cph
+        # One budget covers the whole reserve -> register -> fence
+        # sequence: each RPC gets the REMAINING time, not a fresh
+        # per-call allowance, so a slow head cannot stretch formation
+        # to N x the knob before the spawn phase even starts.
+        dl = Deadline.after(config.mh_form_timeout_s)
         with _gang_span("gang:form", group=self.group_id,
                         hosts=self.num_hosts):
-            sub = stub.reserve_subslice(self._owner, chips)
+            sub = stub.reserve_subslice(self._owner, chips,
+                                        timeout=dl.remaining())
             if sub is None:
                 # The controller's refusal already fed _pending_demand
                 # (the autoscaler sees a gang that could not place).
@@ -989,7 +1001,8 @@ class HostGroup:
             try:
                 reg = stub.mh_register_group(self.group_id,
                                              self.num_hosts,
-                                             None, self._owner)
+                                             None, self._owner,
+                                             timeout=dl.remaining())
                 # The fenced write's verdict matters even during
                 # formation: a stale epoch here means a concurrent
                 # re-registration already owns the group — spawning
@@ -1002,7 +1015,8 @@ class HostGroup:
                 # subscript-only-read invariant).
                 if not (stub.mh_group_put(self.group_id, "reservation",
                                           sub["reservation_id"],
-                                          int(reg["epoch"]))
+                                          int(reg["epoch"]),
+                                          timeout=dl.remaining())
                         or {}).get("ok"):
                     raise GroupEpochFenced(
                         f"reservation write for group {self.group_id} "
@@ -1038,14 +1052,18 @@ class HostGroup:
         guard, so a head blip during one cannot strand the other (a
         failed release is logged; node-death reclamation is the
         backstop) — before the formation error propagates."""
+        from ray_tpu.core.config import config
+
         try:
-            stub.release_subslice(reservation_id)
+            stub.release_subslice(reservation_id,
+                                  timeout=config.ctrl_call_timeout_s)
         except Exception:
             log_every("multihost.abort_release", 10.0, logger,
                       "releasing sub-slice %s during formation abort "
                       "failed", reservation_id, exc_info=True)
         try:
-            stub.mh_drop_group(self.group_id)
+            stub.mh_drop_group(self.group_id,
+                               timeout=config.ctrl_call_timeout_s)
         except Exception:
             log_every("multihost.abort_drop", 10.0, logger,
                       "dropping group %s during formation abort failed",
@@ -1083,8 +1101,8 @@ class HostGroup:
         if nodes:
             try:
                 from ray_tpu.core.rpc_stubs import ControllerStub
-                taints = ControllerStub(
-                    _controller_client()).taint_state()
+                taints = ControllerStub(_controller_client()).taint_state(
+                    timeout=config.ctrl_call_timeout_s)
             except Exception:
                 taints = {}
             if taints:
@@ -1131,6 +1149,7 @@ class HostGroup:
         Every member then receives the same (address, coordinator,
         epoch) triple: aligned visibility by construction."""
         import ray_tpu
+        from ray_tpu.core.config import config
         from ray_tpu.core.rpc_stubs import ControllerStub
 
         coordinator = member_name(0)
@@ -1140,7 +1159,8 @@ class HostGroup:
             put = ControllerStub(_controller_client()).mh_group_put(
                 self.group_id, "coordinator",
                 {"member": coordinator, "address": coord_addr,
-                 "epoch": epoch}, epoch)
+                 "epoch": epoch}, epoch,
+                timeout=config.ctrl_call_timeout_s)
             if not put.get("ok"):
                 raise GroupEpochFenced(
                     f"election write for group {self.group_id} epoch "
@@ -1201,11 +1221,13 @@ class HostGroup:
         action through the exact same epoch-fenced reconcile path as a
         real member death: never a double kill. Only polled when
         config.autopilot_enabled — the OFF path does not even RPC."""
+        from ray_tpu.core.config import config
         from ray_tpu.core.rpc_stubs import ControllerStub
 
         try:
             victim = ControllerStub(_controller_client()).mh_group_get(
-                self.group_id, "autopilot_evict")
+                self.group_id, "autopilot_evict",
+                timeout=config.ctrl_call_timeout_s)
         except Exception:
             return None
         if not isinstance(victim, str):
@@ -1308,11 +1330,13 @@ class HostGroup:
             sub, self._sub = self._sub, None
         if sub is None:
             return False
+        from ray_tpu.core.config import config
         from ray_tpu.core.rpc_stubs import ControllerStub
 
         try:
             ControllerStub(_controller_client()).release_subslice(
-                sub["reservation_id"])
+                sub["reservation_id"],
+                timeout=config.ctrl_call_timeout_s)
         except Exception:
             log_every("multihost.release", 10.0, logger,
                       "releasing sub-slice %s of group %s failed "
@@ -1358,10 +1382,12 @@ class HostGroup:
 
     def coordinator(self) -> Optional[Dict[str, Any]]:
         """The current election record, from the group's fenced KV."""
+        from ray_tpu.core.config import config
         from ray_tpu.core.rpc_stubs import ControllerStub
 
         return ControllerStub(_controller_client()).mh_group_get(
-            self.group_id, "coordinator")
+            self.group_id, "coordinator",
+            timeout=config.ctrl_call_timeout_s)
 
     def call_all(self, method: str, *args,
                  timeout: Optional[float] = None, **kwargs) -> List[Any]:
@@ -1406,6 +1432,6 @@ class HostGroup:
             }
         try:
             out["registry"] = registry_state(self.group_id)
-        except Exception:  # graftlint: disable=swallowed-exception (status stays useful when the head is briefly unreachable)
+        except Exception:
             out["registry"] = None
         return out

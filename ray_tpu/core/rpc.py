@@ -247,6 +247,14 @@ class RpcConnectError(RpcError):
     abandon per-peer work) from "the connection hiccuped" (retry)."""
 
 
+class RpcTimeout(RpcError, TimeoutError):
+    """A bounded call's reply did not land within its timeout. Also a
+    ``TimeoutError``, so pre-existing ``except TimeoutError`` callers
+    keep working; the RpcError base lets transport-failure handlers
+    treat a timed-out peer like a dead one (same recovery: re-resolve,
+    retry, or raise the typed refusal)."""
+
+
 class RemoteCallError(Exception):
     """The handler on the peer raised; carries the remote exception."""
 
@@ -589,7 +597,6 @@ class RpcServer:
                 # delay rule here CAN stall the reactor for inline
                 # methods — deliberately: that's how a test simulates a
                 # wedged control plane.
-                # graftlint: disable=reactor-blocking-call
                 faultinject.check(
                     f"rpc.server.{self._name}.{msg.get('method')}")
             handler = self._handlers[msg["method"]]
@@ -966,7 +973,7 @@ class _PendingCall:
 
     def wait(self, timeout: Optional[float]):
         if not self._event.wait(timeout):
-            raise TimeoutError("RPC call timed out")
+            raise RpcTimeout("RPC call timed out")
         if self._err is not None:
             raise self._err
         if not self._msg["ok"]:

@@ -176,7 +176,6 @@ class CoreWorker:
                 "peek_object": self._handle_peek_object,
                 # remote-free entry point for external tooling (the
                 # owner frees its own objects via free_object directly)
-                # graftlint: disable=rpc-dead-endpoint
                 "free_object": self._handle_free_object,
                 "pull_done": self._handle_pull_done,
                 "pull_failed": self._handle_pull_failed,
@@ -1908,6 +1907,7 @@ class ActorExecutionRuntime:
                     import contextvars as _cv
 
                     ctx = _cv.copy_context()
+                    # graftlint: disable=unbounded-blocking-call (the wait IS the actor task: user code owns its duration, and the CALLER'S RpcClient timeout is the bound — a local cap here would kill legitimate long tasks)
                     result = self._exec_pool.submit(
                         lambda: ctx.run(method, *args, **kwargs)).result()
                 else:
@@ -1949,6 +1949,7 @@ class ActorExecutionRuntime:
                     tracing._ctx.reset(token)
 
             fut = asyncio.run_coroutine_threadsafe(wrapped(), self._loop)
+            # graftlint: disable=unbounded-blocking-call (same contract as the pool branch: the coroutine IS the actor task and the caller's RPC timeout bounds it end-to-end)
             return fut.result()
         return method(*args, **kwargs)
 

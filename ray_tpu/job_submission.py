@@ -61,6 +61,7 @@ class JobSupervisor:
     def _pump_logs(self) -> None:
         for line in self._proc.stdout:
             self._log_chunks.append(line)
+        # graftlint: disable=unbounded-blocking-call (the pump lives exactly as long as the child: job entrypoints have no duration bound by design, stdout EOF above already means the process is exiting, and the thread is daemonized so shutdown never waits on it)
         self._returncode = self._proc.wait()
         self._status = ("SUCCEEDED" if self._returncode == 0 else "FAILED")
         self._publish_state()

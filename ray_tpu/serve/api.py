@@ -106,7 +106,13 @@ def status(timeout: float = 30.0, include_slo: bool = True
     exact failure it is observing. The failed probe doubles as the
     failure report that triggers the controller's restart."""
     from ray_tpu.serve.controller import CONTROLLER_NAME
+    from ray_tpu.util.deadline import Deadline
 
+    # ``timeout`` is the budget for the WHOLE probe, not per attempt:
+    # the retry below runs on the REMAINING time, so a controller that
+    # burned the first attempt to its deadline degrades immediately
+    # instead of earning a second full allowance.
+    dl = Deadline.after(timeout)
     try:
         # Lookup, not get_or_create: a status probe must neither SPAWN
         # a control plane nor block a long ping against a restarting
@@ -115,7 +121,8 @@ def status(timeout: float = 30.0, include_slo: bool = True
         if not _controller_alive(controller):
             return _degraded_status()  # mid-restart: don't park on it
         try:
-            st = ray_tpu.get(controller.status.remote(), timeout=timeout)
+            st = ray_tpu.get(controller.status.remote(),
+                             timeout=dl.remaining())
         except Exception:
             # The failed call doubles as the failure report that starts
             # the controller's restart. Retry once on the same handle
@@ -125,7 +132,8 @@ def status(timeout: float = 30.0, include_slo: bool = True
             # record now RESTARTING means a real outage: degrade.
             if not _controller_alive(controller):
                 return _degraded_status()
-            st = ray_tpu.get(controller.status.remote(), timeout=timeout)
+            st = ray_tpu.get(controller.status.remote(),
+                             timeout=dl.remaining())
     except Exception:
         return _degraded_status()
     if include_slo:

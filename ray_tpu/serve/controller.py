@@ -175,7 +175,8 @@ class ServeController:
 
         try:
             self._epoch = ControllerStub(
-                get_core_worker().controller).epoch_bump(EPOCH_NAME)
+                get_core_worker().controller).epoch_bump(
+                    EPOCH_NAME, timeout=config.ctrl_call_timeout_s)
         except Exception:
             # Head unreachable at start: run epoch-less for now —
             # publishes go out unfenced and checkpoints are skipped —
@@ -244,14 +245,14 @@ class ServeController:
         with self._save_mutex:
             blob = pickle.dumps(self._snapshot_state())
             try:
-                # graftlint: disable=lock-held-blocking
                 # _save_mutex exists precisely to serialize this RPC
                 # with concurrent snapshots: an unserialized slow save
                 # would let a STALE snapshot overwrite a fresher one.
                 # Nothing else ever takes _save_mutex.
                 ok = ControllerStub(
                     get_core_worker().controller).kv_put_fenced(
-                        STATE_KEY, blob, self._epoch, EPOCH_NAME)
+                        STATE_KEY, blob, self._epoch, EPOCH_NAME,
+                        timeout=config.ctrl_call_timeout_s)
             except Exception:
                 # Head blip: state is stale until the next mutation or
                 # reconcile-tick change saves again. Never silent —
@@ -298,7 +299,8 @@ class ServeController:
 
         try:
             blob = ControllerStub(
-                get_core_worker().controller).kv_get(STATE_KEY)
+                get_core_worker().controller).kv_get(
+                    STATE_KEY, timeout=config.ctrl_call_timeout_s)
         except Exception:
             log_every("serve.restore", 10.0, logger,
                       "serve controller checkpoint unreadable (head "
@@ -556,7 +558,8 @@ class ServeController:
             try:
                 sub = ControllerStub(
                     get_core_worker().controller).reserve_subslice(
-                        replica_id, chips, list(mesh_shape))
+                        replica_id, chips, list(mesh_shape),
+                        timeout=config.ctrl_call_timeout_s)
             except Exception:
                 sub = None  # head unreachable counts as no capacity
             if sub is None:
@@ -667,7 +670,8 @@ class ServeController:
 
         try:
             ControllerStub(get_core_worker().controller) \
-                .release_subslice(reservation_id)
+                .release_subslice(reservation_id,
+                                  timeout=config.ctrl_call_timeout_s)
         except Exception:
             with self._lock:
                 self._pending_releases.append(reservation_id)
@@ -714,7 +718,7 @@ class ServeController:
         for rid in pending:
             try:
                 ControllerStub(get_core_worker().controller) \
-                    .release_subslice(rid)
+                    .release_subslice(rid, timeout=config.ctrl_call_timeout_s)
                 released += 1
             except Exception:
                 with self._lock:
@@ -776,7 +780,8 @@ class ServeController:
                 get_core_worker().controller).psub_publish(
                     SNAPSHOT_CHANNEL, rec.name, snapshot,
                     rec.pub_version + 1,
-                    self._epoch if self._epoch > 0 else None)
+                    self._epoch if self._epoch > 0 else None,
+                    timeout=config.ctrl_call_timeout_s)
         except Exception:
             return None
         if version is None:
@@ -1022,7 +1027,8 @@ class ServeController:
 
         try:
             nodes = ControllerStub(
-                get_core_worker().controller).list_nodes()
+                get_core_worker().controller).list_nodes(
+                    timeout=config.ctrl_call_timeout_s)
         except Exception:
             return None
         alive = [n["node_id"] for n in nodes if n["alive"]]
@@ -1077,7 +1083,8 @@ class ServeController:
                 try:
                     record = ControllerStub(
                         get_core_worker().controller).get_actor(
-                            proxy.handle.actor_id.binary())
+                            proxy.handle.actor_id.binary(),
+                            timeout=config.ctrl_call_timeout_s)
                 except Exception:
                     # Actor table unreachable: we can neither verify nor
                     # replace (starting a proxy needs the head too), so
@@ -1229,7 +1236,8 @@ class ServeController:
 
                 record = ControllerStub(
                     get_core_worker().controller).get_actor(
-                        replica.handle.actor_id.binary())
+                        replica.handle.actor_id.binary(),
+                        timeout=config.ctrl_call_timeout_s)
             except Exception:
                 continue
             if record is None or record["state"] == "DEAD":

@@ -195,9 +195,12 @@ def _local_slice_id() -> Optional[str]:
         try:
             from ray_tpu.core.runtime import get_core_worker
 
+            from ray_tpu.core.config import config as rt_config
+
             core = get_core_worker()
             me = core.node_id.hex()
-            for n in core.controller.call("list_nodes"):
+            for n in core.controller.call(
+                    "list_nodes", timeout=rt_config.ctrl_call_timeout_s):
                 if n["node_id"] == me and n.get("slice"):
                     slice_id = n["slice"]["slice_id"]
                     break
@@ -305,11 +308,13 @@ class _Router:
     def _known_to_controller(self) -> bool:
         """One cheap existence probe so unknown names fail fast (404), not
         after a 60s wait."""
+        from ray_tpu.core.config import config as rt_config
         from ray_tpu.core.runtime import get_core_worker
 
         try:
             snap = get_core_worker().controller.call(
-                "psub_snapshot", SNAPSHOT_CHANNEL)
+                "psub_snapshot", SNAPSHOT_CHANNEL,
+                timeout=rt_config.ctrl_call_timeout_s)
             return self.name in snap
         except Exception:
             return True  # can't tell: fall through to the normal wait
@@ -554,7 +559,6 @@ class _Router:
         replicas — otherwise fall through to the legacy colocated path
         (prefill replicas run the full engine; role is routing posture,
         not capability)."""
-        # graftlint: disable=unguarded-field-access — advisory reads;
         # a stale posture routes one request the legacy way, harmlessly
         if self._role != "prefill" or not self._decode_dep:
             return False

@@ -678,7 +678,8 @@ class PipelinePlane:
             stub = ControllerStub(_controller_client())
             reg = stub.pipe_register(self.name, self.n_stages,
                                      group.group_id,
-                                     f"pid:{os.getpid()}")
+                                     f"pid:{os.getpid()}",
+                                     timeout=_cfg.ctrl_call_timeout_s)
         except BaseException:
             try:
                 group.shutdown()
@@ -700,7 +701,7 @@ class PipelinePlane:
         the gang down — each best-effort in its own guard, so a head
         blip during one cannot strand the other."""
         try:
-            stub.pipe_drop(self.name)
+            stub.pipe_drop(self.name, timeout=_cfg.ctrl_call_timeout_s)
         except Exception:
             log_every("pipeline.abort_drop", 10.0, logger,
                       "dropping pipeline %s during formation abort "
@@ -819,7 +820,8 @@ class PipelinePlane:
             # again).
             reg = stub.pipe_register(self.name, self.n_stages,
                                      group.group_id,
-                                     f"pid:{os.getpid()}")
+                                     f"pid:{os.getpid()}",
+                                     timeout=_cfg.ctrl_call_timeout_s)
             self._adopt_epoch(reg)
         flightrec.record("pipe.resetup", pipeline=self.name,
                          step=self._step, epoch=self._epoch,
@@ -1189,7 +1191,8 @@ class PipelinePlane:
 
         try:
             reply = ControllerStub(_controller_client())\
-                .pipe_step_complete(self.name, completed, self._epoch)
+                .pipe_step_complete(self.name, completed, self._epoch,
+                                    timeout=_cfg.ctrl_call_timeout_s)
         except Exception:
             log_every("pipeline.step_report", 10.0, logger,
                       "reporting step %d of pipeline %s failed",
@@ -1286,7 +1289,8 @@ class PipelinePlane:
         """The controller's record of this pipeline (``pipe_state``)."""
         from ray_tpu.core.rpc_stubs import ControllerStub
 
-        return ControllerStub(_controller_client()).pipe_state(self.name)
+        return ControllerStub(_controller_client()).pipe_state(
+            self.name, timeout=_cfg.ctrl_call_timeout_s)
 
     def _collect(self) -> None:
         """Snapshot-time collector: the doctor's pipeline-stall signal.
@@ -1312,27 +1316,21 @@ class PipelinePlane:
                          if self._last_breakdown else None)
         # Pipeline names and stage indexes are bounded by live planes
         # (a handful per driver), not request volume.
-        # graftlint: disable=metrics-label-cardinality
         cm.PIPE_INFLIGHT.set(inflight, tags={"pipeline": self.name})
-        # graftlint: disable=metrics-label-cardinality
         cm.PIPE_ACTIVATION_BYTES.set(act_bytes,
                                      tags={"pipeline": self.name})
         for stage, idle in rows:
-            # graftlint: disable=metrics-label-cardinality
             cm.PIPE_STAGE_IDLE_S.set(idle, tags={"pipeline": self.name,
                                                  "stage": stage})
         if breakdown is not None:
             for phase in ("fwd", "bwd", "apply", "allgather", "idle"):
-                # graftlint: disable=metrics-label-cardinality
                 cm.PIPE_STEP_PHASE_S.set(
                     breakdown[f"{phase}_s"],
                     tags={"pipeline": self.name, "phase": phase})
-            # graftlint: disable=metrics-label-cardinality
             cm.PIPE_MODEL_TFLOPS.set(breakdown["model_tflops"],
                                      tags={"pipeline": self.name})
             peak = rt_config.pipe_peak_tflops
             if peak > 0:
-                # graftlint: disable=metrics-label-cardinality
                 cm.PIPE_MFU.set(
                     100.0 * breakdown["model_tflops"] / peak,
                     tags={"pipeline": self.name})
@@ -1347,7 +1345,8 @@ class PipelinePlane:
         from ray_tpu.core.rpc_stubs import ControllerStub
 
         try:
-            ControllerStub(_controller_client()).pipe_drop(self.name)
+            ControllerStub(_controller_client()).pipe_drop(
+                self.name, timeout=_cfg.ctrl_call_timeout_s)
         except Exception:
             log_every("pipeline.stop_drop", 10.0, logger,
                       "dropping pipeline record %s failed", self.name,
@@ -1370,22 +1369,16 @@ class PipelinePlane:
             return
         from ray_tpu.core import coremetrics as cm
 
-        # graftlint: disable=metrics-label-cardinality
         cm.PIPE_INFLIGHT.set(0.0, tags={"pipeline": self.name})
-        # graftlint: disable=metrics-label-cardinality
         cm.PIPE_ACTIVATION_BYTES.set(0.0, tags={"pipeline": self.name})
         for i in range(self.n_stages):
-            # graftlint: disable=metrics-label-cardinality
             cm.PIPE_STAGE_IDLE_S.set(0.0, tags={"pipeline": self.name,
                                                 "stage": f"s{i}"})
         for phase in ("fwd", "bwd", "apply", "allgather", "idle"):
-            # graftlint: disable=metrics-label-cardinality
             cm.PIPE_STEP_PHASE_S.set(0.0, tags={"pipeline": self.name,
                                                 "phase": phase})
-        # graftlint: disable=metrics-label-cardinality
         cm.PIPE_MODEL_TFLOPS.set(0.0, tags={"pipeline": self.name})
         if rt_config.pipe_peak_tflops > 0:
-            # graftlint: disable=metrics-label-cardinality
             cm.PIPE_MFU.set(0.0, tags={"pipeline": self.name})
 
 

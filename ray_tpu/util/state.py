@@ -50,6 +50,7 @@ def node_infos(nodes: Optional[List[Dict[str, Any]]] = None,
         client = None
         try:
             client = RpcClient(tuple(n["addr"]), connect_timeout=timeout)
+            # graftlint: disable=deadline-not-propagated (PER-NODE bound by design: the docstring's contract is that one hung supervisor costs at most `timeout`, not that the whole sweep fits in it — errors fill in for slow nodes, so a Deadline here would starve the tail of a big cluster)
             out.append(client.call("get_info", timeout=timeout))
         except Exception as e:
             out.append({"node_id": n["node_id"], "error": str(e)})

@@ -444,7 +444,8 @@ def test_mutation_gang_dropped_subslice_release_caught():
     at GANG granularity, and a repo-blocking finding."""
     project = repo_project_with(
         "ray_tpu/core/multihost.py",
-        "            stub.release_subslice(reservation_id)\n",
+        "            stub.release_subslice(reservation_id,\n"
+        "                                  timeout=config.ctrl_call_timeout_s)\n",
         "            pass\n")
     found = run_checker(lifetime.check, project)
     hits = [f for f in found if f.rule == rules.RESOURCE_LEAK
@@ -460,7 +461,8 @@ def test_mutation_gang_dropped_group_drop_caught():
     through the _abort_formation self-callee chain."""
     project = repo_project_with(
         "ray_tpu/core/multihost.py",
-        """            stub.mh_drop_group(self.group_id)
+        """            stub.mh_drop_group(self.group_id,
+                               timeout=config.ctrl_call_timeout_s)
         except Exception:
             log_every("multihost.abort_drop\"""",
         """            pass
@@ -824,7 +826,7 @@ def test_mutation_pipeline_record_drop_caught():
     self-callee chain."""
     project = repo_project_with(
         "ray_tpu/train/pipeline_plane.py",
-        """            stub.pipe_drop(self.name)
+        """            stub.pipe_drop(self.name, timeout=_cfg.ctrl_call_timeout_s)
         except Exception:
             log_every("pipeline.abort_drop\"""",
         """            pass

@@ -373,7 +373,8 @@ def test_mutation_multihost_discarded_reservation_put():
         fence_safety.check, "ray_tpu/core/multihost.py",
         """                if not (stub.mh_group_put(self.group_id, "reservation",
                                           sub["reservation_id"],
-                                          int(reg["epoch"]))
+                                          int(reg["epoch"]),
+                                          timeout=dl.remaining())
                         or {}).get("ok"):
                     raise GroupEpochFenced(
                         f"reservation write for group {self.group_id} "

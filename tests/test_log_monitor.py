@@ -52,7 +52,7 @@ def test_task_print_streams_to_driver(ray_start_regular):
     import threading
 
     streamer._stopped = threading.Event()
-    streamer.poll_once(timeout=0.5)
+    streamer.poll_once(window_s=0.5)
     streamer.stop()
     text = buf.getvalue()
     assert marker in text
@@ -83,10 +83,10 @@ def test_streamer_diffs_no_duplicates(ray_start_regular):
     streamer._stopped = threading.Event()
     deadline = time.monotonic() + 30
     while "line-1" not in buf.getvalue() and time.monotonic() < deadline:
-        streamer.poll_once(timeout=0.5)
+        streamer.poll_once(window_s=0.5)
     first = buf.getvalue().count("line-1")
     assert first >= 1
     # Re-polling with nothing new must not reprint old lines.
-    streamer.poll_once(timeout=0.5)
+    streamer.poll_once(window_s=0.5)
     assert buf.getvalue().count("line-1") == first
     streamer.stop()

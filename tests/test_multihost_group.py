@@ -417,28 +417,30 @@ def test_form_aborts_when_reservation_write_is_fenced(monkeypatch):
         def __init__(self, client):
             pass
 
-        def topology_state(self):
+        def topology_state(self, timeout=None):
             return {"slices": {"s0": {"chips_per_host": 4}}}
 
-        def reserve_subslice(self, owner, chips):
+        def reserve_subslice(self, owner, chips, timeout=None):
             calls.append(("reserve", chips))
             return {"reservation_id": "res-1", "slice_id": "s0",
                     "nodes": ["n0", "n1"], "origin": [0, 0],
                     "shape": [4, 8]}
 
-        def mh_register_group(self, group_id, num_hosts, res, owner):
+        def mh_register_group(self, group_id, num_hosts, res, owner,
+                              timeout=None):
             calls.append(("register", group_id))
             return {"epoch": 3}
 
-        def mh_group_put(self, group_id, key, value, epoch):
+        def mh_group_put(self, group_id, key, value, epoch,
+                         timeout=None):
             calls.append(("put", key, epoch))
             return {"ok": False, "reason": "stale_epoch", "epoch": 4}
 
-        def release_subslice(self, reservation_id):
+        def release_subslice(self, reservation_id, timeout=None):
             calls.append(("release", reservation_id))
             return True
 
-        def mh_drop_group(self, group_id):
+        def mh_drop_group(self, group_id, timeout=None):
             calls.append(("drop", group_id))
             return True
 

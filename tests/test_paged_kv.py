@@ -580,6 +580,8 @@ def test_paged_soak_invariants():
 # ------------------------------------------------------ stats plumbing
 
 
+@pytest.mark.slow  # PR 20 rebudget (5.8s): stats-plumbing variant;
+# allocator correctness and leak gates stay tier-1
 def test_paged_stats_and_replica_metrics_plumbing():
     """pages_free / pages_pinned / kv_fragmentation / prefill-backlog
     flow engine.stats() -> replica_metrics() (the dict the controller

@@ -193,6 +193,8 @@ def test_one_stage_degenerate_bitexact(pipe_cluster):
 # --------------------------------------------------------- ZeRO-1
 
 
+@pytest.mark.slow  # PR 20 rebudget (11.3s): ZeRO-1 parity also
+# covered by the zero1 pipeline-parity sweep above
 def test_zero1_state_bytes_and_parity():
     """ZeRO-1 sharding annotations on the optimizer state: per-replica
     state bytes drop to ~1/N (<= 0.6x at data=2 — the acceptance
@@ -397,6 +399,9 @@ def _agg(source="n1/node/pid1"):
     return {source: _Registry.get().snapshot()}
 
 
+@pytest.mark.slow  # PR 20 rebudget (7.1s): doctor observability on
+# an injected stall; stall detection itself stays covered by the
+# chaos bench
 @pytest.mark.chaos
 def test_doctor_names_pipeline_stall_straggler(pipe_cluster):
     """Delay stage 1's forward (faultinject at the pipeline.stage site)

@@ -48,6 +48,8 @@ def test_ppo_single_iteration(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow  # PR 20 rebudget (6.2s): learning soak; the PPO
+# update math keeps its fast unit gates
 @pytest.mark.timeout_s(420)
 def test_ppo_learns_cartpole(ray_start_regular):
     """Run-to-reward: PPO should clearly improve on CartPole within a small

@@ -20,7 +20,14 @@ import pytest
 import ray_tpu
 from ray_tpu import serve
 
-MESHES = [(1, 8), (2, 4), (8, 1)]
+MESHES = [
+    # PR 20 rebudget (7.3s/7.1s): the 8x1 run stays THE tier-1
+    # bit-exact gate; the other orientations re-trace the same
+    # program under a rotated mesh
+    pytest.param((1, 8), marks=pytest.mark.slow),
+    pytest.param((2, 4), marks=pytest.mark.slow),
+    (8, 1),
+]
 
 
 def _cfg():
@@ -207,6 +214,8 @@ def _drive(eng, prompts, n_tok=6):
     return [r.output for r in reqs]
 
 
+@pytest.mark.slow  # PR 20 rebudget (10.5s): engine-level mesh parity;
+# the 8x1 sharded-logits bit-exact gate stays tier-1
 def test_engine_mesh_matches_single_chip(model):
     """The full continuous-batching engine (admission waves, prefix
     suffix splice, paged pool, chunked prefill) emits identical token

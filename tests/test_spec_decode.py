@@ -116,6 +116,8 @@ def test_spec_greedy_bit_exact_across_boundaries(model, draft):
     spec.shutdown()
 
 
+@pytest.mark.slow  # PR 20 rebudget (7.8s): step-compression property;
+# accept-rate plumbing and bit-exactness keep their own tier-1 gates
 def test_spec_perfect_draft_compresses_steps(model):
     """Draft == target => every proposal accepted (rate 1.0) and the
     target runs ~1/(k+1) as many forwards: the acceptance math, length
@@ -138,6 +140,8 @@ def test_spec_perfect_draft_compresses_steps(model):
     spec.shutdown()
 
 
+@pytest.mark.slow  # PR 20 rebudget (6.9s): truncation edge case;
+# boundary bit-exactness stays tier-1
 def test_spec_eos_and_max_tokens_truncate_mid_round(model):
     """EOS landing inside an accepted run must cut the stream exactly
     where sequential decode would: drive plain first to learn a token
@@ -281,6 +285,8 @@ def test_spec_rollback_soak_zero_leaked_pages(model, draft):
 # ----------------------------------------------------------- composition
 
 
+@pytest.mark.slow  # PR 20 rebudget (6.2s): composition variant;
+# spec and prefix cache each keep their own tier-1 gates
 def test_spec_composes_with_prefix_cache(model, draft):
     """Second submission of a shared prompt splices cached pages into
     the TARGET while the draft re-prefills (it has no prefix index) —
@@ -301,6 +307,8 @@ def test_spec_composes_with_prefix_cache(model, draft):
     spec.shutdown()
 
 
+@pytest.mark.slow  # PR 20 rebudget (10.9s): composition variant;
+# chunked prefill and spec each keep their own tier-1 bit-exact gates
 def test_spec_composes_with_chunked_prefill(model, draft):
     """A long prompt admits through chunked prefill WHILE a short one
     decodes speculatively: spec rounds run with a mid-prefill slot in
@@ -420,6 +428,7 @@ def test_device_sampler_sampled_rows_deterministic(model):
     assert all(0 <= t < _tiny()[0].vocab_size for t in outs[0])
 
 
+@pytest.mark.slow  # PR 20 rebudget (8.2s): warmup perf property
 def test_warmup_predispatches_step_programs(model, draft):
     """warmup() compiles the step-loop grid before traffic: the compile
     keys are marked, the parked KV lengths come back zeroed, and the
@@ -496,6 +505,8 @@ def test_dispatch_fresh_detaches_only_first_dispatch(model, draft):
 # --------------------------------------------------------- observability
 
 
+@pytest.mark.slow  # PR 20 rebudget (6.2s): stats/steplog plumbing;
+# spec correctness and accept-rate math keep their fast gates
 def test_spec_stats_steplog_and_deployment_plumbing(model, draft):
     """spec stats() block, draft/verify steplog phases, timeline() spec
     flag, and the deployment-level replica_metrics passthrough."""

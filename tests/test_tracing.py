@@ -69,6 +69,8 @@ def test_dump_stacks_local():
     assert "thread" in text and "test_dump_stacks_local" in text
 
 
+@pytest.mark.slow  # PR 20 rebudget (5.1s): remote stack-dump
+# surface; local dump coverage stays tier-1
 def test_worker_stack_dump_rpc(ray_start_regular):
     from ray_tpu.core import api as api_mod
     from ray_tpu.core.rpc import RpcClient
